@@ -1,0 +1,83 @@
+"""Eager autograd tape shared between `ndarray` and `autograd`.
+
+TPU-native re-design of the reference's imperative autograd
+(`src/imperative/imperative.cc`, `Imperative::RecordOp/Backward`
+[UNVERIFIED], SURVEY.md §2.2): instead of recording NNVM nodes and
+running a Gradient pass, every eagerly-executed op records a
+`jax.vjp` closure.  `backward()` walks the tape in reverse, calling the
+stored vjp functions and accumulating cotangents — the functional
+equivalent of MXNet's backward graph executed on the dependency engine.
+
+Under `hybridize()` this tape is bypassed entirely: the whole cached
+jitted program becomes ONE tape node whose vjp is the vjp of the jitted
+function (CachedOp::Backward equivalence, SURVEY.md §3.3).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Sequence
+
+__all__ = [
+    "TapeNode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "current_tape",
+    "new_tape",
+    "append_node",
+]
+
+
+class TapeNode:
+    """One recorded op: inputs/outputs are NDArrays, vjp the pullback."""
+
+    __slots__ = ("inputs", "outputs", "vjp", "n_out")
+
+    def __init__(self, inputs: Sequence[Any], outputs: Sequence[Any], vjp: Callable, n_out: int):
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.vjp = vjp
+        self.n_out = n_out
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: List[TapeNode] = []
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev = _STATE.recording
+    _STATE.recording = flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev = _STATE.training
+    _STATE.training = flag
+    return prev
+
+
+def current_tape() -> List[TapeNode]:
+    return _STATE.tape
+
+
+def new_tape() -> None:
+    _STATE.tape = []
+
+
+def append_node(node: TapeNode) -> None:
+    _STATE.tape.append(node)

@@ -1,0 +1,73 @@
+"""AMP — automatic mixed precision, bf16-first.
+
+Re-design of `python/mxnet/amp/` + `src/nnvm/low_precision_pass.cc`
+[UNVERIFIED] (SURVEY.md §2.2 "AMP graph pass"): instead of an NNVM
+graph rewrite with fp16 allow/deny op lists, the TPU policy is a dtype
+policy on parameters + inputs (bf16 matmuls/convs accumulate fp32 via
+`preferred_element_type` — set in nn_ops).  bf16 needs no loss scaling
+(same exponent range as fp32); a dynamic `LossScaler` is still provided
+for fp16 parity and for users porting reference scripts.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .lists import FP16_FP32_FUNCS, FP16_FUNCS, FP32_FUNCS
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "LossScaler", "amp_dtype"]
+
+_state = {"initialized": False, "dtype": None, "loss_scaler": None}
+
+
+def amp_dtype():
+    return _state["dtype"]
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable mixed precision. TPU-native default is bfloat16."""
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
+    _state["initialized"] = True
+    _state["dtype"] = dt
+    _state["loss_scaler"] = LossScaler(init_scale=1.0 if dt == jnp.bfloat16 else 2 ** 16)
+
+
+def init_trainer(trainer):
+    if not _state["initialized"]:
+        raise RuntimeError("call amp.init() before amp.init_trainer()")
+    trainer._amp_loss_scaler = _state["loss_scaler"]
+    return trainer
+
+
+def scale_loss(loss, trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None) or _state["loss_scaler"]
+    if scaler is None:
+        return loss
+    if isinstance(loss, (list, tuple)):
+        return type(loss)(l * scaler.loss_scale for l in loss)
+    return loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None) or _state["loss_scaler"]
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data_nd is not None and p._data_nd._grad is not None:
+            g = p.grad()
+            g._data = g._data * inv
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a Block's parameters to the AMP dtype for inference."""
+    dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else "float16"
+    net.cast(dt)
+    return net
+
+
+convert_hybrid_block = convert_model

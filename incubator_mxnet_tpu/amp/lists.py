@@ -1,0 +1,25 @@
+"""AMP op lists (ref `python/mxnet/amp/lists/symbol_fp16.py`
+[UNVERIFIED]): which op families run in low precision.  On TPU these
+inform the dtype policy (params/activations bf16; reductions,
+softmax/log/exp and norms accumulate fp32)."""
+
+# run in bf16 (MXU-bound)
+FP16_FUNCS = [
+    "FullyConnected", "Convolution", "Deconvolution", "batch_dot", "dot",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+]
+
+# keep fp32 (range/precision sensitive)
+FP32_FUNCS = [
+    "softmax", "log_softmax", "masked_softmax", "BatchNorm", "LayerNorm",
+    "GroupNorm", "InstanceNorm", "L2Normalization", "norm", "exp", "log",
+    "sum", "mean", "SoftmaxOutput", "softmax_cross_entropy",
+]
+
+# either, following input dtype
+FP16_FP32_FUNCS = [
+    "relu", "sigmoid", "tanh", "Activation", "Pooling", "Dropout", "reshape",
+    "transpose", "concat", "split", "add", "subtract", "multiply", "maximum",
+    "minimum", "clip", "where", "take", "Embedding",
+]

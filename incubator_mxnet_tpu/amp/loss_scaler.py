@@ -1,0 +1,36 @@
+"""Dynamic loss scaler (ref `python/mxnet/amp/loss_scaler.py`
+[UNVERIFIED]): double scale every `scale_window` good steps, halve on
+overflow.  bf16 training on TPU generally runs with scale=1."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        for p in params:
+            if p.grad_req == "null" or p._data_nd is None or p._data_nd._grad is None:
+                continue
+            g = p.grad()._data
+            if not bool(jnp.isfinite(g).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped == self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -1,0 +1,229 @@
+"""`mx.autograd` — record/pause scopes and tape backward.
+
+Re-design of the reference autograd (`python/mxnet/autograd.py`,
+`src/imperative/imperative.cc` `Imperative::Backward` [UNVERIFIED],
+SURVEY.md §2.2, §3.2): `record()` flips the thread-local recording flag
+read by `ndarray.apply_op`; `backward()` runs the reverse tape walk,
+calling each node's stored `jax.vjp` pullback and accumulating
+cotangents into leaf `.grad` arrays honoring `grad_req`
+('write'/'add'/'null').
+
+Higher-order gradients go through `hybridize()`/`jax.grad` composition
+rather than re-taping the backward pass (documented deviation — the
+reference's higher-order support was itself partial).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import _tape
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad"]
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode_: Optional[bool]):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = _tape.set_recording(self._enter_is_record)
+            if self._enter_is_record:
+                # fresh tape only at the OUTERMOST record scope — a record()
+                # nested inside pause() must keep taping onto the same graph
+                _RecordingStateScope._record_depth += 1
+                if _RecordingStateScope._record_depth == 1:
+                    _tape.new_tape()
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = _tape.set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            if self._enter_is_record:
+                _RecordingStateScope._record_depth -= 1
+            _tape.set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            _tape.set_training(self._prev_train_mode)
+
+    _record_depth = 0
+
+
+def record(train_mode: bool = True):
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def is_recording() -> bool:
+    return _tape.is_recording()
+
+
+def is_training() -> bool:
+    return _tape.is_training()
+
+
+def set_recording(flag: bool) -> bool:
+    return _tape.set_recording(flag)
+
+
+def set_training(flag: bool) -> bool:
+    return _tape.set_training(flag)
+
+
+def mark_variables(variables: Sequence[NDArray], gradients: Sequence[NDArray],
+                   grad_reqs="write"):
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = req
+        v._in_graph = req != "null"
+        v._grad = g
+
+
+def backward(heads: Sequence[NDArray], head_grads: Optional[Sequence] = None,
+             retain_graph: bool = False, train_mode: bool = True):
+    """Reverse tape walk (Imperative::Backward equivalence)."""
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    grads = {}  # id(NDArray) -> raw cotangent
+    for i, h in enumerate(heads):
+        if not h._in_graph:
+            raise MXNetError("cannot differentiate a head that is not in the autograd graph "
+                             "(did you forget autograd.record() or attach_grad()?)")
+        hg = None if head_grads is None else head_grads[i]
+        g = jnp.ones_like(h._data) if hg is None else jnp.asarray(
+            hg._data if isinstance(hg, NDArray) else hg)
+        _accum(grads, h, g)
+
+    tape = _tape.current_tape()
+    for node in reversed(tape):
+        outs_g = []
+        any_out = False
+        for o in node.outputs:
+            g = grads.get(id(o))
+            if g is None:
+                g = jnp.zeros_like(o._data)
+            else:
+                any_out = True
+            outs_g.append(g)
+        if not any_out:
+            continue
+        cot = outs_g[0] if node.n_out == 1 else tuple(outs_g)
+        in_grads = node.vjp(cot)
+        for inp, ig in zip(node.inputs, in_grads):
+            if ig is None or (hasattr(ig, "dtype") and ig.dtype == jax.dtypes.float0):
+                continue
+            _accum(grads, inp, ig)
+
+    for node in tape:
+        for inp in node.inputs:
+            _write_leaf(inp, grads)
+    for h in heads:
+        _write_leaf(h, grads)
+
+    if not retain_graph:
+        _tape.new_tape()
+
+
+def _accum(grads, arr: NDArray, g):
+    prev = grads.get(id(arr))
+    grads[id(arr)] = g if prev is None else prev + g
+
+
+def _write_leaf(arr: NDArray, grads):
+    if arr._grad_req == "null" or arr._grad is None:
+        return
+    g = grads.get(id(arr))
+    if g is None:
+        return
+    if arr._grad_req == "add":
+        arr._grad._data = arr._grad._data + g
+    else:
+        arr._grad._data = g
+    grads[id(arr)] = None  # write once
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode: bool = True):
+    """Compute and RETURN gradients of heads w.r.t. variables."""
+    if create_graph:
+        raise MXNetError("create_graph=True: use hybridize() + jax.grad composition "
+                         "for higher-order gradients (documented deviation)")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(v._grad, v._grad_req, v._in_graph) for v in variables]
+    for v in variables:
+        if not v._in_graph:
+            raise MXNetError("one of the variables was not marked with attach_grad()")
+        v._grad = NDArray(jnp.zeros_like(v._data))
+        v._grad_req = "write"
+    backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    out = [v._grad for v in variables]
+    for v, (g, req, ing) in zip(variables, saved):
+        v._grad, v._grad_req, v._in_graph = g, req, ing  # leave .grad untouched
+    return out
+
+
+class Function:
+    """Custom differentiable function (parity: mx.autograd.Function).
+
+    Subclass with ``forward``/``backward``; used via ``f = MyFunc(); y = f(x)``.
+    """
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import apply_op, raw
+
+        self_ref = self
+
+        prev = _tape.set_recording(False)  # forward's internal ops must not tape
+        try:
+            outputs = self.forward(*inputs)
+        finally:
+            _tape.set_recording(prev)
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+        if _tape.is_recording() and any(isinstance(i, NDArray) and i._in_graph for i in inputs):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                igs = self_ref.backward(*[NDArray(c) for c in cts])
+                if not isinstance(igs, (tuple, list)):
+                    igs = (igs,)
+                return tuple(raw(g) for g in igs)
+
+            wrapped = []
+            for o in outs:
+                nd = o if isinstance(o, NDArray) else NDArray(o)
+                nd._in_graph = True
+                wrapped.append(nd)
+            _tape.append_node(_tape.TapeNode(nd_inputs, wrapped, vjp_fn, len(wrapped)))
+            outs = wrapped
+        return outs[0] if single else tuple(outs)
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError

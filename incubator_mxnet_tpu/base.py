@@ -1,0 +1,100 @@
+"""Base utilities: errors, registry, env-var config.
+
+TPU-native re-design of the reference's `python/mxnet/base.py` +
+`dmlc-core` registry/parameter machinery (SURVEY.md §2.1 "dmlc-core",
+ref paths `3rdparty/dmlc-core/include/dmlc/registry.h`,
+`python/mxnet/base.py` [UNVERIFIED]).  There is no C library to load:
+the "backend" is JAX/XLA, so `check_call`/`_LIB` are replaced by plain
+Python exceptions, and the dmlc::Parameter system by typed dataclass
+validation in `utils.config`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "MXNetError",
+    "Registry",
+    "get_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+class Registry:
+    """A simple name->object registry with alias support.
+
+    Mirrors dmlc::Registry semantics: register under a canonical name,
+    optionally with aliases; lookup is case-insensitive for parity with
+    MXNet's optimizer/initializer registries.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._registry: Dict[str, Any] = {}
+
+    def register(self, obj: Any = None, name: Optional[str] = None, *aliases: str):
+        def _do(o):
+            key = (name or getattr(o, "__name__", str(o))).lower()
+            self._registry[key] = o
+            for a in aliases:
+                self._registry[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def alias(self, *aliases: str) -> Callable:
+        def _do(o):
+            self.register(o)
+            for a in aliases:
+                self._registry[a.lower()] = o
+            return o
+
+        return _do
+
+    def get(self, key: str) -> Any:
+        k = key.lower()
+        if k not in self._registry:
+            raise MXNetError(
+                f"{self.name} '{key}' is not registered. "
+                f"Known: {sorted(self._registry)}"
+            )
+        return self._registry[k]
+
+    def find(self, key: str) -> Optional[Any]:
+        return self._registry.get(key.lower())
+
+    def list(self):
+        return sorted(self._registry)
+
+    def create(self, key: str, *args, **kwargs) -> Any:
+        return self.get(key)(*args, **kwargs)
+
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def get_env(name: str, default=None, dtype=str):
+    """dmlc::GetEnv equivalent: typed environment variable lookup.
+
+    Env knobs keep the ``MXNET_*`` prefix where behavioral parity
+    matters (SURVEY.md §5.6).
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is bool:
+        return val.lower() in _TRUTHY
+    return dtype(val)

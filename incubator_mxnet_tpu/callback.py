@@ -1,0 +1,91 @@
+"""Training callbacks (ref `python/mxnet/callback.py` [UNVERIFIED],
+SURVEY.md §5.5): Speedometer samples/sec lines (the format
+`tools/parse_log.py` scrapes), checkpointing, log-validation."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
+           "LogValidationMetricsCallback", "module_checkpoint"]
+
+
+class Speedometer:
+    """Prints rolling samples/sec every `frequent` batches."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+        self.auto_reset = auto_reset
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset_local()
+                    msg = f"Epoch[{param.epoch}] Batch [{count}]\tSpeed: {speed:.2f} samples/sec"
+                    for name, value in name_value:
+                        msg += f"\t{name}={value:f}"
+                    logging.info(msg)
+                else:
+                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                                 param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end checkpoint callback (params + symbol json)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            from .utils import serialization
+
+            if sym is not None and hasattr(sym, "save"):
+                sym.save(f"{prefix}-symbol.json")
+            arrays = {}
+            for k, v in (arg or {}).items():
+                arrays[f"arg:{k}"] = v
+            for k, v in (aux or {}).items():
+                arrays[f"aux:{k}"] = v
+            serialization.save_ndarrays(f"{prefix}-{iter_no + 1:04d}.params", arrays)
+            logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, iter_no + 1)
+
+    return _callback
+
+
+module_checkpoint = do_checkpoint
+
+
+def log_train_metric(period, auto_reset=False):
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset_local()
+
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

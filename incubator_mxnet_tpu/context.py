@@ -1,0 +1,126 @@
+"""Device/context abstraction.
+
+Re-design of the reference Context (`python/mxnet/context.py`,
+`include/mxnet/base.h` [UNVERIFIED], SURVEY.md §2.6): ``mx.cpu()`` /
+``mx.gpu(i)`` / ``mx.tpu(i)`` map onto `jax.Device` objects.  TPU is the
+first-class accelerator; ``mx.gpu`` is kept as an API-compatibility
+alias that resolves to the platform accelerator (so reference scripts
+written against ``mx.gpu(0)`` run unmodified on a TPU chip).
+
+Unlike the reference there is no device-side stream/threading state
+here: XLA's async dispatch owns scheduling (SURVEY.md §1 key fact).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = [
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "cpu_pinned",
+    "current_context",
+    "num_gpus",
+    "num_tpus",
+]
+
+
+class Context:
+    """Device context. devtypeid mirrors the reference's enum and adds TPU."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+    devstr2type = {v: k for k, v in devtype2str.items()}
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in self.devstr2type:
+            raise ValueError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = device_id
+        self._old_ctx: Optional["Context"] = None
+
+    @property
+    def device_typeid(self) -> int:
+        return self.devstr2type[self.device_type]
+
+    def to_jax_device(self) -> Optional[jax.Device]:
+        """Resolve to a concrete jax.Device (None = let JAX place it)."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            try:
+                return jax.devices("cpu")[self.device_id]
+            except RuntimeError:
+                return None
+        # gpu/tpu both resolve to the default accelerator platform.
+        devs = jax.devices()
+        accel = [d for d in devs if d.platform != "cpu"] or devs
+        return accel[min(self.device_id, len(accel) - 1)]
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *args):
+        Context._default_ctx.value = self._old_ctx
+
+    def empty_cache(self):
+        """Parity with mx.Context.empty_cache — XLA owns pooling; no-op."""
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Compatibility alias: resolves to the platform accelerator (TPU)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_gpus() -> int:
+    """Number of accelerator chips visible (parity: mx.context.num_gpus)."""
+    return num_tpus()
+
+
+def num_tpus() -> int:
+    try:
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+    except RuntimeError:
+        return 0
+
+
+def current_context() -> Context:
+    if not hasattr(Context._default_ctx, "value"):
+        Context._default_ctx.value = Context("cpu", 0)
+    return Context._default_ctx.value

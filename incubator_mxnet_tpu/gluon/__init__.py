@@ -1,0 +1,15 @@
+"""`mx.gluon` — the user-facing imperative API (SURVEY.md §2.6)."""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict, DeferredInitializationError
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import contrib
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
+           "ParameterDict", "DeferredInitializationError", "Trainer", "nn",
+           "rnn", "loss", "data", "utils", "model_zoo", "contrib"]

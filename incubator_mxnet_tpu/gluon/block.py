@@ -1,0 +1,481 @@
+"""Gluon Block / HybridBlock — eager containers + the jit bridge.
+
+Re-design of `python/mxnet/gluon/block.py` + `src/imperative/cached_op.cc`
+[UNVERIFIED] (SURVEY.md §2.2 "CachedOp", §3.3): ``hybridize()`` does
+NOT build an NNVM symbol — it wraps the block's forward in `jax.jit`.
+The jitted program is parametric in (trainable params, aux state, RNG
+key, inputs); jit's shape-keyed executor cache IS CachedOp's
+per-shape cache ("the single most important equivalence in the whole
+build", SURVEY.md §3.3).  `static_alloc`/`static_shape` flags are
+accepted for parity and ignored: XLA is always static-shape +
+pre-planned memory.
+
+Backward through a hybridized block records ONE tape node whose vjp is
+`jax.vjp` of the whole jitted function (CachedOp::Backward).
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import _tape, autograd
+from .. import ndarray as nd_mod
+from .. import random as _random
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, raw, wrap
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope"]
+
+
+class _BlockScope(threading.local):
+    def __init__(self):
+        self._current: Optional["Block"] = None
+        self._counters: Dict[str, int] = {}
+
+
+_scope = _BlockScope()
+
+
+@contextlib.contextmanager
+def nn_block_scope(block: "Block"):
+    prev = _scope._current
+    _scope._current = block
+    try:
+        yield
+    finally:
+        _scope._current = prev
+
+
+def _make_prefix(hint: str) -> str:
+    cur = _scope._current
+    if cur is not None:
+        counters = cur._child_counters
+    else:
+        counters = _scope._counters
+    idx = counters.get(hint, 0)
+    counters[hint] = idx + 1
+    base = f"{hint}{idx}_"
+    if cur is not None:
+        return cur.prefix + base
+    return base
+
+
+class Block:
+    """Base eager container (ref gluon.Block).
+
+    Children are registered via attribute assignment; `collect_params`
+    walks the tree.  `__call__` → `forward`.
+    """
+
+    def __init__(self, prefix: Optional[str] = None, params: Optional[ParameterDict] = None):
+        hint = type(self).__name__.lower()
+        self._prefix = prefix if prefix is not None else _make_prefix(hint)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._child_counters: Dict[str, int] = {}
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    # -- attribute magic ------------------------------------------------ #
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            if not hasattr(self, "_children"):
+                raise RuntimeError("call super().__init__() before assigning child blocks")
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            if hasattr(self, "_params"):
+                self._params._params[value.name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self):
+        return nn_block_scope(self)
+
+    # -- parameter management ------------------------------------------- #
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            for name, p in self._params.items():
+                if pat.match(name):
+                    ret._params[name] = p
+        for child in self._children.values():
+            child_params = child.collect_params(select)
+            for name, p in child_params.items():
+                ret._params[name] = p
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+        return self
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for c in self._children.values():
+            c.cast(dtype)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    # -- (de)serialization ---------------------------------------------- #
+    def _collect_params_with_prefix(self, prefix: str = "") -> "OrderedDict[str, Parameter]":
+        """Structural names ('0.weight', 'encoder.layer1.bias') — the
+        .params key scheme of the reference save_parameters, stable
+        across instances regardless of global name counters."""
+        if prefix:
+            prefix += "."
+        ret: "OrderedDict[str, Parameter]" = OrderedDict()
+        for name, p in self._params.items():
+            ret[prefix + _strip_prefix(name, self._prefix)] = p
+        for key, child in self._children.items():
+            if isinstance(child, Block):
+                for k, p in child._collect_params_with_prefix(prefix + key).items():
+                    ret.setdefault(k, p)
+        return ret
+
+    def save_parameters(self, filename, deduplicate: bool = False):
+        from ..utils import serialization
+
+        params = self._collect_params_with_prefix()
+        arrays = {}
+        seen = {}
+        for name, p in params.items():
+            if p._data_nd is None:
+                continue
+            if deduplicate and id(p) in seen:
+                continue
+            seen[id(p)] = name
+            arrays[name] = p.data()
+        serialization.save_ndarrays(filename, arrays)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..utils import serialization
+
+        loaded = serialization.load_ndarrays(filename)
+        loaded = {k.removeprefix("arg:").removeprefix("aux:"): v for k, v in loaded.items()}
+        params = self._collect_params_with_prefix()
+        for key, arr in loaded.items():
+            if key in params:
+                params[key].set_data(arr)
+            elif not ignore_extra:
+                raise IOError(f"Parameter {key} loaded from file is not present in the Block")
+        if not allow_missing:
+            missing = [k for k in params if k not in loaded]
+            if missing:
+                raise IOError(f"Parameters missing in file: {sorted(missing)}")
+
+    # legacy aliases
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, **kwargs):
+        self.load_parameters(filename, ctx, **kwargs)
+
+    # -- hooks ----------------------------------------------------------- #
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    def apply(self, fn):
+        for c in self._children.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+    # -- execution ------------------------------------------------------- #
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (parity: Block.summary)."""
+        lines = []
+        seen = set()
+
+        def walk(block, indent=0):
+            n_params = 0
+            for p in block._params.values():
+                if id(p) not in seen and p._data_nd is not None:
+                    n_params += p.data().size
+                    seen.add(id(p))
+            lines.append("  " * indent + f"{type(block).__name__}({block.name}): {n_params} params")
+            for c in block._children.values():
+                walk(c, indent + 1)
+
+        walk(self)
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def __repr__(self):
+        s = f"{type(self).__name__}(\n"
+        for key, child in self._children.items():
+            s += f"  ({key}): {type(child).__name__}\n"
+        return s + ")"
+
+
+class HybridBlock(Block):
+    """Block that can be compiled: ``hybridize()`` → `jax.jit` cache."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._jit_kwargs: Dict[str, Any] = {}
+        self._cached_fn = None
+        self._cached_param_order: Optional[List[Parameter]] = None
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, **kwargs):
+        """Enable compiled execution (CachedOp ≡ jax.jit, SURVEY.md §3.3).
+
+        static_alloc/static_shape accepted for reference parity; XLA is
+        always static — they are no-ops.
+        """
+        self._active = active
+        self._cached_fn = None
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                c.hybridize(active, static_alloc=static_alloc, static_shape=static_shape, **kwargs)
+        return self
+
+    def infer_shape(self, *args):
+        """Run a shape-only forward to resolve deferred params."""
+        self._ensure_shapes(args)
+
+    def _ensure_shapes(self, args):
+        """Resolve deferred param shapes with ONE eager (concrete) forward.
+
+        Must run OUTSIDE any jax trace: initializers materialize real
+        arrays into Parameter state (a tracer there would leak).
+        """
+        need = [p for p in self.collect_params().values() if p._deferred_init is not None]
+        if not need:
+            return
+        rec = _tape.set_recording(False)
+        try:
+            self.forward(*[wrap(a) if isinstance(a, NDArray) or hasattr(a, "shape")
+                           else a for a in args])
+        finally:
+            _tape.set_recording(rec)
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    # -- the CachedOp equivalence ---------------------------------------- #
+    def _build_cache(self):
+        params = self.collect_params()
+        trainable = [p for p in params.values() if p.grad_req != "null" and p._data_nd is not None]
+        aux = [p for p in params.values() if p.grad_req == "null" and p._data_nd is not None]
+        self._cached_param_order = (trainable, aux)
+        outer = self
+
+        def raw_fn(training: bool, train_raws: Tuple, aux_raws: Tuple, rng_key, *input_raws):
+            t_saved = [p._data_nd._data for p in trainable]
+            a_saved = [p._data_nd._data for p in aux]
+            rec_saved = _tape.set_recording(False)
+            train_saved = _tape.set_training(training)
+            try:
+                for p, r in zip(trainable, train_raws):
+                    p._data_nd._data = r
+                for p, r in zip(aux, aux_raws):
+                    p._data_nd._data = r
+                with _random.TraceKeyProvider(rng_key):
+                    outs = outer.forward(*[wrap(i) for i in input_raws])
+                out_raws = jax.tree_util.tree_map(
+                    raw, outs, is_leaf=lambda v: isinstance(v, NDArray))
+                new_aux = tuple(p._data_nd._data for p in aux)
+                return out_raws, new_aux
+            finally:
+                for p, r in zip(trainable, t_saved):
+                    p._data_nd._data = r
+                for p, r in zip(aux, a_saved):
+                    p._data_nd._data = r
+                _tape.set_recording(rec_saved)
+                _tape.set_training(train_saved)
+
+        self._cached_fn = jax.jit(raw_fn, static_argnums=0)
+
+        def grad_fn(training, train_raws, aux_raws, rng, input_raws, cots):
+            def f(tr, ins):
+                out, _new_aux = raw_fn(training, tr, aux_raws, rng, *ins)
+                return out
+
+            _out, vjp = jax.vjp(f, tuple(train_raws), tuple(input_raws))
+            d_train, d_ins = vjp(cots)
+            return d_train, d_ins
+
+        # CachedOp::Backward equivalence: the backward graph is itself
+        # compiled once per shape (forward recomputed inside — full
+        # rematerialization, HBM-friendly and avoids cross-jit residuals)
+        self._cached_grad = jax.jit(grad_fn, static_argnums=0)
+
+    def _call_cached_op(self, *args):
+        if self._cached_fn is None:
+            self._ensure_shapes(args)
+            self._build_cache()
+        trainable, aux = self._cached_param_order
+        train_raws = tuple(p._data_nd._data for p in trainable)
+        aux_raws = tuple(p._data_nd._data for p in aux)
+        input_nds = [wrap(a) for a in args]
+        input_raws = [a._data for a in input_nds]
+        rng = _random.next_key()
+        training = _tape.is_training()
+        fn = self._cached_fn
+
+        recording = _tape.is_recording()
+        if not recording:
+            out_raws, new_aux = fn(training, train_raws, aux_raws, rng, *input_raws)
+            for p, r in zip(aux, new_aux):
+                p._data_nd._data = r
+            return jax.tree_util.tree_map(NDArray, out_raws)
+
+        # one tape node for the whole compiled program; backward runs the
+        # separately-jitted cached grad (no per-call retracing)
+        out_raws, new_aux = fn(training, train_raws, aux_raws, rng, *input_raws)
+        for p, r in zip(aux, new_aux):
+            p._data_nd._data = r
+        leaves, treedef = jax.tree_util.tree_flatten(out_raws)
+        out_nds = []
+        for o in leaves:
+            ndo = NDArray(o)
+            ndo._in_graph = True
+            out_nds.append(ndo)
+
+        tape_inputs = [p._data_nd for p in trainable] + input_nds
+        cached_grad = self._cached_grad
+
+        def node_vjp(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            cot_tree = jax.tree_util.tree_unflatten(treedef, list(cts))
+            d_train, d_ins = cached_grad(training, train_raws, aux_raws, rng,
+                                         tuple(input_raws), cot_tree)
+            return tuple(d_train) + tuple(d_ins)
+
+        _tape.append_node(_tape.TapeNode(tape_inputs, out_nds, node_vjp, len(out_nds)))
+        return jax.tree_util.tree_unflatten(treedef, out_nds)
+
+    # -- execution -------------------------------------------------------- #
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        if self._active and not kwargs:
+            out = self._call_cached_op(*args)
+        else:
+            out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        """Default: dispatch to `hybrid_forward(F, ...)` with params bound."""
+        if type(self).hybrid_forward is not HybridBlock.hybrid_forward:
+            self._resolve_deferred(args)
+            bound = {}
+            for name, p in self._params.items():
+                short = _strip_prefix(name, self._prefix)
+                bound[short] = p.data()
+            return self.hybrid_forward(nd_mod, *args, **bound, **kwargs)
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward or hybrid_forward")
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+    def _resolve_deferred(self, args):
+        """Layers override `_infer_param_shapes(x)` for deferred-init."""
+        pending = [p for p in self._params.values() if p._deferred_init is not None]
+        if not pending:
+            return
+        if args and isinstance(args[0], NDArray):
+            self._infer_param_shapes(*args)
+        for p in pending:
+            p._finish_deferred_init()
+
+    def _infer_param_shapes(self, *args):
+        pass
+
+    def export(self, path: str, epoch: int = 0):
+        """Save symbol JSON + params pair (parity: HybridBlock.export)."""
+        from .. import symbol as sym_mod
+        from ..utils import serialization
+
+        sym_json = sym_mod.block_to_symbol_json(self)
+        with open(f"{path}-symbol.json", "w") as f:
+            f.write(sym_json)
+        params = self.collect_params()
+        arrays = {f"arg:{_strip_prefix(n, self._prefix)}": p.data()
+                  for n, p in params.items() if p._data_nd is not None}
+        serialization.save_ndarrays(f"{path}-{epoch:04d}.params", arrays)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+
+class SymbolBlock(HybridBlock):
+    """Run a saved symbol graph as a Block (inference import path)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+
+        sym = sym_mod.load(symbol_file)
+        block = SymbolBlock(sym, input_names)
+        if param_file:
+            from ..utils import serialization
+
+            loaded = serialization.load_ndarrays(param_file)
+            for k, v in loaded.items():
+                key = k.removeprefix("arg:").removeprefix("aux:")
+                p = Parameter(key, shape=v.shape)
+                p.set_data(v)
+                block._params._params[key] = p
+        return block
+
+    def forward(self, *args):
+        from .. import symbol as sym_mod
+
+        bindings = {name: wrap(a) for name, a in zip(
+            self._inputs if isinstance(self._inputs, (list, tuple)) else [self._inputs], args)}
+        for name, p in self._params.items():
+            bindings[name] = p.data()
+        return sym_mod.evaluate(self._outputs, bindings)
+
+
+def _strip_prefix(name: str, prefix: str) -> str:
+    return name[len(prefix):] if prefix and name.startswith(prefix) else name

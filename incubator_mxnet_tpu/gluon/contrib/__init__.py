@@ -1,0 +1,6 @@
+"""`gluon.contrib` (ref python/mxnet/gluon/contrib/ [UNVERIFIED]):
+SyncBatchNorm, SparseEmbedding idiom, estimator."""
+from . import nn
+from .estimator import Estimator
+
+__all__ = ["nn", "Estimator"]

@@ -1,0 +1,44 @@
+"""Minimal Estimator (ref gluon/contrib/estimator [UNVERIFIED]):
+fit/evaluate loops over DataLoaders with metrics + event handlers."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import autograd, metric as metric_mod
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [metric_mod.Accuracy()]
+        self.trainer = trainer
+
+    def evaluate(self, val_data, batch_axis=0):
+        for m in self.train_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            out = self.net(data)
+            for m in self.train_metrics:
+                m.update([label], [out])
+        return {m.get()[0]: m.get()[1] for m in self.train_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=1, batch_axis=0):
+        history = []
+        for epoch in range(epochs):
+            for m in self.train_metrics:
+                m.reset()
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                with autograd.record():
+                    out = self.net(data)
+                    l = self.loss(out, label)
+                l.backward()
+                self.trainer.step(data.shape[batch_axis])
+                for m in self.train_metrics:
+                    m.update([label], [out])
+            history.append({m.get()[0]: m.get()[1] for m in self.train_metrics})
+        return history

@@ -1,0 +1,55 @@
+"""Contrib layers.
+
+SyncBatchNorm: in the reference this cross-GPU-synchronizes batch
+statistics via extra NCCL comms (`gluon/contrib/nn/basic_layers.py`
+[UNVERIFIED]).  In SPMD, a BatchNorm computed inside a jitted step over
+a batch-sharded array already reduces statistics globally (XLA inserts
+the psum) — so SyncBatchNorm IS BatchNorm here; the class exists for
+API parity and documents the equivalence.
+"""
+from __future__ import annotations
+
+from .. import nn as _nn
+from ..block import HybridBlock
+from ...ndarray.ndarray import wrap
+from ... import ndarray as nd
+
+__all__ = ["SyncBatchNorm", "SparseEmbedding", "HybridConcurrent", "Concurrent",
+           "Identity"]
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+
+
+class SparseEmbedding(_nn.Embedding):
+    """The reference's row_sparse-grad embedding; on TPU the dense
+    gather/scatter Embedding is the idiom (SURVEY.md §8) — alias."""
+
+
+class Concurrent(_nn.Sequential):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [child(x) for child in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(_nn.HybridSequential):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [child(x) for child in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return wrap(x)

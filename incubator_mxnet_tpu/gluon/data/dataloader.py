@@ -1,0 +1,89 @@
+"""DataLoader (ref `python/mxnet/gluon/data/dataloader.py` [UNVERIFIED],
+SURVEY.md §2.5): batchify + optional thread workers.
+
+The reference forks worker PROCESSES and rebuilds NDArrays in shared
+memory; with JAX a forked child cannot touch the accelerator runtime,
+so parallel fetch uses a thread pool (decode/augment are
+numpy/PIL — GIL-releasing) and the final device_put happens on the main
+thread.  `num_workers` keeps its meaning as fetch parallelism.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (NDArray out)."""
+    if isinstance(data[0], NDArray):
+        return NDArray(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(items)) for items in zip(*data))
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return NDArray(jnp.asarray(arr))
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn: Optional[Callable] = None, num_workers=0,
+                 pin_memory=False, prefetch=None, thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or last_batch:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            return
+
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            batches = list(self._batch_sampler)
+            futures = []
+            it = iter(batches)
+
+            def fetch(idxs):
+                return self._batchify_fn([self._dataset[i] for i in idxs])
+
+            # keep `prefetch` batches in flight
+            for _ in range(min(self._prefetch + 1, len(batches))):
+                futures.append(pool.submit(fetch, next(it)))
+            sent = len(futures)
+            for i in range(len(batches)):
+                batch = futures[i].result()
+                if sent < len(batches):
+                    futures.append(pool.submit(fetch, next(it)))
+                    sent += 1
+                yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)

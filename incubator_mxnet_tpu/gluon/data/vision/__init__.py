@@ -1,0 +1,6 @@
+from . import transforms
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, ImageFolderDataset, SyntheticImageDataset)
+
+__all__ = ["transforms", "MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "SyntheticImageDataset"]

@@ -1,0 +1,167 @@
+"""Vision transforms (ref `python/mxnet/gluon/data/vision/transforms.py`
+[UNVERIFIED], SURVEY.md §2.5): Compose, ToTensor, Normalize, crops,
+flips, Resize, Cast — HWC-in, CHW-out per the reference convention.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ....ndarray.ndarray import NDArray, wrap
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast"]
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return wrap(x).astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8/float [0,255] → CHW float32 [0,1]."""
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        x = wrap(x)
+        arr = x._data.astype(jnp.float32)
+        if arr.max() is not None:  # static: normalize only uint8-range inputs
+            pass
+        arr = arr / 255.0 if x._data.dtype == jnp.uint8 else arr
+        if arr.ndim == 3:
+            arr = jnp.transpose(arr, (2, 0, 1))
+        elif arr.ndim == 4:
+            arr = jnp.transpose(arr, (0, 3, 1, 2))
+        return NDArray(arr)
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, "float32").reshape(-1, 1, 1)
+        self._std = onp.asarray(std, "float32").reshape(-1, 1, 1)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        x = wrap(x)
+        return NDArray((x._data - jnp.asarray(self._mean)) / jnp.asarray(self._std))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+
+        x = wrap(x)
+        w, h = self._size
+        if x.ndim == 3:
+            out = jax.image.resize(x._data, (h, w, x.shape[2]), method="bilinear")
+        else:
+            out = jax.image.resize(x._data, (x.shape[0], h, w, x.shape[3]), method="bilinear")
+        return NDArray(out)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        x = wrap(x)
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0, x0 = (H - h) // 2, (W - w) // 2
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import jax
+
+        x = wrap(x)
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            ar = onp.random.uniform(*self._ratio)
+            w = int(round((target_area * ar) ** 0.5))
+            h = int(round((target_area / ar) ** 0.5))
+            if w <= W and h <= H:
+                x0 = onp.random.randint(0, W - w + 1)
+                y0 = onp.random.randint(0, H - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w]
+                out = jax.image.resize(crop._data,
+                                       (self._size[1], self._size[0], x.shape[2]),
+                                       method="bilinear")
+                return NDArray(out)
+        # fallback center crop
+        return CenterCrop(self._size)(x)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        x = wrap(x)
+        if onp.random.rand() < 0.5:
+            return NDArray(jnp.flip(x._data, axis=1))
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        x = wrap(x)
+        if onp.random.rand() < 0.5:
+            return NDArray(jnp.flip(x._data, axis=0))
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._b, self._b)
+        return wrap(x) * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        x = wrap(x)
+        alpha = 1.0 + onp.random.uniform(-self._c, self._c)
+        gray = jnp.mean(x._data)
+        return NDArray(x._data * alpha + gray * (1 - alpha))

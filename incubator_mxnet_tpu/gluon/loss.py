@@ -1,0 +1,318 @@
+"""Gluon losses (ref `python/mxnet/gluon/loss.py` [UNVERIFIED],
+SURVEY.md §2.6): SoftmaxCE, L1/L2, SigmoidBCE, KLDiv, CTC, Huber,
+Hinge/SquaredHinge, Logistic, Triplet, PoissonNLL, CosineEmbedding.
+All are HybridBlocks over jnp math; CTC uses optax's TPU-friendly
+log-space implementation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..ndarray.ndarray import NDArray, apply_op, raw, wrap
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * raw(wrap(sample_weight))
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    return label.reshape(pred.shape) if pred.shape != label.shape else label
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean_all_but_batch(self, x):
+        axes = tuple(i for i in range(x.ndim) if i != self._batch_axis)
+        return jnp.mean(x, axis=axes) if axes else x
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            loss = jnp.square(_reshape_like(p, l) - p)
+            loss = _apply_weighting(loss, self._weight / 2, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            loss = jnp.abs(_reshape_like(p, l) - p)
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        def f(p, l, *rest):
+            l = _reshape_like(p, l)
+            if not self._from_sigmoid:
+                # numerically-stable log-sum-exp formulation
+                loss = jax.nn.relu(p) - p * l + jax.nn.softplus(-jnp.abs(p))
+            else:
+                eps = 1e-12
+                loss = -(l * jnp.log(p + eps) + (1 - l) * jnp.log(1 - p + eps))
+            loss = _apply_weighting(loss, self._weight, rest[0] if rest else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False, weight=None,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            logp = p if self._from_logits else jax.nn.log_softmax(p, axis=self._axis)
+            if self._sparse_label:
+                li = l.astype(jnp.int32)
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(li, self._axis),
+                                            axis=self._axis)
+                loss = jnp.squeeze(loss, axis=self._axis)
+            else:
+                loss = -jnp.sum(logp * _reshape_like(logp, l), axis=self._axis)
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            logp = p if self._from_logits else jax.nn.log_softmax(p, axis=self._axis)
+            loss = l * (jnp.log(jnp.maximum(l, 1e-12)) - logp)
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification via optax.ctc_loss.
+
+    Layout parity with the reference (`layout='NTC'`, blank=last or first
+    via `blank_label`).  ref: src/operator/contrib/ctc_loss.cc.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import optax
+
+        def f(p, l, *rest):
+            if self._layout == "TNC":
+                p = jnp.swapaxes(p, 0, 1)
+            if self._label_layout == "TN":
+                l = jnp.swapaxes(l, 0, 1)
+            B, T, C = p.shape
+            logits = jnp.concatenate([p[..., -1:], p[..., :-1]], axis=-1)  # optax blank=0; ref blank=last
+            labels = (l + 1).astype(jnp.int32)  # shift for blank=0
+            i = 0
+            plen = rest[i] if pred_lengths is not None else None
+            if pred_lengths is not None:
+                i += 1
+            llen = rest[i] if label_lengths is not None else None
+            if label_lengths is not None:
+                i += 1
+            logit_pad = jnp.zeros((B, T))
+            if plen is not None:
+                logit_pad = (jnp.arange(T)[None, :] >= plen[:, None]).astype(jnp.float32)
+            label_pad = jnp.zeros(l.shape)
+            if llen is not None:
+                label_pad = (jnp.arange(l.shape[1])[None, :] >= llen[:, None]).astype(jnp.float32)
+            else:
+                label_pad = (l < 0).astype(jnp.float32)
+            loss = optax.ctc_loss(logits, logit_pad, labels, label_pad)
+            sw = rest[i] if sample_weight is not None else None
+            return _apply_weighting(loss, self._weight, sw)
+
+        args = [wrap(pred), wrap(label)]
+        if pred_lengths is not None:
+            args.append(wrap(pred_lengths))
+        if label_lengths is not None:
+            args.append(wrap(label_lengths))
+        if sample_weight is not None:
+            args.append(wrap(sample_weight))
+        return apply_op(f, *args)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            d = jnp.abs(_reshape_like(p, l) - p)
+            loss = jnp.where(d > self._rho, d - 0.5 * self._rho,
+                             (0.5 / self._rho) * jnp.square(d))
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            loss = jax.nn.relu(self._margin - p * _reshape_like(p, l))
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            loss = jnp.square(jax.nn.relu(self._margin - p * _reshape_like(p, l)))
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        def f(p, l, *sw):
+            l = _reshape_like(p, l)
+            if self._label_format == "signed":
+                l = (l + 1.0) / 2.0
+            loss = jax.nn.relu(p) - p * l + jax.nn.softplus(-jnp.abs(p))
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return self._mean_all_but_batch(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        def f(p, pos, neg, *sw):
+            loss = jnp.sum(jnp.square(p - pos) - jnp.square(p - neg),
+                           axis=tuple(range(1, p.ndim)))
+            loss = jax.nn.relu(loss + self._margin)
+            return _apply_weighting(loss, self._weight, sw[0] if sw else None)
+
+        args = (pred, positive, negative) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        def f(p, l, *sw):
+            l = _reshape_like(p, l)
+            if self._from_logits:
+                loss = jnp.exp(p) - l * p
+            else:
+                loss = p - l * jnp.log(p + epsilon)
+            if self._compute_full:
+                stirling = l * jnp.log(jnp.maximum(l, 1.0)) - l + \
+                    0.5 * jnp.log(2 * jnp.pi * jnp.maximum(l, 1.0))
+                loss = loss + jnp.where(l > 1, stirling, 0.0)
+            loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
+            return jnp.mean(loss)
+
+        args = (pred, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        def f(x1, x2, l, *sw):
+            x1f = x1.reshape(x1.shape[0], -1)
+            x2f = x2.reshape(x2.shape[0], -1)
+            cos = jnp.sum(x1f * x2f, axis=1) / (
+                jnp.linalg.norm(x1f, axis=1) * jnp.linalg.norm(x2f, axis=1) + 1e-12)
+            lr = l.reshape(-1)
+            loss = jnp.where(lr == 1, 1 - cos, jax.nn.relu(cos - self._margin))
+            return _apply_weighting(loss, self._weight, sw[0] if sw else None)
+
+        args = (input1, input2, label) + ((sample_weight,) if sample_weight is not None else ())
+        return apply_op(f, *[wrap(a) for a in args])

@@ -1,0 +1,3 @@
+from . import vision
+
+__all__ = ["vision"]

@@ -1,0 +1,40 @@
+"""Model zoo — vision (ref `python/mxnet/gluon/model_zoo/vision/`
+[UNVERIFIED], SURVEY.md §2.6): resnet v1/v2, vgg, alexnet, squeezenet,
+densenet, mobilenet v1/v2, lenet.  `get_model(name)` factory parity.
+Pretrained weights cannot be downloaded in this environment
+(`pretrained=True` raises with guidance); architectures are full."""
+from .lenet import LeNet
+from .alexnet import AlexNet, alexnet
+from .resnet import (ResNetV1, ResNetV2, resnet18_v1, resnet34_v1, resnet50_v1,
+                     resnet101_v1, resnet152_v1, resnet18_v2, resnet34_v2,
+                     resnet50_v2, resnet101_v2, resnet152_v2, get_resnet)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, get_vgg
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet201
+from .mobilenet import (MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_75,
+                        mobilenet0_5, mobilenet0_25, mobilenet_v2_1_0)
+
+_models = {
+    "lenet": LeNet,
+    "alexnet": alexnet,
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+    "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+    "resnet152_v2": resnet152_v2,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0,
+}
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(f"Model {name} is not supported. Available: {sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,34 @@
+"""AlexNet (ref model_zoo/vision/alexnet.py [UNVERIFIED])."""
+from ....base import MXNetError
+from ...nn import basic_layers as nn
+from ...nn import conv_layers as conv
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(nn.HybridSequential):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.add(
+            conv.Conv2D(64, kernel_size=11, strides=4, padding=2, activation="relu"),
+            conv.MaxPool2D(pool_size=3, strides=2),
+            conv.Conv2D(192, kernel_size=5, padding=2, activation="relu"),
+            conv.MaxPool2D(pool_size=3, strides=2),
+            conv.Conv2D(384, kernel_size=3, padding=1, activation="relu"),
+            conv.Conv2D(256, kernel_size=3, padding=1, activation="relu"),
+            conv.Conv2D(256, kernel_size=3, padding=1, activation="relu"),
+            conv.MaxPool2D(pool_size=3, strides=2),
+            nn.Flatten(),
+            nn.Dense(4096, activation="relu"),
+            nn.Dropout(0.5),
+            nn.Dense(4096, activation="relu"),
+            nn.Dropout(0.5),
+            nn.Dense(classes),
+        )
+
+
+def alexnet(pretrained=False, ctx=None, classes=1000, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network egress); "
+                         "load a local .params file via load_parameters")
+    return AlexNet(classes=classes, **kwargs)

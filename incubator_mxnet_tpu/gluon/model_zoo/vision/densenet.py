@@ -1,0 +1,100 @@
+"""DenseNet 121/161/169/201 (ref model_zoo/vision/densenet.py [UNVERIFIED])."""
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...nn import basic_layers as nn
+from ...nn import conv_layers as conv
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
+
+
+class _DenseBlock(HybridBlock):
+    def __init__(self, num_layers, bn_size, growth_rate, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.layers = []
+        for i in range(num_layers):
+            layer = nn.HybridSequential()
+            layer.add(nn.BatchNorm())
+            layer.add(nn.Activation("relu"))
+            layer.add(conv.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False))
+            layer.add(nn.BatchNorm())
+            layer.add(nn.Activation("relu"))
+            layer.add(conv.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
+            if dropout:
+                layer.add(nn.Dropout(dropout))
+            setattr(self, f"layer{i}", layer)
+            self.layers.append(layer)
+
+    def forward(self, x):
+        from .... import ndarray as nd
+
+        for layer in self.layers:
+            out = layer(x)
+            x = nd.concat(x, out, dim=1)
+        return x
+
+
+def _transition(num_output_features):
+    out = nn.HybridSequential()
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    out.add(conv.Conv2D(num_output_features, kernel_size=1, use_bias=False))
+    out.add(conv.AvgPool2D(pool_size=2, strides=2))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(conv.Conv2D(num_init_features, kernel_size=7,
+                                      strides=2, padding=3, use_bias=False))
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(conv.MaxPool2D(pool_size=3, strides=2, padding=1))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            self.features.add(_DenseBlock(num_layers, bn_size, growth_rate, dropout))
+            num_features = num_features + num_layers * growth_rate
+            if i != len(block_config) - 1:
+                num_features //= 2
+                self.features.add(_transition(num_features))
+        self.features.add(nn.BatchNorm())
+        self.features.add(nn.Activation("relu"))
+        self.features.add(conv.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+densenet_spec = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+def _get(num_layers, pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network egress)")
+    ninit, growth, cfg = densenet_spec[num_layers]
+    return DenseNet(ninit, growth, cfg, **kwargs)
+
+
+def densenet121(**kw):
+    return _get(121, **kw)
+
+
+def densenet161(**kw):
+    return _get(161, **kw)
+
+
+def densenet169(**kw):
+    return _get(169, **kw)
+
+
+def densenet201(**kw):
+    return _get(201, **kw)

@@ -1,0 +1,17 @@
+"""LeNet-5 (the `example/gluon/mnist` model, BASELINE config #1)."""
+from ...nn import basic_layers as nn
+from ...nn import conv_layers as conv
+
+
+class LeNet(nn.HybridSequential):
+    def __init__(self, classes=10, **kwargs):
+        super().__init__(**kwargs)
+        self.add(
+            conv.Conv2D(20, kernel_size=5, activation="relu"),
+            conv.MaxPool2D(pool_size=2, strides=2),
+            conv.Conv2D(50, kernel_size=5, activation="relu"),
+            conv.MaxPool2D(pool_size=2, strides=2),
+            nn.Flatten(),
+            nn.Dense(500, activation="relu"),
+            nn.Dense(classes),
+        )

@@ -1,0 +1,125 @@
+"""MobileNet v1 / v2 (ref model_zoo/vision/mobilenet.py [UNVERIFIED]).
+
+Depthwise convs map to feature_group_count convolutions — XLA:TPU
+lowers these efficiently without im2col.
+"""
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...nn import basic_layers as nn
+from ...nn import conv_layers as conv
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0"]
+
+
+def _add_conv(out, channels, kernel=1, stride=1, pad=0, num_group=1, active=True,
+              relu6=False):
+    out.add(conv.Conv2D(channels, kernel, stride, pad, groups=num_group, use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        out.add(nn.Activation("relu") if not relu6 else _ReLU6())
+
+
+class _ReLU6(HybridBlock):
+    def forward(self, x):
+        from .... import ndarray as nd
+        from ....ndarray.ndarray import wrap
+
+        return nd.clip(wrap(x), 0.0, 6.0)
+
+
+def _dw_sep(out, dw_channels, channels, stride, relu6=False):
+    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
+              num_group=dw_channels, relu6=relu6)
+    _add_conv(out, channels, relu6=relu6)
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2, pad=1)
+        dw_channels = [int(x * multiplier) for x in
+                       [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+        channels = [int(x * multiplier) for x in
+                    [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+        strides = [1, 2, 1, 2, 1, 2] + [1] * 5 + [2, 1]
+        for dwc, c, s in zip(dw_channels, channels, strides):
+            _dw_sep(self.features, dwc, c, s)
+        self.features.add(conv.GlobalAvgPool2D())
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _LinearBottleneck(HybridBlock):
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        self.out = nn.HybridSequential()
+        _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
+                  num_group=in_channels * t, relu6=True)
+        _add_conv(self.out, channels, active=False)
+
+    def forward(self, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            from ....ndarray.ndarray import wrap
+
+            out = out + wrap(x)
+        return out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        _add_conv(self.features, int(32 * multiplier), kernel=3, stride=2, pad=1, relu6=True)
+        in_channels_group = [int(x * multiplier) for x in
+                             [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3]
+        channels_group = [int(x * multiplier) for x in
+                          [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3 + [320]]
+        ts = [1] + [6] * 16
+        strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
+        for in_c, c, t, s in zip(in_channels_group, channels_group, ts, strides):
+            self.features.add(_LinearBottleneck(in_c, c, t, s))
+        last = int(1280 * multiplier) if multiplier > 1.0 else 1280
+        _add_conv(self.features, last, relu6=True)
+        self.features.add(conv.GlobalAvgPool2D())
+        self.output = nn.HybridSequential()
+        self.output.add(conv.Conv2D(classes, 1, use_bias=False))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _get(mult, pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network egress)")
+    return MobileNet(mult, **kwargs)
+
+
+def mobilenet1_0(**kw):
+    return _get(1.0, **kw)
+
+
+def mobilenet0_75(**kw):
+    return _get(0.75, **kw)
+
+
+def mobilenet0_5(**kw):
+    return _get(0.5, **kw)
+
+
+def mobilenet0_25(**kw):
+    return _get(0.25, **kw)
+
+
+def mobilenet_v2_1_0(pretrained=False, **kw):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network egress)")
+    return MobileNetV2(1.0, **kw)

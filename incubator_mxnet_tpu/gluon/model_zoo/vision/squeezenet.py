@@ -1,0 +1,74 @@
+"""SqueezeNet 1.0/1.1 (ref model_zoo/vision/squeezenet.py [UNVERIFIED])."""
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...nn import basic_layers as nn
+from ...nn import conv_layers as conv
+from ..vision_helpers import HybridConcat
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+def _fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
+    out = nn.HybridSequential()
+    out.add(conv.Conv2D(squeeze_channels, kernel_size=1, activation="relu"))
+    paths = HybridConcat(axis=1)
+    p1 = nn.HybridSequential()
+    p1.add(conv.Conv2D(expand1x1_channels, kernel_size=1, activation="relu"))
+    p3 = nn.HybridSequential()
+    p3.add(conv.Conv2D(expand3x3_channels, kernel_size=3, padding=1, activation="relu"))
+    paths.add(p1, p3)
+    out.add(paths)
+    return out
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        assert version in ("1.0", "1.1")
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(conv.Conv2D(96, kernel_size=7, strides=2, activation="relu"))
+            self.features.add(conv.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
+            self.features.add(_fire(16, 64, 64))
+            self.features.add(_fire(16, 64, 64))
+            self.features.add(_fire(32, 128, 128))
+            self.features.add(conv.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
+            self.features.add(_fire(32, 128, 128))
+            self.features.add(_fire(48, 192, 192))
+            self.features.add(_fire(48, 192, 192))
+            self.features.add(_fire(64, 256, 256))
+            self.features.add(conv.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
+            self.features.add(_fire(64, 256, 256))
+        else:
+            self.features.add(conv.Conv2D(64, kernel_size=3, strides=2, activation="relu"))
+            self.features.add(conv.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
+            self.features.add(_fire(16, 64, 64))
+            self.features.add(_fire(16, 64, 64))
+            self.features.add(conv.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
+            self.features.add(_fire(32, 128, 128))
+            self.features.add(_fire(32, 128, 128))
+            self.features.add(conv.MaxPool2D(pool_size=3, strides=2, ceil_mode=True))
+            self.features.add(_fire(48, 192, 192))
+            self.features.add(_fire(48, 192, 192))
+            self.features.add(_fire(64, 256, 256))
+            self.features.add(_fire(64, 256, 256))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential()
+        self.output.add(conv.Conv2D(classes, kernel_size=1, activation="relu"))
+        self.output.add(conv.GlobalAvgPool2D())
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network egress)")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network egress)")
+    return SqueezeNet("1.1", **kwargs)

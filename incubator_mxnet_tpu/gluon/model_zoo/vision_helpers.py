@@ -1,0 +1,18 @@
+"""Shared helpers for zoo models."""
+from ...ndarray.ndarray import wrap
+from ... import ndarray as _  # noqa: F401
+from ..nn.basic_layers import HybridSequential
+
+
+class HybridConcat(HybridSequential):
+    """Run children on the same input, concat outputs on `axis`."""
+
+    def __init__(self, axis=1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+
+        outs = [child(x) for child in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)

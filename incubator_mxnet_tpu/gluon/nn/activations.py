@@ -1,0 +1,76 @@
+"""Gluon activation blocks (ref `python/mxnet/gluon/nn/activations.py`
+[UNVERIFIED], SURVEY.md §2.6)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import wrap
+from ..block import HybridBlock
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish",
+           "SiLU"]
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._act_type = activation
+
+    def forward(self, x):
+        return nd.Activation(wrap(x), act_type=self._act_type)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.LeakyReLU(wrap(x), act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, prefix=None, params=None):
+        from ... import initializer
+
+        super().__init__(prefix, params)
+        self.alpha = self.params.get("alpha", shape=(in_channels,),
+                                     init=alpha_initializer or initializer.Constant(0.25))
+
+    def forward(self, x):
+        return nd.LeakyReLU(wrap(x), gamma=self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return nd.LeakyReLU(wrap(x), act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return nd.LeakyReLU(wrap(x), act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._approx = approximation != "erf"
+
+    def forward(self, x):
+        return nd.gelu(wrap(x), approximate=self._approx)
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._beta = beta
+
+    def forward(self, x):
+        x = wrap(x)
+        return x * nd.sigmoid(x * self._beta)
+
+
+SiLU = Swish

@@ -1,0 +1,315 @@
+"""Gluon basic layers.
+
+Re-design of `python/mxnet/gluon/nn/basic_layers.py` [UNVERIFIED]
+(SURVEY.md §2.6 "Gluon layers"): Dense, Dropout, BatchNorm, LayerNorm,
+GroupNorm, InstanceNorm, Embedding, Flatten, Lambda/HybridLambda,
+Sequential/HybridSequential.  Compute goes through `ndarray.nn_ops`
+(XLA MXU/VPU); BatchNorm running stats are aux Parameters updated
+functionally (eager rebind / cached-op state channel).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ... import _tape
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray, wrap
+from ..block import Block, HybridBlock
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "LayerNorm", "GroupNorm", "InstanceNorm", "Embedding", "Flatten",
+           "Lambda", "HybridLambda", "Identity"]
+
+
+class Sequential(Block):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._children[str(len(self._children))] = b
+        return self
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            net = type(self)()
+            for b in list(self._children.values())[i]:
+                net.add(b)
+            return net
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                c.hybridize(active, **kwargs)
+        return self
+
+
+class HybridSequential(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._children[str(len(self._children))] = b
+        return self
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            net = type(self)()
+            for b in list(self._children.values())[i]:
+                net.add(b)
+            return net
+        return list(self._children.values())[i]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """y = act(x·Wᵀ + b) (ref: gluon.nn.Dense over FullyConnected op)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = self.params.get("weight", shape=(units, in_units), dtype=dtype,
+                                      init=weight_initializer, allow_deferred_init=True)
+        self.bias = self.params.get("bias", shape=(units,), dtype=dtype,
+                                    init=bias_initializer) if use_bias else None
+
+    def _infer_param_shapes(self, x):
+        if self.weight.shape[1] == 0:
+            import math
+
+            in_units = math.prod(x.shape[1:]) if self._flatten else x.shape[-1]
+            self.weight.shape = (self._units, in_units)
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        out = nd.FullyConnected(x, self.weight.data(),
+                                None if self.bias is None else self.bias.data(),
+                                num_hidden=self._units, flatten=self._flatten,
+                                no_bias=self.bias is None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return nd.Dropout(wrap(x), p=self._rate, axes=self._axes,
+                          training=_tape.is_training())
+
+
+class BatchNorm(HybridBlock):
+    """ref: gluon.nn.BatchNorm over the BatchNorm op; running stats are
+    aux params (grad_req='null') flowing through the cached-op state
+    channel under hybridize."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = self.params.get("gamma", shape=(in_channels,), init=gamma_initializer,
+                                     allow_deferred_init=True,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,), init=beta_initializer,
+                                    allow_deferred_init=True,
+                                    grad_req="write" if center else "null")
+        self.running_mean = self.params.get("running_mean", shape=(in_channels,),
+                                            init=running_mean_initializer,
+                                            allow_deferred_init=True, grad_req="null")
+        self.running_var = self.params.get("running_var", shape=(in_channels,),
+                                           init=running_variance_initializer,
+                                           allow_deferred_init=True, grad_req="null")
+
+    def _infer_param_shapes(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if p.shape[0] == 0:
+                p.shape = (c,)
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        out, new_mean, new_var = nd.BatchNorm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum, axis=self._axis,
+            use_global_stats=self._use_global_stats, training=_tape.is_training())
+        if _tape.is_training() and not self._use_global_stats:
+            self.running_mean.data()._data = new_mean._data
+            self.running_var.data()._data = new_var._data
+        return out
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,), init=gamma_initializer,
+                                     allow_deferred_init=True,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,), init=beta_initializer,
+                                    allow_deferred_init=True,
+                                    grad_req="write" if center else "null")
+
+    def _infer_param_shapes(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if p.shape[0] == 0:
+                p.shape = (c,)
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        return nd.LayerNorm(x, self.gamma.data(), self.beta.data(),
+                            axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,), init=gamma_initializer,
+                                     allow_deferred_init=True,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,), init=beta_initializer,
+                                    allow_deferred_init=True,
+                                    grad_req="write" if center else "null")
+
+    def _infer_param_shapes(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p.shape[0] == 0:
+                p.shape = (c,)
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        return nd.GroupNorm(x, self.gamma.data(), self.beta.data(),
+                            num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._epsilon = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,), init=gamma_initializer,
+                                     allow_deferred_init=True,
+                                     grad_req="write" if scale else "null")
+        self.beta = self.params.get("beta", shape=(in_channels,), init=beta_initializer,
+                                    allow_deferred_init=True,
+                                    grad_req="write" if center else "null")
+
+    def _infer_param_shapes(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p.shape[0] == 0:
+                p.shape = (c,)
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        return nd.InstanceNorm(x, self.gamma.data(), self.beta.data(), eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Gather-based embedding (the TPU idiom replacing row_sparse)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
+                                      dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return nd.Embedding(wrap(x), self.weight.data(),
+                            input_dim=self._input_dim, output_dim=self._output_dim)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return nd.flatten(wrap(x))
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return wrap(x)
+
+
+class Lambda(Block):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix)
+        if isinstance(function, str):
+            self._func = getattr(nd, function)
+            self._func_name = function
+        else:
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix)
+        if isinstance(function, str):
+            self._func = getattr(nd, function)
+            self._func_name = function
+        else:
+            self._func = function
+            self._func_name = getattr(function, "__name__", "lambda")
+
+    def forward(self, *args):
+        return self._func(*args)

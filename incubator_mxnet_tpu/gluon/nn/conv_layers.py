@@ -1,0 +1,287 @@
+"""Gluon convolution / pooling layers.
+
+Re-design of `python/mxnet/gluon/nn/conv_layers.py` [UNVERIFIED]
+(SURVEY.md §2.6): Conv1D/2D/3D(+Transpose), Max/Avg/GlobalPool in NCHW
+family layouts, lowering to `lax.conv_general_dilated` /
+`lax.reduce_window` (MXU-tiled by XLA:TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import wrap
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", ndim=2,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tuple(kernel_size, ndim)
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._activation = activation
+        self._ndim = ndim
+        wshape = (channels, in_channels // groups if in_channels else 0) + self._kernel
+        self.weight = self.params.get("weight", shape=wshape,
+                                      init=weight_initializer, allow_deferred_init=True)
+        self.bias = self.params.get("bias", shape=(channels,), init=bias_initializer) \
+            if use_bias else None
+
+    def _infer_param_shapes(self, x):
+        if self.weight.shape[1] == 0:
+            cin = x.shape[1]
+            self.weight.shape = (self._channels, cin // self._groups) + self._kernel
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        out = nd.Convolution(x, self.weight.data(),
+                             None if self.bias is None else self.bias.data(),
+                             kernel=self._kernel, stride=self._strides,
+                             dilate=self._dilation, pad=self._padding,
+                             num_filter=self._channels, num_group=self._groups,
+                             no_bias=self.bias is None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=1,
+                         prefix=prefix, params=params)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=2,
+                         prefix=prefix, params=params)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=3,
+                         prefix=prefix, params=params)
+
+
+class _ConvTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides, padding, output_padding,
+                 dilation, groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 ndim=2, prefix=None, params=None):
+        HybridBlock.__init__(self, prefix, params)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tuple(kernel_size, ndim)
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._output_padding = _tuple(output_padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._activation = activation
+        self._ndim = ndim
+        # transposed conv stores weight as (in_channels, channels//groups, *k)
+        wshape = (in_channels if in_channels else 0, channels // groups) + self._kernel
+        self.weight = self.params.get("weight", shape=wshape,
+                                      init=weight_initializer, allow_deferred_init=True)
+        self.bias = self.params.get("bias", shape=(channels,), init=bias_initializer) \
+            if use_bias else None
+
+    def _infer_param_shapes(self, x):
+        if self.weight.shape[0] == 0:
+            self.weight.shape = (x.shape[1], self._channels // self._groups) + self._kernel
+
+    def forward(self, x):
+        x = wrap(x)
+        self._resolve_deferred((x,))
+        out = nd.Deconvolution(x, self.weight.data(),
+                               None if self.bias is None else self.bias.data(),
+                               kernel=self._kernel, stride=self._strides,
+                               dilate=self._dilation, pad=self._padding,
+                               adj=self._output_padding, num_filter=self._channels,
+                               num_group=self._groups, no_bias=self.bias is None)
+        if self._activation:
+            out = nd.Activation(out, act_type=self._activation)
+        return out
+
+
+class Conv1DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, output_padding=0,
+                 dilation=1, groups=1, layout="NCW", in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, output_padding,
+                         dilation, groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=1,
+                         prefix=prefix, params=params)
+
+
+class Conv2DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, output_padding,
+                         dilation, groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=2,
+                         prefix=prefix, params=params)
+
+
+class Conv3DTranspose(_ConvTranspose):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, output_padding,
+                         dilation, groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, ndim=3,
+                         prefix=prefix, params=params)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=True, ndim=2,
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._kernel = _tuple(pool_size, ndim) if pool_size else None
+        self._strides = _tuple(strides if strides is not None else pool_size, ndim) \
+            if not global_pool else None
+        self._padding = _tuple(padding, ndim) if not global_pool else None
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return nd.Pooling(wrap(x), kernel=self._kernel, pool_type=self._type,
+                          stride=self._strides, pad=self._padding,
+                          global_pool=self._global,
+                          pooling_convention="full" if self._ceil else "valid",
+                          count_include_pad=self._count_include_pad)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, prefix=None, params=None):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+                         layout, ndim=1, prefix=prefix, params=params)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, prefix=None, params=None):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+                         layout, ndim=2, prefix=prefix, params=params)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+                 ceil_mode=False, prefix=None, params=None):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "max",
+                         layout, ndim=3, prefix=prefix, params=params)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, prefix=None, params=None):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+                         layout, count_include_pad, ndim=1, prefix=prefix, params=params)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, count_include_pad=True, prefix=None, params=None):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+                         layout, count_include_pad, ndim=2, prefix=prefix, params=params)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0, layout="NCDHW",
+                 ceil_mode=False, count_include_pad=True, prefix=None, params=None):
+        super().__init__(pool_size, strides, padding, ceil_mode, False, "avg",
+                         layout, count_include_pad, ndim=3, prefix=prefix, params=params)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__(None, None, None, False, True, "max", layout, ndim=1,
+                         prefix=prefix, params=params)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__(None, None, None, False, True, "max", layout, ndim=2,
+                         prefix=prefix, params=params)
+
+
+class GlobalMaxPool3D(_Pool):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__(None, None, None, False, True, "max", layout, ndim=3,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, layout="NCW", prefix=None, params=None):
+        super().__init__(None, None, None, False, True, "avg", layout, ndim=1,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, layout="NCHW", prefix=None, params=None):
+        super().__init__(None, None, None, False, True, "avg", layout, ndim=2,
+                         prefix=prefix, params=params)
+
+
+class GlobalAvgPool3D(_Pool):
+    def __init__(self, layout="NCDHW", prefix=None, params=None):
+        super().__init__(None, None, None, False, True, "avg", layout, ndim=3,
+                         prefix=prefix, params=params)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._padding = _tuple(padding, 4) if not isinstance(padding, int) else (0, 0, 0, 0, padding, padding, padding, padding)
+        if isinstance(padding, int):
+            self._pw = (0, 0, 0, 0, padding, padding, padding, padding)
+        else:
+            self._pw = tuple(padding)
+
+    def forward(self, x):
+        return nd.pad(wrap(x), mode="reflect", pad_width=self._pw)

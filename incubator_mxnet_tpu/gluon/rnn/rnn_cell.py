@@ -1,0 +1,318 @@
+"""RNN cells (ref `python/mxnet/gluon/rnn/rnn_cell.py` [UNVERIFIED],
+SURVEY.md §2.6).  `unroll` builds the time loop eagerly (python) —
+hybridize the enclosing block to compile it; the fused layers in
+`rnn_layer.py` use `lax.scan` directly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray, wrap
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for c in self._children.values():
+            if isinstance(c, RecurrentCell):
+                c.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            states.append(NDArray(jnp.zeros(shape, jnp.float32)))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        inputs = wrap(inputs)
+        batch = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            step = inputs.slice_axis(axis, t, t + 1).squeeze(axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            merged = nd.stack(*outputs, axis=axis)
+            if valid_length is not None:
+                merged = nd.sequence_mask(merged, valid_length,
+                                          use_sequence_length=True, axis=axis)
+            return merged, states
+        return outputs, states
+
+
+class HybridRecurrentCell(RecurrentCell):
+    pass
+
+
+class RNNCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                          init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                          init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                        init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                        init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _infer_param_shapes(self, x, *a):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        inputs = wrap(inputs)
+        self._resolve_deferred((inputs,))
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(wrap(states[0]), self.h2h_weight.data(),
+                                self.h2h_bias.data(),
+                                num_hidden=self._hidden_size, flatten=False)
+        out = nd.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get("i2h_weight", shape=(4 * hidden_size, input_size),
+                                          init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(4 * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                        init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                        init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _infer_param_shapes(self, x, *a):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        inputs = wrap(inputs)
+        self._resolve_deferred((inputs,))
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=4 * self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(wrap(states[0]), self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=4 * self._hidden_size, flatten=False)
+        gates = i2h + h2h
+        slices = nd.split(gates, num_outputs=4, axis=-1)
+        i = nd.sigmoid(slices[0])
+        f = nd.sigmoid(slices[1])
+        g = nd.tanh(slices[2])
+        o = nd.sigmoid(slices[3])
+        c = f * wrap(states[1]) + i * g
+        h = o * nd.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(HybridRecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._hidden_size = hidden_size
+        self.i2h_weight = self.params.get("i2h_weight", shape=(3 * hidden_size, input_size),
+                                          init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(3 * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                        init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                        init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _infer_param_shapes(self, x, *a):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def forward(self, inputs, states):
+        inputs = wrap(inputs)
+        self._resolve_deferred((inputs,))
+        i2h = nd.FullyConnected(inputs, self.i2h_weight.data(), self.i2h_bias.data(),
+                                num_hidden=3 * self._hidden_size, flatten=False)
+        h2h = nd.FullyConnected(wrap(states[0]), self.h2h_weight.data(), self.h2h_bias.data(),
+                                num_hidden=3 * self._hidden_size, flatten=False)
+        i2h_s = nd.split(i2h, num_outputs=3, axis=-1)
+        h2h_s = nd.split(h2h, num_outputs=3, axis=-1)
+        r = nd.sigmoid(i2h_s[0] + h2h_s[0])
+        z = nd.sigmoid(i2h_s[1] + h2h_s[1])
+        n = nd.tanh(i2h_s[2] + r * h2h_s[2])
+        h = (1 - z) * n + z * wrap(states[0])
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, cell):
+        self._children[str(len(self._children))] = cell
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for c in self._children.values():
+            infos += c.state_info(batch_size)
+        return infos
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for c in self._children.values():
+            states += c.begin_state(batch_size, **kwargs)
+        return states
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for c in self._children.values():
+            n = len(c.state_info())
+            inputs, s = c(inputs, states[p:p + n])
+            next_states += s
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(HybridRecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import _tape
+
+        if self._rate > 0:
+            inputs = nd.Dropout(wrap(inputs), p=self._rate, axes=self._axes,
+                                training=_tape.is_training())
+        return inputs, states
+
+
+class _ModifierCell(HybridRecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        from ... import _tape, random as _r
+        import jax
+
+        out, new_states = self.base_cell(inputs, states)
+        if _tape.is_training():
+            if self.zoneout_outputs > 0:
+                prev = self._prev_output if self._prev_output is not None else out * 0
+                mask = jax.random.bernoulli(_r.next_key(), self.zoneout_outputs, out.shape)
+                out = nd.where(NDArray(mask.astype(jnp.float32)), prev, out)
+            if self.zoneout_states > 0:
+                zs = []
+                for s_new, s_old in zip(new_states, states):
+                    mask = jax.random.bernoulli(_r.next_key(), self.zoneout_states, s_new.shape)
+                    zs.append(nd.where(NDArray(mask.astype(jnp.float32)), wrap(s_old), s_new))
+                new_states = zs
+        self._prev_output = out
+        return out, new_states
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + wrap(inputs), states
+
+
+class BidirectionalCell(HybridRecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix=None, params=None)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.l_cell.begin_state(batch_size, **kwargs) + \
+            self.r_cell.begin_state(batch_size, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        inputs = wrap(inputs)
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(length, inputs, begin_state[:nl],
+                                             layout, True, valid_length)
+        rev = nd.sequence_reverse(inputs, valid_length,
+                                  use_sequence_length=valid_length is not None, axis=axis)
+        r_out, r_states = self.r_cell.unroll(length, rev, begin_state[nl:],
+                                             layout, True, valid_length)
+        r_out = nd.sequence_reverse(r_out, valid_length,
+                                    use_sequence_length=valid_length is not None, axis=axis)
+        out = nd.concat(l_out, r_out, dim=2 if layout == "NTC" else -1)
+        return out, l_states + r_states
+
+    def forward(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell supports only unroll()")

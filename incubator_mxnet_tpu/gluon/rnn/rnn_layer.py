@@ -1,0 +1,99 @@
+"""Fused RNN layers over the lax.scan RNN op (ref
+`python/mxnet/gluon/rnn/rnn_layer.py` + cuDNN RNN [UNVERIFIED],
+SURVEY.md §2.3 RNN row)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import _tape
+from ... import ndarray as nd
+from ...ndarray.ndarray import NDArray, wrap
+from ...ndarray.rnn_impl import param_size
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix, params)
+        assert layout in ("TNC", "NTC")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self.parameters = self.params.get(
+            "parameters",
+            shape=(param_size(mode, input_size, hidden_size, num_layers, bidirectional)
+                   if input_size else 0,),
+            init=i2h_weight_initializer, allow_deferred_init=True)
+
+    def _infer_param_shapes(self, x, *a):
+        if self.parameters.shape[0] == 0:
+            in_sz = x.shape[-1]
+            self._input_size = in_sz
+            self.parameters.shape = (param_size(self._mode, in_sz, self._hidden_size,
+                                                self._num_layers, self._dir == 2),)
+
+    def state_info(self, batch_size=0):
+        n = self._num_layers * self._dir
+        if self._mode == "lstm":
+            return [{"shape": (n, batch_size, self._hidden_size)},
+                    {"shape": (n, batch_size, self._hidden_size)}]
+        return [{"shape": (n, batch_size, self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return [NDArray(jnp.zeros(info["shape"], jnp.float32))
+                for info in self.state_info(batch_size)]
+
+    def forward(self, inputs, states=None):
+        inputs = wrap(inputs)
+        self._resolve_deferred((inputs,))
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        batch = inputs.shape[1]
+        ret_states = states is not None
+        if states is None:
+            states = self.begin_state(batch)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        out = nd.RNN(inputs, self.parameters.data(), states[0],
+                     states[1] if len(states) > 1 else None,
+                     mode=self._mode, state_size=self._hidden_size,
+                     num_layers=self._num_layers, bidirectional=self._dir == 2,
+                     p=self._dropout, training=_tape.is_training())
+        y = out[0]
+        new_states = list(out[1:])
+        if self._layout == "NTC":
+            y = y.swapaxes(0, 1)
+        if ret_states:
+            return y, new_states
+        return y
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="tanh", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        mode = "rnn_tanh" if activation == "tanh" else "rnn_relu"
+        super().__init__(mode, hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

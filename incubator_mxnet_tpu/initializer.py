@@ -1,0 +1,230 @@
+"""Weight initializers (parity: `python/mxnet/initializer.py` [UNVERIFIED],
+SURVEY.md §2.6): Xavier, MSRAPrelu, Normal/Uniform, Orthogonal,
+Bilinear, Constant, One/Zero, Mixed — drawn from `jax.random` keys via
+the global `mx.random` stream for reproducibility.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from . import random as _random
+from .base import Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "InitDesc", "register", "create"]
+
+_REG = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter name carrying init attrs (parity with mx InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray):
+        self.init_weight(name, arr)
+
+    def init_weight(self, name: str, arr: NDArray):
+        name = str(name)
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma") or "moving_var" in name or "running_var" in name:
+            self._init_one(arr)
+        elif name.endswith("beta") or "moving_mean" in name or "running_mean" in name:
+            self._init_zero(arr)
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_zero(self, arr):
+        arr._data = jnp.zeros_like(arr._data)
+
+    def _init_one(self, arr):
+        arr._data = jnp.ones_like(arr._data)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+_REG.register(Zero, "zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+_REG.register(One, "ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._data = jnp.full_like(arr._data, self.value)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._data = jax.random.uniform(_random.next_key(), arr.shape, arr._data.dtype,
+                                       -self.scale, self.scale)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._data = self.sigma * jax.random.normal(_random.next_key(), arr.shape, arr._data.dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        a = jax.random.normal(_random.next_key(), (max(nout, nin), min(nout, nin)))
+        q, _ = jnp.linalg.qr(a)
+        q = q.T if nout < nin else q
+        arr._data = (self.scale * q[:nout, :nin]).reshape(arr.shape).astype(arr._data.dtype)
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(f"Xavier requires ndim>=2, got {shape} for {name}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr._data = jax.random.uniform(_random.next_key(), shape, arr._data.dtype, -scale, scale)
+        else:
+            arr._data = scale * jax.random.normal(_random.next_key(), shape, arr._data.dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._data = jnp.asarray(weight.reshape(shape), dtype=arr._data.dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1.0 (parity with mx.init.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = jnp.zeros_like(arr._data)
+        n = arr.shape[0] // 4
+        arr._data = b.at[n:2 * n].set(self.forget_bias)
+
+
+class Mixed(Initializer):
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, ini in self.map:
+            if pat.match(str(name)):
+                ini(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")
+
+
+def create(name, **kwargs) -> Initializer:
+    if isinstance(name, Initializer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+class _InitAlias:
+    """`mx.init.*` namespace alias."""
+
+    Zero = Zero
+    One = One
+    Constant = Constant
+    Uniform = Uniform
+    Normal = Normal
+    Orthogonal = Orthogonal
+    Xavier = Xavier
+    MSRAPrelu = MSRAPrelu
+    Bilinear = Bilinear
+    LSTMBias = LSTMBias
+    Mixed = Mixed
+    Initializer = Initializer
+    InitDesc = InitDesc
+
+
+init = _InitAlias

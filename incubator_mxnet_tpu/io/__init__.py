@@ -1,0 +1,13 @@
+"""`mx.io` — legacy data iterators.
+
+Re-design of `python/mxnet/io/io.py` + the C++ iterators in `src/io/`
+[UNVERIFIED] (SURVEY.md §2.5): `DataIter` protocol (`next() →
+DataBatch`, `provide_data/provide_label`, `reset`), `NDArrayIter` with
+shuffle + last-batch handling, CSVIter, and `ImageRecordIter` backed by
+the RecordIO codec + host-side decode workers.
+"""
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
+                 MNISTIter, ResizeIter, PrefetchingIter, ImageRecordIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]

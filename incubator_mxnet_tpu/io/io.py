@@ -1,0 +1,382 @@
+"""DataIter implementations (see package docstring)."""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+from collections import namedtuple
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, wrap
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._next_batch.data
+
+    def getlabel(self):
+        return self._next_batch.label
+
+    def getindex(self):
+        return self._next_batch.index
+
+    def getpad(self):
+        return self._next_batch.pad
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (ref: python/mxnet/io/io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = onp.arange(self.num_data)
+        if shuffle:
+            onp.random.shuffle(self._order)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], str(v.dtype))
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], str(v.dtype))
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+
+    def next(self) -> DataBatch:
+        self.cursor += self.batch_size
+        if self.cursor >= self.num_data:
+            raise StopIteration
+        end = self.cursor + self.batch_size
+        pad = 0
+        idx = self._order[self.cursor:end]
+        if end > self.num_data:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if self.last_batch_handle == "pad":
+                pad = end - self.num_data
+                idx = onp.concatenate([idx, self._order[:pad]])
+            # roll_over: keep short batch
+        data = [NDArray(jnp.asarray(v[idx])) for _, v in self.data]
+        label = [NDArray(jnp.asarray(v[idx])) for _, v in self.label]
+        return DataBatch(data=data, label=label, pad=pad, index=idx,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        if not allow_empty:
+            raise ValueError("data cannot be None")
+        return []
+    if isinstance(data, (NDArray, onp.ndarray)):
+        data = [(default_name, data)]
+    elif isinstance(data, (list, tuple)):
+        data = [(f"{default_name}_{i}" if i else default_name, d)
+                for i, d in enumerate(data)]
+    elif isinstance(data, dict):
+        data = list(data.items())
+    out = []
+    for k, v in data:
+        arr = v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v)
+        out.append((k, arr))
+    return out
+
+
+class CSVIter(DataIter):
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype="float32")
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = onp.loadtxt(label_csv, delimiter=",", dtype="float32") \
+            if label_csv else onp.zeros((data.shape[0],) + tuple(label_shape), "float32")
+        self._inner = NDArrayIter(data, label, batch_size, last_batch_handle="discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class MNISTIter(DataIter):
+    """Reads the classic idx-format MNIST files (ref: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import struct as _struct
+
+        def _read_idx(path):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                magic = _struct.unpack(">I", f.read(4))[0]
+                ndim = magic & 0xFF
+                dims = [_struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+                return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(dims)
+
+        imgs = _read_idx(image).astype("float32") / 255.0
+        labels = _read_idx(label).astype("float32")
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, 28, 28)
+        self._inner = NDArrayIter(imgs, labels, batch_size, shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+
+class ResizeIter(DataIter):
+    """Caps an iterator at `size` batches (ref io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            return self.data_iter.next()
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (ref: src/io/iter_prefetcher.h) —
+    overlaps host batch prep with device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch_depth=2):
+        it = iters[0] if isinstance(iters, list) else iters
+        super().__init__(it.batch_size)
+        self.iter = it
+        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        if self._thread is not None:
+            while not self._queue.empty():
+                self._queue.get_nowait()
+            self._thread.join(timeout=5)
+        self._stop.clear()
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (ref: src/io/iter_image_recordio_2.cc).
+
+    Decode/augment runs in host worker threads; batches land as a
+    single device array ready for `jax.device_put` (sharded when a mesh
+    is active).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 preprocess_threads=4, label_width=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        from .. import recordio as rio
+
+        self.data_shape = tuple(data_shape)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = onp.array([mean_r, mean_g, mean_b], "float32").reshape(3, 1, 1)
+        self.std = onp.array([std_r, std_g, std_b], "float32").reshape(3, 1, 1)
+        self.shuffle = shuffle
+        if path_imgidx:
+            self.rec = rio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self.keys = list(self.rec.keys)
+        else:
+            self.rec = rio.MXRecordIO(path_imgrec, "r")
+            self.keys = None
+        self._order = None
+        self.reset()
+
+    def reset(self):
+        if self.keys is not None:
+            self._order = onp.arange(len(self.keys))
+            if self.shuffle:
+                onp.random.shuffle(self._order)
+            self._cursor = 0
+        else:
+            self.rec.reset()
+
+    def _read_one(self):
+        from .. import recordio as rio
+
+        if self.keys is not None:
+            if self._cursor >= len(self.keys):
+                raise StopIteration
+            raw = self.rec.read_idx(self.keys[self._order[self._cursor]])
+            self._cursor += 1
+        else:
+            raw = self.rec.read()
+            if raw is None:
+                raise StopIteration
+        header, img = rio.unpack_img(raw)
+        arr = img.asnumpy().astype("float32")
+        if arr.ndim == 2:
+            arr = onp.stack([arr] * 3, axis=-1)
+        arr = arr.transpose(2, 0, 1)  # HWC→CHW
+        c, h, w = self.data_shape
+        arr = _center_or_rand_crop(arr, h, w, self.rand_crop)
+        if self.rand_mirror and onp.random.rand() < 0.5:
+            arr = arr[:, :, ::-1]
+        arr = (arr - self.mean) / self.std
+        return arr, onp.float32(header.label if onp.isscalar(header.label) else header.label[0])
+
+    def next(self) -> DataBatch:
+        datas, labels = [], []
+        for _ in range(self.batch_size):
+            d, l = self._read_one()
+            datas.append(d)
+            labels.append(l)
+        data = NDArray(jnp.asarray(onp.stack(datas)))
+        label = NDArray(jnp.asarray(onp.stack(labels)))
+        return DataBatch(data=[data], label=[label])
+
+
+def _center_or_rand_crop(arr, h, w, rand):
+    c, H, W = arr.shape
+    if H < h or W < w:
+        # pad small images
+        out = onp.zeros((c, max(H, h), max(W, w)), arr.dtype)
+        out[:, :H, :W] = arr
+        arr, H, W = out, max(H, h), max(W, w)
+    if rand:
+        y = onp.random.randint(0, H - h + 1)
+        x = onp.random.randint(0, W - w + 1)
+    else:
+        y, x = (H - h) // 2, (W - w) // 2
+    return arr[:, y:y + h, x:x + w]

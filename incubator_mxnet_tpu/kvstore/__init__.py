@@ -1,0 +1,4 @@
+from .kvstore import KVStore, create
+from .gradient_compression import GradientCompression
+
+__all__ = ["KVStore", "create", "GradientCompression"]

@@ -1,0 +1,42 @@
+"""2-bit gradient compression with error-feedback residual.
+
+Re-design of `src/kvstore/gradient_compression.cc` [UNVERIFIED]
+(SURVEY.md §2.4): quantize each gradient to {-threshold, 0, +threshold}
+keeping the quantization error as residual added to the next push —
+the same algorithm, expressed as a jitted functional kernel.  Intended
+for the cross-slice DCN axis where bandwidth (not ICI) binds.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradientCompression"]
+
+
+@jax.jit
+def _two_bit_compress(grad, residual, threshold):
+    g = grad + residual
+    q = jnp.where(g >= threshold, threshold,
+                  jnp.where(g <= -threshold, -threshold, 0.0)).astype(grad.dtype)
+    return q, g - q
+
+
+class GradientCompression:
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise ValueError(f"unsupported compression type {type!r}")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def compress(self, key, grad_raw):
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(grad_raw)
+        q, new_res = _two_bit_compress(grad_raw, res, self.threshold)
+        self._residuals[key] = new_res
+        return q
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}

@@ -1,0 +1,170 @@
+"""KVStore facade over XLA collectives.
+
+Re-design of the reference KVStore stack (SURVEY.md §2.4, §5.8; ref
+`include/mxnet/kvstore.h`, `src/kvstore/kvstore_local.h`,
+`kvstore_nccl.h`, `kvstore_dist.h`, `3rdparty/ps-lite` [UNVERIFIED]).
+
+Mapping (SURVEY.md §7 translation table):
+  local/device/nccl → in-process reduce; when values are mesh-sharded
+      jax.Arrays the reduction compiles to ICI `psum` inside jit.
+  dist_sync / dist_sync_device → synchronous SPMD over
+      `jax.distributed` (rank = process_index, num_workers =
+      process_count); the barrier is implicit in SPMD collectives.
+  dist_async / server-side optimizer → NOT carried (SURVEY.md §8):
+      async PS conflicts with SPMD.  `set_optimizer` therefore runs
+      the optimizer worker-side via an Updater, preserving observable
+      `pull` semantics for `update_on_kvstore` users.
+
+Semantics preserved for the reference's kvstore tests (SURVEY.md §4
+"Distributed"): after N pushes to a key, `pull` returns the SUM of
+pushed values; `pushpull` fuses both.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import NDArray, raw, wrap
+from .gradient_compression import GradientCompression
+
+__all__ = ["KVStore", "create"]
+
+
+def _sum_values(vals: List[NDArray]):
+    acc = raw(vals[0])
+    for v in vals[1:]:
+        acc = acc + raw(v)
+    return acc
+
+
+class KVStore:
+    def __init__(self, kv_type: str = "local"):
+        self.type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._compression: Optional[GradientCompression] = None
+        self._is_dist = kv_type.startswith("dist")
+
+    # -- topology ------------------------------------------------------- #
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self._is_dist else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self._is_dist else 1
+
+    # -- core protocol --------------------------------------------------- #
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        self._store[key] = raw(wrap(value))
+
+    def push(self, key, value, priority: int = 0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        summed = _sum_values([wrap(v) for v in vals])
+        if self._is_dist and jax.process_count() > 1:
+            # cross-host reduction over the DCN data axis
+            from ..parallel import collectives
+
+            summed = collectives.allreduce_across_processes(summed)
+        if self._compression is not None:
+            summed = self._compression.compress(key, summed)
+        if self._updater is not None:
+            # server-side-optimizer parity: run updater, store weights
+            w = self._store.get(key)
+            if w is None:
+                raise MXNetError(f"kvstore key {key} not initialized before push")
+            wnd = NDArray(w)
+            self._updater(key, NDArray(summed), wnd)
+            self._store[key] = wnd._data
+        else:
+            # sync-training usage: one push per pull window; the pushed
+            # (already list-summed, cross-host-reduced) value replaces the slot
+            self._store[key] = summed
+
+    def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        val = self._store.get(key)
+        if val is None:
+            raise MXNetError(f"kvstore key {key} was not initialized")
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._set_data(val.astype(o._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority: int = 0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority: int = 0, row_ids=None):
+        """Dense-gather equivalent of the reference row_sparse pull."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        val = self._store.get(key)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        ids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for o, rid in zip(outs, ids):
+            rows = jnp.take(val, raw(wrap(rid)).astype(jnp.int32), axis=0)
+            full = jnp.zeros_like(val).at[raw(wrap(rid)).astype(jnp.int32)].set(rows)
+            o._set_data(full.astype(o._data.dtype))
+
+    # -- optimizer / compression ---------------------------------------- #
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = GradientCompression(**compression_params)
+
+    def _set_updater(self, updater: Callable):
+        self._updater = updater
+
+    # -- persistence ----------------------------------------------------- #
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set on this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set on this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        if self._is_dist and jax.process_count() > 1:
+            from ..parallel import collectives
+
+            collectives.barrier()
+
+
+_TYPES = ("local", "device", "nccl", "dist_sync", "dist_device_sync",
+          "dist_sync_device", "dist_async", "horovod", "p3")
+
+
+def create(name: str = "local") -> KVStore:
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async (server-side asynchronous parameter server) is not carried "
+            "to TPU: it conflicts with SPMD execution. Use dist_sync. "
+            "(documented drop, SURVEY.md §8)")
+    if name not in _TYPES:
+        raise MXNetError(f"unknown kvstore type {name!r}; valid: {_TYPES}")
+    return KVStore(name)

@@ -1,0 +1,13 @@
+"""Flagship model families (BASELINE.json configs): BERT (GluonNLP-
+shaped), Transformer WMT, ArcFace margin-softmax.  Vision zoo lives in
+`gluon.model_zoo.vision`."""
+
+
+def __getattr__(name):
+    if name in ("bert", "transformer", "arcface"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

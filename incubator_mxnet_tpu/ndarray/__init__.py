@@ -1,0 +1,50 @@
+"""`mx.nd` — the imperative array namespace.
+
+Anything not explicitly defined in `ops`/`nn_ops`/`linalg` falls back to
+the corresponding `jax.numpy` function wrapped through `apply_op`, so
+the op surface is effectively the full jnp catalogue with autograd
+recording (SURVEY.md §2.3 "NumPy-compat ops": free via jax.numpy).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ndarray import (NDArray, apply_op, arange, array, empty, eye, full,
+                      ones, ones_like, raw, wrap, zeros, zeros_like)
+from .ops import *  # noqa: F401,F403
+from .nn_ops import *  # noqa: F401,F403
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+from . import contrib  # noqa: F401
+from . import ops as _ops
+from . import nn_ops as _nn_ops
+
+waitall = lambda: None  # engine drain — XLA async dispatch needs no global barrier
+
+
+def save(fname, data):
+    from ..utils import serialization
+
+    serialization.save_ndarrays(fname, data)
+
+
+def load(fname):
+    from ..utils import serialization
+
+    return serialization.load_ndarrays(fname)
+
+
+def _jnp_fallback(name):
+    jfn = getattr(jnp, name, None)
+    if jfn is None or not callable(jfn):
+        raise AttributeError(f"module 'nd' has no attribute {name!r}")
+
+    def op(*args, **kwargs):
+        return apply_op(lambda *xs: jfn(*xs, **kwargs), *args)
+
+    op.__name__ = name
+    return op
+
+
+def __getattr__(name):
+    return _jnp_fallback(name)

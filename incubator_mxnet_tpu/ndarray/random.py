@@ -1,0 +1,86 @@
+"""`mx.nd.random` sampler namespace.
+
+Re-design of `src/operator/random/sample_op.cc` + `multisample_op.cc`
+(SURVEY.md §2.3 "Random" [UNVERIFIED]) over `jax.random` counter-based
+keys — reproducible across replicas/hosts by construction, unlike the
+reference's per-device Philox state.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _r
+from .ndarray import NDArray, raw, wrap
+
+__all__ = ["uniform", "normal", "randn", "randint", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "bernoulli"]
+
+
+def _shp(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return NDArray(jax.random.uniform(_r.next_key(), _shp(shape), jnp.dtype(dtype), raw(low), raw(high)))
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return NDArray(raw(loc) + raw(scale) * jax.random.normal(_r.next_key(), _shp(shape), jnp.dtype(dtype)))
+
+
+def randn(*shape, dtype="float32", **kw):
+    return normal(0.0, 1.0, shape, dtype=dtype)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, **kw):
+    return NDArray(jax.random.randint(_r.next_key(), _shp(shape), low, high, jnp.dtype(dtype)))
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return NDArray(raw(beta) * jax.random.gamma(_r.next_key(), raw(alpha), _shp(shape), jnp.dtype(dtype)))
+
+
+def exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return NDArray(jax.random.exponential(_r.next_key(), _shp(shape), jnp.dtype(dtype)) / raw(lam))
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return NDArray(jax.random.poisson(_r.next_key(), raw(lam), _shp(shape)).astype(jnp.dtype(dtype)))
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    g = jax.random.gamma(_r.next_key(), k, _shp(shape)) * (1 - p) / p
+    return NDArray(jax.random.poisson(_r.next_key(), g).astype(jnp.dtype(dtype)))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    return negative_binomial(k=k, p=p, shape=shape, dtype=dtype)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", **kw):
+    return NDArray(jax.random.bernoulli(_r.next_key(), raw(prob), _shp(shape) or None).astype(jnp.dtype(dtype)))
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    """Sample from categorical distributions given probabilities."""
+    p = raw(wrap(data))
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    n = () if shape is None else _shp(shape)
+    samples = jax.random.categorical(_r.next_key(), logits, axis=-1, shape=n + logits.shape[:-1] if n else None)
+    out = NDArray(samples.astype(jnp.dtype(dtype)))
+    if get_prob:
+        logp = jnp.take_along_axis(jnp.log(jnp.maximum(p, 1e-30)),
+                                   samples[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return out, NDArray(logp)
+    return out
+
+
+def shuffle(data, **kw):
+    x = raw(wrap(data))
+    return NDArray(jax.random.permutation(_r.next_key(), x, axis=0))

@@ -1,0 +1,172 @@
+"""Fused multi-layer RNN (LSTM/GRU/vanilla) via lax.scan.
+
+Re-design of the reference fused RNN operator (`src/operator/rnn.cc`,
+`rnn-inl.h`, cuDNN path `src/operator/nn/cudnn/cudnn_rnn-inl.h`
+[UNVERIFIED], SURVEY.md §2.3 "RNN"): the packed parameter blob layout
+(per layer/direction: i2h weights, h2h weights, then all biases)
+matches the reference so `.params` checkpoints map 1:1.  The time loop
+is a `lax.scan` — XLA compiles it once and keeps the cell's two matmuls
+on the MXU; no dynamic Python control flow (SURVEY.md §7 table).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ndarray import NDArray, apply_op, raw, wrap
+
+_GATES = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}
+
+
+def param_size(mode: str, input_size: int, state_size: int, num_layers: int,
+               bidirectional: bool = False) -> int:
+    """Total packed parameter count (reference rnn-inl.h GetParamSize)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        size += d * g * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, bidirectional):
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    idx = 0
+    weights = []
+    # weights first, all layers/directions; then biases (reference layout)
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * d
+        for _ in range(d):
+            w_i2h = lax.dynamic_slice(params, (idx,), (g * state_size * in_sz,)).reshape(g * state_size, in_sz)
+            idx += g * state_size * in_sz
+            w_h2h = lax.dynamic_slice(params, (idx,), (g * state_size * state_size,)).reshape(g * state_size, state_size)
+            idx += g * state_size * state_size
+            weights.append((w_i2h, w_h2h))
+    biases = []
+    for layer in range(num_layers):
+        for _ in range(d):
+            b_i2h = lax.dynamic_slice(params, (idx,), (g * state_size,))
+            idx += g * state_size
+            b_h2h = lax.dynamic_slice(params, (idx,), (g * state_size,))
+            idx += g * state_size
+            biases.append((b_i2h, b_h2h))
+    return weights, biases
+
+
+def _cell_step(mode, state_size):
+    if mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c)
+    elif mode == "gru":
+        step = None  # handled inline (needs h2h split)
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(carry, gates):
+            (h,) = carry
+            return (act(gates),)
+    return step
+
+
+def _run_layer(x, w_i2h, w_h2h, b_i2h, b_h2h, h0, c0, mode, state_size, reverse=False):
+    """x: (T, B, in). Returns (y:(T,B,H), hT, cT)."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    xg = jnp.einsum("tbi,gi->tbg", x, w_i2h) + b_i2h  # hoisted input matmul (one big MXU op)
+
+    if mode == "gru":
+        def scan_fn(carry, xg_t):
+            h = carry[0]
+            hg = h @ w_h2h.T + b_h2h
+            xr, xz, xn = jnp.split(xg_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+
+        (hT,), y = lax.scan(scan_fn, (h0,), xg)
+        cT = hT
+    elif mode == "lstm":
+        cell = _cell_step(mode, state_size)
+
+        def scan_fn(carry, xg_t):
+            h, c = carry
+            gates = xg_t + h @ w_h2h.T + b_h2h
+            h, c = cell((h, c), gates)
+            return (h, c), h
+
+        (hT, cT), y = lax.scan(scan_fn, (h0, c0), xg)
+    else:
+        cell = _cell_step(mode, state_size)
+
+        def scan_fn(carry, xg_t):
+            (h,) = carry
+            gates = xg_t + h @ w_h2h.T + b_h2h
+            (h,) = cell((h,), gates)
+            return (h,), h
+
+        (hT,), y = lax.scan(scan_fn, (h0,), xg)
+        cT = hT
+    if reverse:
+        y = jnp.flip(y, axis=0)
+    return y, hT, cT
+
+
+def fused_rnn(data, parameters, state, state_cell=None, mode="lstm", state_size=0,
+              num_layers=1, bidirectional=False, dropout=0.0, training=False):
+    """Layout parity with reference RNN op: data (T,B,I), state (L*D,B,H)."""
+    d = 2 if bidirectional else 1
+    has_cell = mode == "lstm"
+
+    from .. import random as _random
+
+    drop_key = _random.next_key() if (dropout > 0.0 and training) else None
+
+    def f(x, params, h0_all, *rest):
+        c0_all = rest[0] if rest else jnp.zeros_like(h0_all)
+        input_size = x.shape[-1]
+        weights, biases = _unpack(params, mode, input_size, state_size, num_layers, bidirectional)
+        out = x
+        hTs, cTs = [], []
+        for layer in range(num_layers):
+            ys = []
+            for di in range(d):
+                wi = layer * d + di
+                w_i2h, w_h2h = weights[wi]
+                b_i2h, b_h2h = biases[wi]
+                h0 = h0_all[wi]
+                c0 = c0_all[wi]
+                y, hT, cT = _run_layer(out, w_i2h, w_h2h, b_i2h, b_h2h, h0, c0,
+                                       mode, state_size, reverse=(di == 1))
+                ys.append(y)
+                hTs.append(hT)
+                cTs.append(cT)
+            out = jnp.concatenate(ys, axis=-1) if d == 2 else ys[0]
+            if dropout > 0.0 and training and layer < num_layers - 1 and drop_key is not None:
+                k = jax.random.fold_in(drop_key, layer)
+                keep = jax.random.bernoulli(k, 1.0 - dropout, out.shape)
+                out = jnp.where(keep, out / (1.0 - dropout), 0.0)
+        hT = jnp.stack(hTs, axis=0)
+        cT = jnp.stack(cTs, axis=0)
+        if has_cell:
+            return out, hT, cT
+        return out, hT
+
+    args = [data, parameters, state]
+    if has_cell and state_cell is not None:
+        args.append(state_cell)
+    n_out = 3 if has_cell else 2
+    return apply_op(f, *args, n_out=n_out)

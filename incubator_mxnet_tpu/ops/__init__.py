@@ -1,0 +1,20 @@
+"""Custom TPU kernels (Pallas) + fused ops.
+
+The reference's hand-tuned CUDA kernels (SURVEY.md §2.3: fused
+attention in contrib/transformer.cu, multi-tensor optimizer ops,
+pointwise fusion) become Pallas kernels here; anything XLA already
+fuses well stays in plain jnp.
+"""
+from .flash_attention import flash_attention, attention_reference
+
+__all__ = ["flash_attention", "attention_reference"]
+
+
+def __getattr__(name):
+    if name in ("fused_optimizer", "margin_softmax"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

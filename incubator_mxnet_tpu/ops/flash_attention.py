@@ -1,0 +1,147 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+Replaces the reference's fused interleaved-matmul attention CUDA ops
+(`src/operator/contrib/transformer.cu` [UNVERIFIED], SURVEY.md §2.3
+"Attention / transformer kernels": "Pallas flash attention (the
+marquee custom kernel)").
+
+Design (per /opt/skills/guides/pallas_guide.md):
+- grid = (batch*heads, ceil(Tq/BQ)); each program owns one query block
+  in VMEM and streams key/value blocks with `pl.ds`, keeping the
+  running (max, denom, acc) online-softmax state as fori_loop carry.
+- both matmuls hit the MXU with fp32 accumulation
+  (`preferred_element_type`); inputs may be bf16.
+- causal masking via iota comparison; out-of-range tails masked the
+  same way so ragged Tk works.
+- `interpret=True` on CPU so the same kernel runs in the test suite
+  (SURVEY.md §4: CPU is the reference implementation).
+
+`attention_reference` is the jnp oracle used by the numeric tests.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "attention_reference"]
+
+
+def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Plain XLA softmax(QKᵀ)V oracle. q,k,v: (B, H, T, D)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, nk, tk):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+    qi = pl.program_id(1)
+    d = q.shape[-1]
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        col = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = col < tk
+        if causal:
+            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # guard fully-masked rows (m_new == -inf) against NaN from exp(-inf - -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(valid, s - m_safe, -jnp.inf))
+        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+        alpha = jnp.where(jnp.isfinite(m), alpha, 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k",
+                                             "interpret"))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    pad_q = (-Tq) % bq
+    pad_k = (-Tk) % bk
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    Tq_p, Tk_p = Tq + pad_q, Tk + pad_k
+    nk = Tk_p // bk
+    grid = (B * H, Tq_p // bq)
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk, tk=Tk)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out[:, :Tq, :].reshape(B, H, Tq, D)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    force_reference: bool = False):
+    """Fused attention. q,k,v: (B, H, T, D) jax arrays (or NDArray).
+
+    TPU → Pallas kernel; CPU → same kernel via the Pallas interpreter
+    for small shapes, XLA reference otherwise (identical numerics).
+    """
+    from ..ndarray.ndarray import NDArray, raw
+
+    was_nd = isinstance(q, NDArray)
+    q, k, v = raw(q), raw(k), raw(v)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    platform = jax.default_backend()
+    if force_reference:
+        out = attention_reference(q, k, v, causal, scale)
+    elif platform == "cpu":
+        # interpreter is exact but slow — only for kernel-parity tests
+        if q.shape[2] * k.shape[2] <= 256 * 256:
+            out = _flash_core(q, k, v, causal, scale, min(block_q, 64),
+                              min(block_k, 64), True)
+        else:
+            out = attention_reference(q, k, v, causal, scale)
+    else:
+        out = _flash_core(q, k, v, causal, scale, block_q, block_k, False)
+    return NDArray(out) if was_nd else out

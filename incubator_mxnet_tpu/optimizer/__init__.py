@@ -1,0 +1,9 @@
+from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, Adamax, Nadam,
+                        RMSProp, AdaGrad, AdaDelta, Ftrl, LAMB, LARS, DCASGD,
+                        Signum, SGLD, Test, create, register, get_updater,
+                        Updater)
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "Adamax", "Nadam",
+           "RMSProp", "AdaGrad", "AdaDelta", "Ftrl", "LAMB", "LARS", "DCASGD",
+           "Signum", "SGLD", "Test", "create", "register", "get_updater",
+           "Updater"]

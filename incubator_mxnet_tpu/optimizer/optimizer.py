@@ -1,0 +1,609 @@
+"""Optimizers.
+
+Re-design of the reference optimizer stack (SURVEY.md §2.6
+`python/mxnet/optimizer/optimizer.py` + §2.3 optimizer ops
+`src/operator/optimizer_op.cc`, `contrib/multi_lamb.cc` [UNVERIFIED]).
+Each update rule is ONE jitted functional kernel (weight, grad, state)
+→ (weight', state') with hyper-parameters passed as traced scalars so
+lr/wd changes never trigger recompiles.  XLA fuses the whole chain
+(rescale → clip → wd → moment update → apply) into a single elementwise
+kernel — the equivalent of the reference's hand-fused `sgd_mom_update`
+/ `adam_update` CUDA ops, for free.
+
+Multi-precision (`multi_precision=True`) keeps fp32 master weights for
+bf16 params — parity with the reference `mp_*` op variants.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import Registry
+from ..ndarray.ndarray import NDArray, raw
+
+_REG = Registry("optimizer")
+register = _REG.register
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+def _prep(g, w, rescale, clip, wd):
+    g = g.astype(w.dtype) * rescale
+    g = jnp.clip(g, -clip, clip)
+    return g + wd * w
+
+
+class Optimizer:
+    """Base optimizer: per-weight state, lr/wd multipliers, loss-scale-aware."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 param_dict=None, multi_precision=False, begin_num_update=0, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient if clip_gradient is not None else float("inf")
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict = {}
+        self.wd_mult: Dict = {}
+
+    # -- hyper-parameter plumbing (reference API parity) ---------------- #
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler is not None else self.lr
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= getattr(p, "lr_mult", 1.0)
+        else:
+            lr *= self.lr_mult.get(index, self.lr_mult.get(self.idx2name.get(index, ""), 1.0))
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= getattr(p, "wd_mult", 1.0)
+        else:
+            wd *= self.wd_mult.get(index, self.wd_mult.get(self.idx2name.get(index, ""), 1.0))
+        return wd
+
+    # -- state ---------------------------------------------------------- #
+    def create_state(self, index, weight: NDArray):
+        return None
+
+    def create_state_multi_precision(self, index, weight: NDArray):
+        if self.multi_precision and weight._data.dtype in (jnp.float16, jnp.bfloat16):
+            master = weight._data.astype(jnp.float32)
+            return (master, self.create_state(index, NDArray(master)))
+        return self.create_state(index, weight)
+
+    # -- update --------------------------------------------------------- #
+    def update(self, index, weight: NDArray, grad: NDArray, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight._data.dtype in (jnp.float16, jnp.bfloat16):
+            master, sub = state
+            mw = NDArray(master)
+            new_sub = self.update(index, mw, grad, sub)
+            weight._data = mw._data.astype(weight._data.dtype)
+            return (mw._data, new_sub if new_sub is not None else sub)
+        return self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+# ---------------------------------------------------------------------- #
+# jitted update kernels
+# ---------------------------------------------------------------------- #
+@jax.jit
+def _k_sgd(w, g, lr, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    return w - lr * g
+
+
+@jax.jit
+def _k_sgd_mom(w, g, mom, lr, momentum, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    mom = momentum * mom - lr * g
+    return w + mom, mom
+
+
+@jax.jit
+def _k_nag(w, g, mom, lr, momentum, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    mom = momentum * mom + g
+    return w - lr * (g + momentum * mom), mom
+
+
+@jax.jit
+def _k_adam(w, g, m, v, lr, beta1, beta2, eps, wd, rescale, clip, coef1, coef2):
+    g = _prep(g, w, rescale, clip, wd)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return w - lr_t * m / (jnp.sqrt(v) + eps), m, v
+
+
+@jax.jit
+def _k_adamw(w, g, m, v, lr, beta1, beta2, eps, wd, rescale, clip, coef1, coef2):
+    g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)  # decoupled wd
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(coef2) / coef1
+    return w - lr_t * (m / (jnp.sqrt(v) + eps)) - lr * wd * w, m, v
+
+
+@jax.jit
+def _k_rmsprop(w, g, n, lr, rho, eps, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    n = rho * n + (1 - rho) * jnp.square(g)
+    return w - lr * g / (jnp.sqrt(n) + eps), n
+
+
+@jax.jit
+def _k_rmsprop_alex(w, g, n, gm, delta, lr, rho, momentum, eps, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    n = rho * n + (1 - rho) * jnp.square(g)
+    gm = rho * gm + (1 - rho) * g
+    delta = momentum * delta - lr * g / jnp.sqrt(n - jnp.square(gm) + eps)
+    return w + delta, n, gm, delta
+
+
+@jax.jit
+def _k_adagrad(w, g, h, lr, eps, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    h = h + jnp.square(g)
+    return w - lr * g / (jnp.sqrt(h) + eps), h
+
+
+@jax.jit
+def _k_adadelta(w, g, acc_g, acc_d, rho, eps, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_d = rho * acc_d + (1 - rho) * jnp.square(d)
+    return w - d, acc_g, acc_d
+
+
+@jax.jit
+def _k_ftrl(w, g, z, n, lr, lamda1, beta, wd, rescale, clip):
+    g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    w = jnp.where(jnp.abs(z) > lamda1,
+                  -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n_new)) / lr + wd),
+                  0.0)
+    return w, z, n_new
+
+
+@jax.jit
+def _k_signum(w, g, mom, lr, momentum, wd_lh, wd, rescale, clip):
+    g = _prep(g, w, rescale, clip, wd)
+    mom = momentum * mom - (1 - momentum) * g
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@jax.jit
+def _k_lamb(w, g, m, v, lr, beta1, beta2, eps, wd, rescale, clip, coef1, coef2, lower, upper):
+    """LAMB phase1+phase2 fused (ref: lamb_update_phase1/2 + multi_lamb.cc)."""
+    g = jnp.clip(g.astype(jnp.float32) * rescale, -clip, clip)
+    w32 = w.astype(jnp.float32)
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    m_hat = m / coef1
+    v_hat = v / coef2
+    update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w32
+    wnorm = jnp.linalg.norm(w32)
+    unorm = jnp.linalg.norm(update)
+    ratio = jnp.where((wnorm > 0) & (unorm > 0),
+                      jnp.clip(wnorm, lower, upper) / unorm, 1.0)
+    return (w32 - lr * ratio * update).astype(w.dtype), m, v
+
+
+@jax.jit
+def _k_lars(w, g, mom, lr, momentum, eta, eps, wd, rescale, clip):
+    g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)
+    wnorm = jnp.linalg.norm(w)
+    gnorm = jnp.linalg.norm(g)
+    local_lr = jnp.where((wnorm > 0) & (gnorm > 0),
+                         eta * wnorm / (gnorm + wd * wnorm + eps), 1.0)
+    g = g + wd * w
+    mom = momentum * mom + local_lr * lr * g
+    return w - mom, mom
+
+
+# ---------------------------------------------------------------------- #
+# optimizer classes
+# ---------------------------------------------------------------------- #
+@register
+class SGD(Optimizer):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return jnp.zeros_like(weight._data)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.momentum == 0.0:
+            weight._data = _k_sgd(weight._data, raw(grad), lr, wd, self.rescale_grad, self.clip_gradient)
+            return None
+        weight._data, new_state = _k_sgd_mom(weight._data, raw(grad), state, lr,
+                                             self.momentum, wd, self.rescale_grad, self.clip_gradient)
+        return new_state
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(weight._data)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, new_state = _k_nag(weight._data, raw(grad), state, lr,
+                                         self.momentum, wd, self.rescale_grad, self.clip_gradient)
+        return new_state
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        weight._data, m, v = _k_adam(weight._data, raw(grad), m, v, lr, self.beta1,
+                                     self.beta2, self.epsilon, wd, self.rescale_grad,
+                                     self.clip_gradient, coef1, coef2)
+        return (m, v)
+
+
+@register
+class AdamW(Adam):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        weight._data, m, v = _k_adamw(weight._data, raw(grad), m, v, lr, self.beta1,
+                                      self.beta2, self.epsilon, wd, self.rescale_grad,
+                                      self.clip_gradient, coef1, coef2)
+        return (m, v)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index) / (1.0 - self.beta1 ** t), self._get_wd(index)
+        m, u = state
+        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        weight._data = weight._data - lr * m / (u + 1e-8)
+        return (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
+        mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mom_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule *= mom_t
+        sched1 = self.m_schedule
+        sched2 = self.m_schedule * mom_t1
+        g_prime = g / (1.0 - sched1)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        m_prime = m / (1.0 - sched2)
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mom_t) * g_prime + mom_t1 * m_prime
+        weight._data = weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+        return (m, v)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9, epsilon=1e-8,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon, self.centered = rho, momentum, epsilon, centered
+
+    def create_state(self, index, weight):
+        z = jnp.zeros_like(weight._data)
+        if self.centered:
+            return (z, z, z)
+        return z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, gm, delta = state
+            weight._data, n, gm, delta = _k_rmsprop_alex(
+                weight._data, raw(grad), n, gm, delta, lr, self.rho, self.momentum,
+                self.epsilon, wd, self.rescale_grad, self.clip_gradient)
+            return (n, gm, delta)
+        weight._data, n = _k_rmsprop(weight._data, raw(grad), state, lr, self.rho,
+                                     self.epsilon, wd, self.rescale_grad, self.clip_gradient)
+        return n
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(weight._data)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, h = _k_adagrad(weight._data, raw(grad), state, lr,
+                                     self.float_stable_eps, wd, self.rescale_grad, self.clip_gradient)
+        return h
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_d = state
+        weight._data, acc_g, acc_d = _k_adadelta(weight._data, raw(grad), acc_g, acc_d,
+                                                 self.rho, self.epsilon, wd,
+                                                 self.rescale_grad, self.clip_gradient)
+        return (acc_g, acc_d)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        weight._data, z, n = _k_ftrl(weight._data, raw(grad), z, n, lr, self.lamda1,
+                                     self.beta, wd, self.rescale_grad, self.clip_gradient)
+        return (z, n)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (ref multi_lamb.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound if lower_bound is not None else 0.0
+        self.upper_bound = upper_bound if upper_bound is not None else float("inf")
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, jnp.float32), jnp.zeros(weight.shape, jnp.float32))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        m, v = state
+        coef1 = 1.0 - self.beta1 ** t if self.bias_correction else 1.0
+        coef2 = 1.0 - self.beta2 ** t if self.bias_correction else 1.0
+        weight._data, m, v = _k_lamb(weight._data, raw(grad), m, v, lr, self.beta1,
+                                     self.beta2, self.epsilon, wd, self.rescale_grad,
+                                     self.clip_gradient, coef1, coef2,
+                                     self.lower_bound, self.upper_bound)
+        return (m, v)
+
+
+@register
+class LARS(Optimizer):
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(weight._data)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, mom = _k_lars(weight._data, raw(grad), state, lr, self.momentum,
+                                    self.eta, self.epsilon, wd, self.rescale_grad,
+                                    self.clip_gradient)
+        return mom
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data), weight._data)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, prev = state
+        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
+        mom = self.momentum * mom - lr * (g + self.lamda * g * g * (weight._data - prev))
+        prev = weight._data
+        weight._data = weight._data + mom
+        return (mom, prev)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(weight._data)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        weight._data, mom = _k_signum(weight._data, raw(grad), state, lr, self.momentum,
+                                      self.wd_lh, wd, self.rescale_grad, self.clip_gradient)
+        return mom
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
+        noise = jnp.sqrt(lr) * jax.random.normal(_random.next_key(), weight.shape, weight._data.dtype)
+        weight._data = weight._data - lr / 2 * g + noise
+        return None
+
+
+@register
+class Test(Optimizer):
+    """w -= g (unit-test optimizer, parity with mx.optimizer.Test)."""
+
+    def create_state(self, index, weight):
+        return jnp.zeros_like(weight._data)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._data = weight._data - raw(grad) * self.rescale_grad
+        return state
+
+
+class Updater:
+    """Callable wrapper binding optimizer + per-index states (parity:
+    mx.optimizer.get_updater; used by KVStore server-side updates)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.states[index] = self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps({k: jax.device_get(v) for k, v in self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+
+        self.states = pickle.loads(states)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

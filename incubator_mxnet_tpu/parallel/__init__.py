@@ -1,0 +1,31 @@
+"""Parallelism package: mesh + DP/TP/PP/SP/EP building blocks.
+
+The reference's parallelism inventory (SURVEY.md §2.4) re-built
+TPU-first.  Submodules:
+  mesh        — device mesh + ambient-mesh context
+  collectives — eager-side allreduce/barrier (DCN)
+  sharding    — Megatron-style TP partition rules for Gluon params
+  ring        — ring attention over the `seq` axis (ppermute KV rotation)
+  ulysses     — all_to_all head-scatter sequence parallelism
+  pipeline    — GPipe/1F1B microbatch pipeline over the `pipe` axis
+  moe         — expert-parallel MoE with all_to_all token dispatch
+"""
+from .mesh import (Mesh, PartitionSpec, create_mesh, current_mesh,
+                   default_mesh_devices, mesh_axis_size, named_sharding,
+                   use_mesh)
+from . import collectives
+
+__all__ = ["Mesh", "PartitionSpec", "create_mesh", "current_mesh", "use_mesh",
+           "mesh_axis_size", "named_sharding", "default_mesh_devices",
+           "collectives"]
+
+
+def __getattr__(name):
+    # lazy imports: heavy submodules load on first touch
+    if name in ("ring", "ulysses", "pipeline", "moe", "sharding"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

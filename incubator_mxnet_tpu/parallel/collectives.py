@@ -1,0 +1,45 @@
+"""Collective helpers over ICI/DCN.
+
+Replaces the reference's three transports (comm.h tree reduce, NCCL,
+ps-lite ZMQ — SURVEY.md §5.8) with XLA collectives on the ambient mesh.
+Inside jit/shard_map use `lax.psum` etc. directly; these helpers cover
+the eager/host side: cross-process allreduce for the dist KVStore and a
+barrier for rendezvous parity with the dmlc tracker.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["allreduce_across_processes", "barrier", "initialize_distributed"]
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, **kwargs):
+    """`jax.distributed.initialize` wrapper — replaces the dmlc tracker
+    env-var rendezvous (DMLC_PS_ROOT_URI etc., SURVEY.md §3.5)."""
+    import os
+
+    coordinator_address = coordinator_address or os.environ.get("MXTPU_COORDINATOR")
+    if coordinator_address is None and num_processes is None:
+        return  # single-process
+    jax.distributed.initialize(coordinator_address, num_processes, process_id, **kwargs)
+
+
+def allreduce_across_processes(x: jax.Array) -> jax.Array:
+    """Sum x across all processes (DCN) using a jitted psum over the
+    global device set. Single-process: identity."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x).sum(axis=0)
+
+
+def barrier(name: str = "kvstore_barrier"):
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

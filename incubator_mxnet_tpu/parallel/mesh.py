@@ -1,0 +1,81 @@
+"""Device-mesh management — the spine of all parallelism.
+
+The reference scatters parallelism across KVStore backends, ctx lists
+and `group2ctx` (SURVEY.md §2.4); here every strategy is an axis of ONE
+`jax.sharding.Mesh`:
+
+    data  — data parallel (DCN across slices, ICI within)
+    model — tensor parallel (Megatron-style)
+    pipe  — pipeline stages
+    seq   — sequence/context parallel (ring attention / Ulysses)
+    expert— expert parallel (MoE)
+
+`create_mesh(data=4, model=2)` builds the mesh; `current_mesh()` is the
+ambient mesh used by Trainer/KVStore/shard rules.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["create_mesh", "current_mesh", "use_mesh", "mesh_axis_size",
+           "named_sharding", "PartitionSpec", "Mesh", "default_mesh_devices"]
+
+_CURRENT: Optional[Mesh] = None
+
+AXES = ("data", "model", "pipe", "seq", "expert")
+
+
+def default_mesh_devices(n: Optional[int] = None):
+    devs = jax.devices()
+    return devs[:n] if n else devs
+
+
+def create_mesh(devices=None, **axis_sizes: int) -> Mesh:
+    """create_mesh(data=4, model=2) → Mesh of shape (4,2)."""
+    if not axis_sizes:
+        axis_sizes = {"data": len(devices or jax.devices())}
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(v) for v in axis_sizes.values())
+    total = int(onp.prod(sizes))
+    devs = list(devices or jax.devices())
+    if len(devs) < total:
+        raise ValueError(f"mesh needs {total} devices, only {len(devs)} available")
+    arr = onp.asarray(devs[:total]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    global _CURRENT
+    prev = _CURRENT
+    _CURRENT = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _CURRENT = prev
+
+
+def mesh_axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or _CURRENT
+    if m is None or axis not in m.axis_names:
+        return 1
+    return m.shape[axis]
+
+
+def named_sharding(spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    m = mesh or _CURRENT
+    if m is None:
+        raise RuntimeError("no active mesh; wrap in parallel.use_mesh(...)")
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return NamedSharding(m, spec)

@@ -1,0 +1,94 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the `pipe` axis.
+
+ABSENT in the reference (SURVEY.md §2.4: "build: shard_map stage mesh +
+microbatch lax.scan").  Implementation: every device holds ONE stage's
+params; a lax.scan over (num_microbatches + num_stages - 1) ticks keeps
+all stages busy; activations move stage→stage with a single ppermute
+per tick (ICI neighbor transfer).  The same schedule runs forward AND
+backward when jitted under jax.grad — XLA differentiates through scan
+and ppermute, yielding the 1F1B-equivalent reverse pipeline for free.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_forward", "pipeline_apply"]
+
+
+def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
+                     axis_name: str = "pipe"):
+    """Inside-shard_map GPipe forward.
+
+    stage_fn(params, x) -> y : one stage's compute (same signature all
+    stages — heterogeneous stages dispatch on params).
+    stage_params: this device's stage params (pytree).
+    x_microbatches: (M, mb, ...) — the M microbatches, REPLICATED input;
+    stage 0 consumes them, later stages ignore and take the ring input.
+    Returns (M, mb, ...) outputs valid on the LAST stage.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    total = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    mb_shape = x_microbatches.shape[1:]
+    state = jnp.zeros(mb_shape, x_microbatches.dtype)  # activation in flight
+    outputs = jnp.zeros((M,) + mb_shape, x_microbatches.dtype)
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 injects microbatch t (if any remain); others take ring input
+        inject = x_microbatches[jnp.minimum(t, M - 1)]
+        x_in = jnp.where(idx == 0, inject, state)
+        active = jnp.logical_and(t - idx >= 0, t - idx < M)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, state)
+        # last stage writes its finished microbatch t-(n-1)
+        out_slot = t - (n - 1)
+        is_last = idx == n - 1
+        write = jnp.logical_and(is_last, jnp.logical_and(out_slot >= 0, out_slot < M))
+        outputs = lax.cond(
+            write,
+            lambda o: lax.dynamic_update_index_in_dim(o, y, jnp.maximum(out_slot, 0), 0),
+            lambda o: o,
+            outputs)
+        # rotate activations to the next stage
+        state = lax.ppermute(y, axis_name, perm)
+        return state, outputs
+
+    _, outputs = lax.fori_loop(0, total, tick, (state, outputs))
+    # broadcast final outputs from the last stage to all (psum of masked)
+    mask = (idx == n - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
+                   num_microbatches: int, axis_name: str = "pipe"):
+    """Top-level: split batch into microbatches, shard stage params over
+    `axis_name` (leading axis = stage), run the GPipe schedule.
+
+    all_stage_params: pytree whose leaves have leading dim = n_stages.
+    x: (B, ...) global batch.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    B = x.shape[0]
+    mb = B // num_microbatches
+    xm = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    def inner(params, xmb):
+        local = jax.tree_util.tree_map(lambda p: p[0], params)  # this stage's slice
+        return pipeline_forward(stage_fn, local, xmb, axis_name)
+
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), all_stage_params)
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(param_spec, P()), out_specs=P(), check_rep=False)
+    out = fn(all_stage_params, xm)
+    return out.reshape((B,) + out.shape[2:])

@@ -1,0 +1,79 @@
+"""Tensor-parallel sharding rules for Gluon parameters.
+
+The reference's only model-parallel primitive is `group2ctx` manual
+placement (SURVEY.md §2.4 TP row).  Here: Megatron-style PartitionSpec
+rules assigned by parameter-name pattern — Dense column/row pairs,
+attention QKV column-sharded, output proj row-sharded, embeddings
+vocab-sharded — applied by `shard_params(block, mesh)`, after which any
+jitted step over those arrays gets XLA-inserted ICI collectives.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["TP_RULES_TRANSFORMER", "spec_for", "shard_params", "shard_param_tree",
+           "data_parallel_spec"]
+
+# (name regex, PartitionSpec) — first match wins.  Specs refer to the
+# 'model' mesh axis; params are (out, in) per FullyConnected convention.
+TP_RULES_TRANSFORMER: List[Tuple[str, P]] = [
+    (r".*(query|key|value|qkv).*weight", P("model", None)),   # column parallel
+    (r".*(proj|out_proj|o_proj).*weight", P(None, "model")),  # row parallel
+    (r".*ffn.*(up|gate|inter|fc1|dense1).*weight", P("model", None)),
+    (r".*ffn.*(down|fc2|dense2|out).*weight", P(None, "model")),
+    (r".*embed.*weight", P("model", None)),                   # vocab-sharded
+    (r".*(gamma|beta|bias)$", P()),                           # replicated
+]
+
+
+def spec_for(name: str, shape, rules=None) -> P:
+    rules = rules or TP_RULES_TRANSFORMER
+    for pat, spec in rules:
+        if re.match(pat, name):
+            # drop axes that don't divide; fall back to replication per-axis
+            cleaned = []
+            for dim, ax in zip(shape, list(spec) + [None] * (len(shape) - len(spec))):
+                cleaned.append(ax)
+            return P(*cleaned[:len(shape)])
+    return P()
+
+
+def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None):
+    """Assign NamedShardings to every initialized Parameter of a Block
+    and device_put the arrays accordingly. Returns {name: spec}."""
+    assigned = {}
+    for name, p in block.collect_params().items():
+        if p._data_nd is None:
+            continue
+        spec = spec_for(name, p.shape, rules)
+        spec = _validate(spec, p.shape, mesh)
+        p.sharding = spec
+        sh = NamedSharding(mesh, spec)
+        p._data_nd._data = jax.device_put(p._data_nd._data, sh)
+        if p._data_nd._grad is not None:
+            p._data_nd._grad._data = jax.device_put(p._data_nd._grad._data, sh)
+        assigned[name] = spec
+    return assigned
+
+
+def _validate(spec: P, shape, mesh: Mesh) -> P:
+    axes = []
+    for dim, ax in zip(shape, list(spec) + [None] * (len(shape) - len(spec))):
+        if ax is None or ax not in mesh.axis_names or dim % mesh.shape[ax] != 0:
+            axes.append(None)
+        else:
+            axes.append(ax)
+    return P(*axes)
+
+
+def shard_param_tree(params, mesh: Mesh, spec_tree):
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, spec_tree)
+
+
+def data_parallel_spec(batch_shape, mesh: Mesh, axis: str = "data") -> P:
+    return P(axis, *([None] * (len(batch_shape) - 1)))

@@ -1,0 +1,57 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism via all_to_all.
+
+ABSENT in the reference (SURVEY.md §2.4) — built first-class: with
+sequence sharded over `seq`, redistribute HEADS across the axis around
+the attention block (all_to_all), so each device computes FULL-sequence
+attention for H/n heads, then scatter back.  Comm volume is 2 ·
+all_to_all of activations vs ring's n·ppermute of KV — the low-comm
+choice when H ≥ n.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
+                      scale: Optional[float] = None,
+                      attn_fn: Optional[Callable] = None):
+    """Inside-shard_map. q,k,v: (B, H, Tlocal, D); H divisible by axis size.
+
+    all_to_all: (B, H, T/n, D) → (B, H/n, T, D); full-seq attention on
+    the local head group; inverse all_to_all back to sequence sharding.
+    """
+    n = lax.psum(1, axis_name)
+    # scatter heads (axis 1), gather sequence (axis 2)
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    if attn_fn is None:
+        from ..ops.flash_attention import attention_reference
+
+        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    # inverse: scatter sequence, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, causal: bool = False,
+                              scale: Optional[float] = None, axis_name: str = "seq",
+                              attn_fn: Optional[Callable] = None):
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal,
+                          scale=scale, attn_fn=attn_fn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+    return fn(q, k, v)

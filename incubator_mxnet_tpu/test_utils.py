@@ -1,0 +1,194 @@
+"""Test utilities — the numeric oracle machinery.
+
+Re-design of `python/mxnet/test_utils.py` [UNVERIFIED] (SURVEY.md §4):
+`assert_almost_equal` with per-dtype tolerances,
+`check_numeric_gradient` (finite differences — the reference's main
+gradient oracle), `check_consistency` (cross-backend cpu↔tpu↔bf16,
+replacing cpu↔gpu), `default_context`, `rand_ndarray`, `with_seed`
+(seed printed on failure for replay — reproducibility parity).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import random as _pyrandom
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from . import context as ctx_mod
+from . import random as _random
+from .ndarray.ndarray import NDArray, raw, wrap
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "default_context",
+           "set_default_context", "rand_ndarray", "rand_shape_nd",
+           "check_numeric_gradient", "check_consistency", "with_seed",
+           "default_rtols", "default_atols", "effective_dtype"]
+
+_DEFAULT_CTX = None
+
+default_rtols = {
+    onp.dtype(onp.float16): 1e-2,
+    onp.dtype(onp.float32): 1e-4,
+    onp.dtype(onp.float64): 1e-6,
+    onp.dtype(onp.int32): 0,
+    onp.dtype(onp.int64): 0,
+    "bfloat16": 2e-2,
+}
+default_atols = {
+    onp.dtype(onp.float16): 1e-3,
+    onp.dtype(onp.float32): 1e-5,
+    onp.dtype(onp.float64): 1e-8,
+    onp.dtype(onp.int32): 0,
+    onp.dtype(onp.int64): 0,
+    "bfloat16": 1e-2,
+}
+
+
+def effective_dtype(arr):
+    if isinstance(arr, NDArray):
+        if arr._data.dtype == jnp.bfloat16:
+            return "bfloat16"
+        return onp.dtype(str(arr._data.dtype))
+    return onp.asarray(arr).dtype
+
+
+def default_context() -> ctx_mod.Context:
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is not None:
+        return _DEFAULT_CTX
+    return ctx_mod.tpu() if ctx_mod.num_tpus() > 0 else ctx_mod.cpu()
+
+
+def set_default_context(ctx):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        if a._data.dtype == jnp.bfloat16:
+            return onp.asarray(a._data.astype(jnp.float32))
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+def same(a, b) -> bool:
+    return onp.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
+    an, bn = _to_np(a), _to_np(b)
+    dt = effective_dtype(a if isinstance(a, NDArray) else wrap(onp.asarray(a)))
+    rtol = rtol if rtol is not None else default_rtols.get(dt, 1e-4)
+    atol = atol if atol is not None else default_atols.get(dt, 1e-5)
+    return onp.allclose(an, bn, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"), equal_nan=False):
+    an, bn = _to_np(a), _to_np(b)
+    dt = effective_dtype(a if isinstance(a, NDArray) else wrap(onp.asarray(an)))
+    rtol = rtol if rtol is not None else default_rtols.get(dt, 1e-4)
+    atol = atol if atol is not None else default_atols.get(dt, 1e-5)
+    if not onp.allclose(an, bn, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        err = onp.abs(an - bn)
+        rel = err / (onp.abs(bn) + atol)
+        raise AssertionError(
+            f"Arrays {names[0]} and {names[1]} not almost equal "
+            f"(rtol={rtol}, atol={atol}): max abs err {err.max():.6g}, "
+            f"max rel err {rel.max():.6g}\n{names[0]}={an}\n{names[1]}={bn}")
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 ctx=None, scale=1.0) -> NDArray:
+    if stype != "default":
+        raise ValueError("sparse stypes are de-scoped on TPU (SURVEY.md §8)")
+    arr = onp.random.uniform(-scale, scale, size=shape).astype(dtype)
+    return NDArray(jnp.asarray(arr))
+
+
+def check_numeric_gradient(f: Callable, inputs: List[NDArray],
+                           analytic_grads: Optional[List] = None,
+                           eps: float = 1e-3, rtol: float = 1e-2, atol: float = 1e-3):
+    """Finite-difference gradient check (the reference oracle).
+
+    `f(*inputs) -> NDArray scalar-or-tensor`; compares numeric grads of
+    sum(f) against autograd's.
+    """
+    from . import autograd
+
+    inputs = [wrap(i) for i in inputs]
+    if analytic_grads is None:
+        for i in inputs:
+            i.attach_grad()
+        with autograd.record():
+            out = f(*inputs)
+            s = out.sum() if out.ndim > 0 else out
+        s.backward()
+        analytic_grads = [i.grad.asnumpy() for i in inputs]
+
+    for idx, inp in enumerate(inputs):
+        base = inp.asnumpy().astype("float64")
+        num_grad = onp.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(_to_np(f(*[NDArray(jnp.asarray(base.astype("float32"))) if k == idx else inputs[k]
+                                  for k in range(len(inputs))]).sum()))
+            flat[j] = orig - eps
+            fm = float(_to_np(f(*[NDArray(jnp.asarray(base.astype("float32"))) if k == idx else inputs[k]
+                                  for k in range(len(inputs))]).sum()))
+            flat[j] = orig
+            ng_flat[j] = (fp - fm) / (2 * eps)
+        assert_almost_equal(analytic_grads[idx], num_grad.astype("float32"),
+                            rtol=rtol, atol=atol,
+                            names=(f"analytic_grad[{idx}]", f"numeric_grad[{idx}]"))
+
+
+def check_consistency(fn: Callable, inputs: List[onp.ndarray],
+                      dtypes=("float32", "bfloat16"), rtol=None, atol=None):
+    """Cross-backend/dtype consistency (replaces cpu-vs-gpu
+    check_consistency, SURVEY.md §4 conclusion 3): runs `fn` under each
+    dtype and compares against the widest result."""
+    results = []
+    for dt in dtypes:
+        cast = [NDArray(jnp.asarray(i, dtype=jnp.bfloat16 if dt == "bfloat16" else jnp.dtype(dt)))
+                for i in inputs]
+        out = fn(*cast)
+        results.append(_to_np(out).astype("float32"))
+    ref = results[0]
+    for dt, res in zip(dtypes[1:], results[1:]):
+        r = rtol if rtol is not None else default_rtols.get(dt if dt == "bfloat16" else onp.dtype(dt), 1e-2)
+        a = atol if atol is not None else default_atols.get(dt if dt == "bfloat16" else onp.dtype(dt), 1e-2)
+        assert_almost_equal(ref, res, rtol=r, atol=a, names=("ref", f"{dt}"))
+    return results
+
+
+def with_seed(seed=None):
+    """Decorator: seed all RNGs; print the seed on failure for replay."""
+
+    def decorator(test_fn):
+        @functools.wraps(test_fn)
+        def wrapper(*args, **kwargs):
+            actual = seed if seed is not None else onp.random.randint(0, 2 ** 31)
+            onp.random.seed(actual)
+            _pyrandom.seed(actual)
+            _random.seed(actual)
+            try:
+                return test_fn(*args, **kwargs)
+            except Exception:
+                print(f"*** with_seed: test failed with seed={actual}; "
+                      f"reproduce with @with_seed({actual})")
+                raise
+
+        return wrapper
+
+    return decorator

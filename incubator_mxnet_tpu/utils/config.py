@@ -1,0 +1,69 @@
+"""Typed config registry unifying env overrides + feature report.
+
+Re-design of the reference's three config mechanisms (SURVEY.md §5.6):
+~80 `MXNET_*` env knobs (`dmlc::GetEnv`), `dmlc::Parameter` typed
+structs, and build-time feature flags.  Here: one dataclass-style
+registry; env names keep the MXNET_ prefix where behavior parity
+matters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["Knob", "knobs", "get", "describe"]
+
+
+@dataclasses.dataclass
+class Knob:
+    name: str
+    default: Any
+    dtype: type
+    doc: str
+
+    def value(self):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        if self.dtype is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        return self.dtype(raw)
+
+
+_KNOBS: Dict[str, Knob] = {}
+
+
+def _k(name, default, dtype, doc):
+    _KNOBS[name] = Knob(name, default, dtype, doc)
+
+
+# behavior-parity knobs (subset of the reference's env_var.md list)
+_k("MXNET_ENGINE_TYPE", "XLA", str,
+   "Engine selection. 'NaiveEngine' → synchronous debug mode (jit disabled), "
+   "anything else → XLA async dispatch (the default engine).")
+_k("MXNET_EXEC_BULK_EXEC_INFERENCE", True, bool, "kept for parity; XLA always bulks")
+_k("MXNET_GPU_MEM_POOL_TYPE", "xla_bfc", str, "kept for parity; XLA BFC allocator")
+_k("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
+   "arrays above this get sharded collectives in the kvstore facade")
+_k("MXNET_USE_FUSION", True, bool, "kept for parity; XLA fuses always")
+_k("MXNET_SAFE_ACCUMULATION", True, bool, "accumulate bf16 reductions in fp32")
+_k("MXNET_ENFORCE_DETERMINISM", False, bool, "forbid nondeterministic reductions")
+_k("MXTPU_DEFAULT_DTYPE", "float32", str, "default parameter dtype")
+_k("MXTPU_AMP_DTYPE", "bfloat16", str, "AMP low-precision dtype (TPU: bf16)")
+_k("MXTPU_MESH_SHAPE", "", str, "default mesh axes, e.g. 'data=8' or 'data=4,model=2'")
+
+
+def knobs() -> Dict[str, Knob]:
+    return dict(_KNOBS)
+
+
+def get(name: str):
+    return _KNOBS[name].value()
+
+
+def describe() -> str:
+    lines = []
+    for k in _KNOBS.values():
+        lines.append(f"{k.name} (default {k.default!r}): {k.doc}")
+    return "\n".join(lines)

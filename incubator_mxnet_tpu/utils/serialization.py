@@ -1,0 +1,127 @@
+"""NDArray binary serialization — the `.params` file codec.
+
+Re-design of the reference NDArray file format
+(`src/ndarray/ndarray.cc` `NDArray::Save/Load` + `mx.nd.save/load`
+C API list format [UNVERIFIED], SURVEY.md §5.4): little-endian
+dmlc::Stream-style layout —
+
+    uint64 kMXAPINDArrayListMagic = 0x112
+    uint64 reserved = 0
+    uint64 ndarray_count
+    per array:  uint64 NDARRAY_MAGIC = 0xF993FAC9
+                uint32 shape_ndim, uint32[ndim] shape (int64 dims as u64 when >2^31? kept u32)
+                int32  dev_type, int32 dev_id
+                int32  type_flag (mshadow code)
+                raw data bytes
+    uint64 name_count, then dmlc strings (uint64 len + bytes)
+
+Exact byte-compat with every MXNet minor version could not be verified
+against the (empty) reference mount — the layout above follows the
+documented upstream format; §9 of SURVEY.md tracks re-verification.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Union
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC = 0xF993FAC9
+
+# mshadow type codes (ref: 3rdparty/mshadow/mshadow/base.h [UNVERIFIED])
+_DTYPE_TO_CODE = {
+    onp.dtype("float32"): 0,
+    onp.dtype("float64"): 1,
+    onp.dtype("float16"): 2,
+    onp.dtype("uint8"): 3,
+    onp.dtype("int32"): 4,
+    onp.dtype("int8"): 5,
+    onp.dtype("int64"): 6,
+    onp.dtype("bool"): 7,
+}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+_BF16_CODE = 12  # extension: bfloat16 (TPU-native dtype, not in upstream table)
+
+
+def _np_of(arr) -> onp.ndarray:
+    if isinstance(arr, NDArray):
+        if arr._data.dtype == jnp.bfloat16:
+            return onp.asarray(arr._data).view(onp.uint16), True
+        return arr.asnumpy(), False
+    a = onp.asarray(arr)
+    return a, False
+
+
+def _write_ndarray(f, arr):
+    data, is_bf16 = _np_of(arr)
+    f.write(struct.pack("<Q", _ND_MAGIC))
+    f.write(struct.pack("<I", data.ndim))
+    for s in data.shape:
+        f.write(struct.pack("<I", s))
+    f.write(struct.pack("<ii", 1, 0))  # dev_type=cpu, dev_id=0
+    code = _BF16_CODE if is_bf16 else _DTYPE_TO_CODE[data.dtype]
+    f.write(struct.pack("<i", code))
+    f.write(onp.ascontiguousarray(data).tobytes())
+
+
+def _read_ndarray(f) -> NDArray:
+    (magic,) = struct.unpack("<Q", f.read(8))
+    if magic != _ND_MAGIC:
+        raise MXNetError(f"bad ndarray magic {magic:#x}")
+    (ndim,) = struct.unpack("<I", f.read(4))
+    shape = tuple(struct.unpack("<I", f.read(4))[0] for _ in range(ndim))
+    _devt, _devid = struct.unpack("<ii", f.read(8))
+    (code,) = struct.unpack("<i", f.read(4))
+    if code == _BF16_CODE:
+        n = int(onp.prod(shape)) if shape else 1
+        buf = onp.frombuffer(f.read(n * 2), dtype=onp.uint16).reshape(shape)
+        return NDArray(jnp.asarray(buf).view(jnp.bfloat16))
+    dtype = _CODE_TO_DTYPE[code]
+    n = int(onp.prod(shape)) if shape else 1
+    buf = onp.frombuffer(f.read(n * dtype.itemsize), dtype=dtype).reshape(shape)
+    return NDArray(jnp.asarray(buf))
+
+
+def save_ndarrays(fname: str, data: Union[Dict[str, NDArray], List[NDArray], NDArray]):
+    if isinstance(data, NDArray):
+        data = [data]
+    names: List[str] = []
+    arrays: List = []
+    if isinstance(data, dict):
+        for k, v in data.items():
+            names.append(k)
+            arrays.append(v)
+    else:
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_ndarray(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load_ndarrays(fname: str):
+    with open(fname, "rb") as f:
+        magic, _res = struct.unpack("<QQ", f.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError(f"Invalid NDArray file format magic {magic:#x} in {fname}")
+        (count,) = struct.unpack("<Q", f.read(8))
+        arrays = [_read_ndarray(f) for _ in range(count)]
+        (ncount,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(ncount):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

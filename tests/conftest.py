@@ -1,0 +1,35 @@
+"""Test harness config (SURVEY.md §4 conclusions):
+
+- force the CPU backend with 8 virtual devices
+  (`xla_force_host_platform_device_count`) so every DP/TP/PP/SP/EP test
+  runs on a faked mesh with no TPU — the translation of the reference's
+  `tools/launch.py --launcher local` multi-process-on-one-host testing.
+- must run BEFORE any computation: jax is preloaded by the image's
+  sitecustomize and the default platform would claim the TPU tunnel.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    import incubator_mxnet_tpu.parallel as par
+
+    return par.create_mesh(data=8)
+
+
+@pytest.fixture
+def mesh42():
+    import incubator_mxnet_tpu.parallel as par
+
+    return par.create_mesh(data=4, model=2)

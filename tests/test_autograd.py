@@ -1,0 +1,167 @@
+"""Autograd tests (model: tests/python/unittest/test_autograd.py,
+SURVEY.md §4 — finite differences are the gradient oracle)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_numeric_gradient, with_seed)
+
+
+def test_basic_backward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = mx.nd.array([[0.5, -0.5], [1.0, -1.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.exp(mx.nd.sin(x)).sum()
+    y.backward()
+    expected = onp.exp(onp.sin(x.asnumpy())) * onp.cos(x.asnumpy())
+    assert_almost_equal(x.grad, expected)
+
+
+def test_two_inputs():
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, onp.array([30.0, 300.0]))
+
+
+def test_grad_req_add():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (2 * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0, 6.0]))
+
+
+def test_detach():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y.detach() * x  # gradient must not flow through detached y
+        s = z.sum()
+    s.backward()
+    assert_almost_equal(x.grad, onp.array([6.0]))  # d/dx (6*x) = 6
+
+
+def test_pause():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = (y + z.detach()).sum()
+    w.backward()
+    assert_almost_equal(x.grad, onp.array([2.0]))
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record(train_mode=True):
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_autograd_grad_api():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    (g,) = autograd.grad([y], [x])
+    assert_almost_equal(g, 3 * x.asnumpy() ** 2)
+
+
+def test_mark_variables():
+    x = mx.nd.array([2.0])
+    g = mx.nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 5).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([5.0]))
+
+
+def test_getitem_grad():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x[0].sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([[1.0, 1.0], [0.0, 0.0]]))
+
+
+def test_multi_output_op_grad():
+    x = mx.nd.array([[1.0, 2.0, 3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        parts = mx.nd.split(x, 2, axis=1)
+        y = (parts[0] * 2 + parts[1] * 3).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([[2.0, 2.0, 3.0, 3.0]]))
+
+
+@with_seed(0)
+def test_numeric_gradient():
+    def f(a):
+        return mx.nd.tanh(mx.nd.dot(a, a))
+
+    a = mx.nd.array(onp.random.rand(3, 3).astype("f") * 0.5)
+    check_numeric_gradient(f, [a])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self._x = x.asnumpy()
+            return x * x
+
+        def backward(self, dy):
+            return dy * mx.nd.array(2 * self._x)
+
+    sq = Square()
+    x = mx.nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = sq(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([4.0, 6.0]))
+
+
+def test_inplace_on_recorded_raises():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        with pytest.raises(mx.MXNetError):
+            x[:] = 0.0

@@ -1,0 +1,213 @@
+"""Gluon core tests (model: tests/python/unittest/test_gluon.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert p.data().shape == (3, 4)
+    assert_almost_equal(p.data(), onp.ones((3, 4)))
+    assert p.list_data()[0] is p.data()
+    p.set_data(mx.nd.zeros((3, 4)))
+    assert_almost_equal(p.data(), onp.zeros((3, 4)))
+    assert p.grad() is not None
+
+
+def test_parameter_deferred_init():
+    net = nn.Dense(8)
+    net.initialize()
+    with pytest.raises(Exception):
+        net.weight.data()  # deferred until first forward
+    out = net(mx.nd.ones((2, 5)))
+    assert net.weight.shape == (8, 5)
+    assert out.shape == (2, 8)
+
+
+def test_dense_flatten():
+    net = nn.Dense(4, flatten=True)
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 5)))
+    assert out.shape == (2, 4)
+    assert net.weight.shape == (4, 15)
+    net2 = nn.Dense(4, flatten=False)
+    net2.initialize()
+    out2 = net2(mx.nd.ones((2, 3, 5)))
+    assert out2.shape == (2, 3, 4)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    out = net(mx.nd.ones((4, 10)))
+    assert out.shape == (4, 8)
+    assert len(net) == 2
+    assert net[0]._units == 16
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+
+
+def test_block_naming():
+    net1 = nn.Dense(2)
+    net2 = nn.Dense(2)
+    assert net1.prefix != net2.prefix
+    named = nn.Dense(2, prefix="custom_")
+    assert named.prefix == "custom_"
+    assert named.weight.name == "custom_weight"
+
+
+def test_gradient_flow_through_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.ones((4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    for name, p in net.collect_params().items():
+        g = p.grad().asnumpy()
+        assert g.shape == p.shape
+    # at least the output layer weight grad must be nonzero
+    assert onp.abs(net[1].weight.grad().asnumpy()).sum() > 0
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(onp.random.rand(3, 7).astype("f"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    compiled = net(x).asnumpy()
+    assert_almost_equal(eager, compiled, rtol=1e-5, atol=1e-6)
+    # changed shape triggers transparent re-specialization (CachedOp cache)
+    y = mx.nd.array(onp.random.rand(5, 7).astype("f"))
+    assert net(y).shape == (5, 4)
+
+
+def test_hybridize_backward():
+    net = nn.Dense(3)
+    net.initialize(mx.init.One())
+    net.hybridize()
+    x = mx.nd.ones((2, 4))
+    with autograd.record():
+        loss = (net(x) * 2).sum()
+    loss.backward()
+    assert_almost_equal(net.weight.grad(), 4 * onp.ones((3, 4)))
+    assert_almost_equal(net.bias.grad(), 4 * onp.ones(3))
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(onp.random.rand(8, 3, 4, 4).astype("f") * 5)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert onp.abs(rm).sum() > 0  # updated from zeros
+    # inference mode uses running stats, output differs from training
+    out_train_mean = bn(x).asnumpy().mean()
+    assert onp.isfinite(out_train_mean)
+
+
+def test_batchnorm_hybrid_state_channel():
+    bn = nn.BatchNorm(in_channels=2)
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.array(onp.random.rand(4, 2, 3, 3).astype("f") * 2 + 1)
+    with autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert onp.abs(rm).sum() > 0  # state flowed out of the jitted program
+
+
+def test_dropout_train_vs_eval():
+    do = nn.Dropout(0.5)
+    x = mx.nd.ones((100, 100))
+    out_eval = do(x)
+    assert_almost_equal(out_eval, x.asnumpy())  # identity in inference
+    with autograd.record():
+        out_train = do(x)
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array([[1, 2], [3, 4]]))
+    assert out.shape == (2, 2, 4)
+
+
+def test_layernorm_math():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = mx.nd.array(onp.random.rand(2, 6).astype("f") * 3)
+    out = ln(x).asnumpy()
+    assert_almost_equal(out.mean(axis=-1), onp.zeros(2), atol=1e-5)
+    assert_almost_equal(out.std(axis=-1), onp.ones(2), rtol=1e-2, atol=1e-2)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.ones((2, 5))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "model.params")
+    net.save_parameters(path)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net2.initialize()
+    net2(x)  # resolve shapes
+    net2.load_parameters(path)
+    assert_almost_equal(net2(x), ref)
+
+
+def test_activations():
+    x = mx.nd.array([[-1.0, 0.0, 1.0]])
+    for act, fn in [(nn.Activation("relu"), lambda v: onp.maximum(v, 0)),
+                    (nn.LeakyReLU(0.1), lambda v: onp.where(v > 0, v, 0.1 * v)),
+                    (nn.ELU(1.0), lambda v: onp.where(v > 0, v, onp.exp(v) - 1))]:
+        assert_almost_equal(act(x), fn(x.asnumpy()), rtol=1e-4, atol=1e-5)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    out = prelu(x)
+    assert_almost_equal(out, onp.where(x.asnumpy() > 0, x.asnumpy(), 0.25 * x.asnumpy()))
+    g = nn.GELU()
+    assert g(x).shape == (1, 3)
+    s = nn.Swish()
+    assert s(x).shape == (1, 3)
+
+
+def test_cast_bf16():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("bfloat16")
+    assert "bfloat16" in str(net.weight.data()._data.dtype)
+    out = net(mx.nd.ones((2, 3)).astype("bfloat16"))
+    assert "bfloat16" in str(out._data.dtype)
+
+
+def test_block_summary_and_repr():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    s = net.summary()
+    assert "Dense" in s
+    assert "HybridSequential" in repr(net)
+
+
+def test_constant_param():
+    c = gluon.Constant("const", onp.array([1.0, 2.0]))
+    assert_almost_equal(c.data(), onp.array([1.0, 2.0]))
+    assert c.grad_req == "null"

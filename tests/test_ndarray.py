@@ -1,0 +1,241 @@
+"""NDArray + op namespace tests (model: tests/python/unittest/test_ndarray.py
++ test_operator.py — numeric oracle is NumPy, SURVEY.md §4)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == onp.float32
+    b = mx.nd.ones((2, 3))
+    assert_almost_equal(b, onp.ones((2, 3)))
+    c = mx.nd.full((2, 2), 7.0)
+    assert_almost_equal(c, onp.full((2, 2), 7.0))
+    d = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(d, onp.arange(0, 10, 2, dtype="float32"))
+    e = mx.nd.array([[1, 2], [3, 4]])
+    assert e.dtype == onp.float32  # float64 source downcast like reference
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[5.0, 6.0], [7.0, 8.0]])
+    an, bn = a.asnumpy(), b.asnumpy()
+    assert_almost_equal(a + b, an + bn)
+    assert_almost_equal(a - b, an - bn)
+    assert_almost_equal(a * b, an * bn)
+    assert_almost_equal(a / b, an / bn)
+    assert_almost_equal(a ** 2, an ** 2)
+    assert_almost_equal(2 - a, 2 - an)
+    assert_almost_equal(2 / a, 2 / an)
+    assert_almost_equal(-a, -an)
+    assert_almost_equal(abs(-a), an)
+
+
+def test_inplace_mutation():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert_almost_equal(a, 2 * onp.ones((2, 2)))
+    a *= 3
+    assert_almost_equal(a, 6 * onp.ones((2, 2)))
+    a[:] = 0.5
+    assert_almost_equal(a, 0.5 * onp.ones((2, 2)))
+    a[0, 0] = 9.0
+    assert a.asnumpy()[0, 0] == 9.0
+
+
+def test_indexing():
+    a = mx.nd.array(onp.arange(24).reshape(2, 3, 4))
+    an = a.asnumpy()
+    assert_almost_equal(a[1], an[1])
+    assert_almost_equal(a[:, 1], an[:, 1])
+    assert_almost_equal(a[0, 1:3], an[0, 1:3])
+    assert_almost_equal(a[:, :, -1], an[:, :, -1])
+
+
+def test_dot_semantics():
+    a = mx.nd.array(onp.random.rand(3, 4).astype("f"))
+    b = mx.nd.array(onp.random.rand(4, 5).astype("f"))
+    assert_almost_equal(mx.nd.dot(a, b), a.asnumpy() @ b.asnumpy())
+    # transpose flags
+    assert_almost_equal(mx.nd.dot(a, b.T, transpose_b=True), a.asnumpy() @ b.asnumpy())
+    # batch_dot
+    x = mx.nd.array(onp.random.rand(2, 3, 4).astype("f"))
+    y = mx.nd.array(onp.random.rand(2, 4, 5).astype("f"))
+    assert_almost_equal(mx.nd.batch_dot(x, y), x.asnumpy() @ y.asnumpy())
+
+
+def test_reductions():
+    a = mx.nd.array(onp.random.rand(3, 4, 5).astype("f"))
+    an = a.asnumpy()
+    assert_almost_equal(a.sum(), an.sum())
+    assert_almost_equal(a.sum(axis=1), an.sum(axis=1))
+    assert_almost_equal(mx.nd.sum(a, axis=[0, 2]), an.sum(axis=(0, 2)))
+    assert_almost_equal(mx.nd.sum(a, axis=1, exclude=True), an.sum(axis=(0, 2)))
+    assert_almost_equal(a.mean(axis=0, keepdims=True), an.mean(axis=0, keepdims=True))
+    assert_almost_equal(a.max(), an.max())
+    assert_almost_equal(mx.nd.norm(a), onp.linalg.norm(an.ravel()))
+
+
+def test_shape_ops():
+    a = mx.nd.array(onp.arange(24).reshape(2, 3, 4).astype("f"))
+    an = a.asnumpy()
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape(0, -1).shape == (2, 12)  # 0 = copy dim (MXNet semantics)
+    assert_almost_equal(a.transpose(), an.T)
+    assert_almost_equal(mx.nd.transpose(a, axes=(2, 0, 1)), an.transpose(2, 0, 1))
+    assert_almost_equal(a.swapaxes(0, 2), an.swapaxes(0, 2))
+    assert_almost_equal(mx.nd.expand_dims(a, axis=1), an[:, None])
+    assert_almost_equal(mx.nd.flatten(a), an.reshape(2, -1))
+    assert_almost_equal(mx.nd.tile(a, (2, 1, 1)), onp.tile(an, (2, 1, 1)))
+    assert_almost_equal(mx.nd.repeat(a, 2, axis=1), onp.repeat(an, 2, axis=1))
+    assert_almost_equal(mx.nd.flip(a, axis=1), an[:, ::-1])
+
+
+def test_concat_split_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=1)
+    assert c.shape == (2, 6)
+    c0 = mx.nd.concat(a, b, dim=0)
+    assert c0.shape == (4, 3)
+    s = mx.nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = mx.nd.split(c, num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    assert_almost_equal(parts[0], a.asnumpy())
+    parts2 = mx.nd.split(c, 3, axis=1, squeeze_axis=False)
+    assert parts2[0].shape == (2, 2)
+
+
+def test_slice_ops():
+    a = mx.nd.array(onp.arange(20).reshape(4, 5).astype("f"))
+    an = a.asnumpy()
+    assert_almost_equal(mx.nd.slice(a, begin=(1, 0), end=(3, 4)), an[1:3, 0:4])
+    assert_almost_equal(mx.nd.slice_axis(a, axis=1, begin=1, end=4), an[:, 1:4])
+    b = mx.nd.zeros((2, 3))
+    assert_almost_equal(mx.nd.slice_like(a, b), an[:2, :3])
+
+
+def test_indexing_ops():
+    a = mx.nd.array(onp.random.rand(5, 4).astype("f"))
+    idx = mx.nd.array([0, 2, 4])
+    assert_almost_equal(mx.nd.take(a, idx), a.asnumpy()[[0, 2, 4]])
+    oh = mx.nd.one_hot(mx.nd.array([1, 0, 2]), 3)
+    assert_almost_equal(oh, onp.eye(3, dtype="f")[[1, 0, 2]])
+    picked = mx.nd.pick(a, mx.nd.array([0, 1, 2, 3, 0]), axis=1)
+    assert_almost_equal(picked, a.asnumpy()[onp.arange(5), [0, 1, 2, 3, 0]])
+    emb = mx.nd.Embedding(mx.nd.array([1, 3]), a, input_dim=5, output_dim=4)
+    assert_almost_equal(emb, a.asnumpy()[[1, 3]])
+
+
+def test_ordering_ops():
+    a = mx.nd.array([[3.0, 1.0, 2.0], [0.5, 2.5, 1.5]])
+    assert_almost_equal(mx.nd.sort(a), onp.sort(a.asnumpy()))
+    assert_almost_equal(mx.nd.sort(a, is_ascend=False), -onp.sort(-a.asnumpy()))
+    assert_almost_equal(mx.nd.argsort(a), onp.argsort(a.asnumpy()).astype("f"))
+    topv, topi = mx.nd.topk(a, k=2, ret_typ="both")
+    assert topv.shape == (2, 2)
+    assert_almost_equal(topv, -onp.sort(-a.asnumpy())[:, :2])
+
+
+def test_broadcast_ops():
+    a = mx.nd.ones((2, 1, 3))
+    b = mx.nd.ones((1, 4, 3)) * 2
+    assert mx.nd.broadcast_add(a, b).shape == (2, 4, 3)
+    assert_almost_equal(mx.nd.broadcast_mul(a, b), 2 * onp.ones((2, 4, 3)))
+    assert mx.nd.broadcast_to(mx.nd.ones((1, 3)), (5, 3)).shape == (5, 3)
+    assert_almost_equal(mx.nd.broadcast_maximum(a, b), 2 * onp.ones((2, 4, 3)))
+
+
+def test_elementwise_math():
+    a = mx.nd.array(onp.random.rand(3, 3).astype("f") + 0.5)
+    an = a.asnumpy()
+    for name, ref in [("exp", onp.exp), ("log", onp.log), ("sqrt", onp.sqrt),
+                      ("square", onp.square), ("sigmoid", lambda x: 1 / (1 + onp.exp(-x))),
+                      ("tanh", onp.tanh), ("floor", onp.floor), ("ceil", onp.ceil),
+                      ("sign", onp.sign), ("sin", onp.sin), ("cos", onp.cos)]:
+        assert_almost_equal(getattr(mx.nd, name)(a), ref(an), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mx.nd.clip(a, 0.6, 1.0), onp.clip(an, 0.6, 1.0))
+    assert_almost_equal(mx.nd.rsqrt(a), 1 / onp.sqrt(an), rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_ops():
+    x = mx.nd.array(onp.arange(24).reshape(4, 2, 3).astype("f"))  # (T,B,C)
+    vl = mx.nd.array([2, 3])
+    masked = mx.nd.SequenceMask(x, vl, use_sequence_length=True, value=-1.0)
+    mn = masked.asnumpy()
+    assert (mn[2:, 0] == -1).all() and (mn[3:, 1] == -1).all()
+    last = mx.nd.SequenceLast(x, vl, use_sequence_length=True)
+    assert_almost_equal(last, x.asnumpy()[[1, 2], [0, 1]])
+    rev = mx.nd.SequenceReverse(x, vl, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x.asnumpy()[1, 0])
+
+
+def test_where_and_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(a > b, (a.asnumpy() > b.asnumpy()).astype("f"))
+    assert_almost_equal(mx.nd.where(a > b, a, b), onp.maximum(a.asnumpy(), b.asnumpy()))
+
+
+def test_jnp_fallback():
+    # anything not explicitly defined falls through to jax.numpy
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert_almost_equal(mx.nd.cumsum(a, axis=1), onp.cumsum(a.asnumpy(), axis=1))
+    assert_almost_equal(mx.nd.diag(a), onp.diag(a.asnumpy()))
+
+
+def test_linalg():
+    a = onp.random.rand(3, 3).astype("f")
+    spd = a @ a.T + 3 * onp.eye(3, dtype="f")
+    L = mx.nd.linalg.potrf(mx.nd.array(spd))
+    assert_almost_equal(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(mx.nd.linalg.det(mx.nd.array(spd)), onp.linalg.det(spd),
+                        rtol=1e-3, atol=1e-3)
+    g = mx.nd.linalg.gemm2(mx.nd.array(a), mx.nd.array(spd), alpha=2.0)
+    assert_almost_equal(g, 2 * a @ spd, rtol=1e-4, atol=1e-4)
+
+
+def test_control_flow():
+    # foreach == scan
+    data = mx.nd.array(onp.arange(6).reshape(3, 2).astype("f"))
+    out, final = mx.nd.contrib.foreach(
+        lambda x, s: (x + s[0], [x + s[0]]), data, [mx.nd.zeros((2,))])
+    assert_almost_equal(final[0], onp.array([6.0, 9.0]))
+    # while_loop
+    _, loop_vars = mx.nd.contrib.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (i + 1, s + i),
+        [mx.nd.array([0.0]), mx.nd.array([0.0])], max_iterations=10)
+    assert_almost_equal(loop_vars[1], onp.array([10.0]))
+    # cond
+    out = mx.nd.contrib.cond(mx.nd.array([1.0]),
+                             lambda x: x * 2, lambda x: x * 3, [mx.nd.array([5.0])])
+    assert_almost_equal(out, onp.array([10.0]))
+
+
+def test_context_and_sync():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context.device_type in ("cpu", "tpu")
+    a.wait_to_read()
+    mx.nd.waitall()
+    b = a.as_in_context(mx.cpu())
+    assert_almost_equal(a, b)
+    assert a.copy().shape == (2, 2)
+    s = mx.nd.array([3.14])
+    assert abs(s.asscalar() - 3.14) < 1e-6
+
+
+def test_dtype_cast():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("float16")
+    assert str(b.dtype) == "float16"
+    c = mx.nd.cast(a, "int32")
+    assert str(c.dtype) == "int32"
+    bf = a.astype("bfloat16")
+    assert "bfloat16" in str(bf._data.dtype)
