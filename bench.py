@@ -1,0 +1,137 @@
+"""Flagship benchmark: BERT-large MLM pretraining step throughput → MFU.
+
+Mirrors the reference's headline BERT-large phase-1 (seq 128) training
+benchmark (BASELINE.md; GluonNLP `scripts/bert` era) as a fully fused
+jitted train step: bf16 compute, fp32 master weights, flash-attention
+Pallas kernel, momentum SGD, buffer donation.  North star
+(BASELINE.json): ≥40% MFU — `vs_baseline` = measured_MFU / 0.40.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+# bf16 peak FLOP/s per chip by device kind substring
+_PEAKS = [
+    ("v6e", 918e12), ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for sub, peak in _PEAKS:
+        if sub in kind:
+            return peak
+    return 1e12  # unknown accelerator / CPU: nominal 1 TFLOP/s
+
+
+def main():
+    on_cpu = "cpu" in sys.argv
+    if on_cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=1")
+    import jax
+
+    if on_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.block import functionalize
+    from incubator_mxnet_tpu.models import bert
+
+    dev = jax.devices()[0]
+    is_tpu = dev.platform == "tpu" or "tpu" in getattr(dev, "device_kind", "").lower() \
+        or dev.platform == "axon"
+    if is_tpu:
+        # BERT-large, phase-1 shapes
+        V, D, Dff, L, H, B, T = 30522, 1024, 4096, 24, 16, 32, 128
+        steps, warmup = 10, 2
+    else:  # CPU smoke configuration — keeps the harness runnable anywhere
+        V, D, Dff, L, H, B, T = 1000, 128, 512, 2, 4, 4, 64
+        steps, warmup = 3, 1
+
+    mx.random.seed(0)
+    net = bert.BERTForPretraining(vocab_size=V, units=D, hidden_size=Dff,
+                                  num_layers=L, num_heads=H, dropout=0.0)
+    net.initialize()
+    x = jnp.ones((B, T), jnp.int32)
+    apply_fn, train_raws, aux_raws = functionalize(net, mx.nd.NDArray(x))
+
+    n_params = sum(p.size for p in train_raws)
+
+    def loss_fn(params_bf16, tokens, labels, rng):
+        (mlm_logits, nsp_logits), _ = apply_fn(params_bf16, aux_raws, rng, tokens)
+        logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+        mlm = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+        nsp = -jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)[:, 0].mean()
+        return mlm + nsp
+
+    lr, mom = 1e-3, 0.9
+
+    def train_step(params32, velocity, tokens, labels, rng):
+        params_bf16 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), params32)
+        loss, grads = jax.value_and_grad(loss_fn)(params_bf16, tokens, labels, rng)
+        new_vel = jax.tree_util.tree_map(
+            lambda v, g: mom * v + g.astype(jnp.float32), velocity, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, v: p - lr * v, params32, new_vel)
+        return new_params, new_vel, loss
+
+    params32 = tuple(p.astype(jnp.float32) for p in train_raws)
+    velocity = tuple(jnp.zeros_like(p) for p in params32)
+    key = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(key)
+    tokens = jax.random.randint(kx, (B, T), 0, V, dtype=jnp.int32)
+    labels = jax.random.randint(ky, (B, T), 0, V, dtype=jnp.int32)
+
+    # donate params/velocity for in-place updates
+    train_step_donated = jax.jit(train_step, donate_argnums=(0, 1))
+
+    for _ in range(warmup):
+        params32, velocity, loss = train_step_donated(
+            params32, velocity, tokens, labels, key)
+    float(loss)  # value fetch — block_until_ready is unreliable over the relay
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params32, velocity, loss = train_step_donated(
+            params32, velocity, tokens, labels, key)
+    final_loss = float(loss)  # steps are serialized by the params dependency
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = B * T * steps / dt
+    # train FLOPs/token ≈ 6·N_matmul + attention 12·L·T·D; embedding
+    # lookups are gathers, not matmuls — exclude their tables
+    n_embed = V * D + 512 * D + 2 * D
+    flops_per_token = 6 * (n_params - n_embed) + 12 * L * T * D
+    mfu = tokens_per_s * flops_per_token / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "bert_large_pretrain_mfu" if is_tpu else "bert_smoke_pretrain_mfu",
+        "value": round(mfu * 100, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "tokens_per_s": round(tokens_per_s, 1),
+            "device": getattr(dev, "device_kind", str(dev)),
+            "n_params": int(n_params),
+            "batch": B, "seq": T, "steps_timed": steps,
+            "final_loss": round(final_loss, 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

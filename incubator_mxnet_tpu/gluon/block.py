@@ -31,7 +31,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, raw, wrap
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope", "functionalize"]
 
 
 class _BlockScope(threading.local):
@@ -300,31 +300,11 @@ class HybridBlock(Block):
         trainable = [p for p in params.values() if p.grad_req != "null" and p._data_nd is not None]
         aux = [p for p in params.values() if p.grad_req == "null" and p._data_nd is not None]
         self._cached_param_order = (trainable, aux)
-        outer = self
+        apply_fn = _make_apply_fn(self, trainable, aux, call_forward=True)
 
         def raw_fn(training: bool, train_raws: Tuple, aux_raws: Tuple, rng_key, *input_raws):
-            t_saved = [p._data_nd._data for p in trainable]
-            a_saved = [p._data_nd._data for p in aux]
-            rec_saved = _tape.set_recording(False)
-            train_saved = _tape.set_training(training)
-            try:
-                for p, r in zip(trainable, train_raws):
-                    p._data_nd._data = r
-                for p, r in zip(aux, aux_raws):
-                    p._data_nd._data = r
-                with _random.TraceKeyProvider(rng_key):
-                    outs = outer.forward(*[wrap(i) for i in input_raws])
-                out_raws = jax.tree_util.tree_map(
-                    raw, outs, is_leaf=lambda v: isinstance(v, NDArray))
-                new_aux = tuple(p._data_nd._data for p in aux)
-                return out_raws, new_aux
-            finally:
-                for p, r in zip(trainable, t_saved):
-                    p._data_nd._data = r
-                for p, r in zip(aux, a_saved):
-                    p._data_nd._data = r
-                _tape.set_recording(rec_saved)
-                _tape.set_training(train_saved)
+            return apply_fn(train_raws, aux_raws, rng_key, *input_raws,
+                            training=training)
 
         self._cached_fn = jax.jit(raw_fn, static_argnums=0)
 
@@ -479,3 +459,75 @@ class SymbolBlock(HybridBlock):
 
 def _strip_prefix(name: str, prefix: str) -> str:
     return name[len(prefix):] if prefix and name.startswith(prefix) else name
+
+
+def _make_apply_fn(block: Block, trainable: List[Parameter], aux: List[Parameter],
+                   call_forward: bool = False):
+    """Shared pure-function body for `functionalize` and `_build_cache`:
+    temporarily rebinds param raws (restored in `finally`), disables the
+    tape, installs a trace key provider, and returns
+    ``(out_raws, new_aux)``.  `call_forward=True` invokes
+    ``block.forward`` directly (cached-op path: skip the child-cache
+    dispatch); else ``block.__call__``."""
+
+    def apply_fn(train_raws, aux_raws, rng_key, *input_raws, training=False):
+        t_saved = [p._data_nd._data for p in trainable]
+        a_saved = [p._data_nd._data for p in aux]
+        rec_saved = _tape.set_recording(False)
+        trn_saved = _tape.set_training(training)
+        try:
+            for p, r in zip(trainable, train_raws):
+                p._data_nd._data = r
+            for p, r in zip(aux, aux_raws):
+                p._data_nd._data = r
+            with _random.TraceKeyProvider(rng_key):
+                fn = block.forward if call_forward else block
+                outs = fn(*[wrap(i) for i in input_raws])
+            out_raws = jax.tree_util.tree_map(
+                raw, outs, is_leaf=lambda v: isinstance(v, NDArray))
+            new_aux = tuple(p._data_nd._data for p in aux)
+            return out_raws, new_aux
+        finally:
+            for p, r in zip(trainable, t_saved):
+                p._data_nd._data = r
+            for p, r in zip(aux, a_saved):
+                p._data_nd._data = r
+            _tape.set_recording(rec_saved)
+            _tape.set_training(trn_saved)
+
+    apply_fn.trainable_params = trainable
+    apply_fn.aux_params = aux
+    return apply_fn
+
+
+def functionalize(block: Block, *example_args):
+    """Extract a pure JAX function from an (initialized) Block.
+
+    The SPMD bridge: once a Gluon model is a pure function of
+    ``(trainable, aux, rng_key, *inputs)`` it composes with ``jax.jit``,
+    ``jax.grad``, ``pjit`` shardings and ``shard_map`` — this is how the
+    Trainer/bench/multichip paths compile full train steps (the
+    CachedOp equivalence of SURVEY.md §3.3 taken to its conclusion).
+
+    Returns ``(apply_fn, trainable_raws, aux_raws)`` where
+    ``apply_fn(trainable, aux, rng_key, *input_raws, training=False)``
+    → ``(out_raws, new_aux)``.  ``trainable``/``aux`` are tuples of raw
+    `jax.Array` in `collect_params()` order (grad_req != 'null' first
+    tuple, the rest in the second).
+    """
+    if example_args:
+        if isinstance(block, HybridBlock):
+            block._ensure_shapes(tuple(wrap(a) for a in example_args))
+        else:
+            block(*[wrap(a) for a in example_args])
+    params = block.collect_params()
+    trainable = [p for p in params.values() if p.grad_req != "null" and p._data_nd is not None]
+    aux = [p for p in params.values() if p.grad_req == "null" and p._data_nd is not None]
+    pending = [p.name for p in params.values() if p._data_nd is None]
+    if pending:
+        raise MXNetError(
+            f"functionalize: parameters not initialized (pass example args): {pending}")
+    apply_fn = _make_apply_fn(block, trainable, aux)
+    train_raws = tuple(p._data_nd._data for p in trainable)
+    aux_raws = tuple(p._data_nd._data for p in aux)
+    return apply_fn, train_raws, aux_raws

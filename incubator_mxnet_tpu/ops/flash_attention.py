@@ -30,19 +30,31 @@ import jax.numpy as jnp
 __all__ = ["flash_attention", "attention_reference"]
 
 
+def _safe_softmax(s):
+    """Softmax along -1 that returns 0 (not NaN) on fully-masked rows —
+    the flash-kernel convention for queries with no visible keys."""
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+    return e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+
+
 def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
-    """Plain XLA softmax(QKᵀ)V oracle. q,k,v: (B, H, T, D)."""
+    """Plain XLA softmax(QKᵀ)V oracle. q,k,v: (B, H, T, D).
+
+    Causal masking is bottom-right aligned (query i sees keys j with
+    j − (Tk − Tq) ≤ i), matching the Pallas kernel and the VJP."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
         s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+    p = _safe_softmax(s)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, nk, tk):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, nk, tq, tk):
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
@@ -58,8 +70,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, nk, tk):
         col = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = col < tk
         if causal:
+            # bottom-right alignment (matches attention_reference & VJP):
+            # query i attends keys j with j - (tk - tq) <= i
             row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            valid = jnp.logical_and(valid, col <= row)
+            valid = jnp.logical_and(valid, col <= row + (tk - tq))
         s = jnp.where(valid, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # guard fully-masked rows (m_new == -inf) against NaN from exp(-inf - -inf)
@@ -103,7 +117,7 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
     nk = Tk_p // bk
     grid = (B * H, Tq_p // bq)
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, tk=Tk)
+                               bq=bq, bk=bk, nk=nk, tq=Tq, tk=Tk)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -119,6 +133,61 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
     return out[:, :Tq, :].reshape(B, H, Tq, D)
 
 
+def _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference):
+    platform = jax.default_backend()
+    if force_reference:
+        return attention_reference(q, k, v, causal, scale)
+    if platform == "cpu":
+        # interpreter is exact but slow — only for kernel-parity tests
+        if q.shape[2] * k.shape[2] <= 256 * 256:
+            return _flash_core(q, k, v, causal, scale, min(block_q, 64),
+                               min(block_k, 64), True)
+        return attention_reference(q, k, v, causal, scale)
+    return _flash_core(q, k, v, causal, scale, block_q, block_k, False)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, force_reference):
+    return _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, force_reference):
+    out = _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, force_reference, res, do):
+    """Exact attention backward (fp32 score recompute).
+
+    dV = Pᵀ dO;  dS = P ∘ (dO Vᵀ − rowsum(dO ∘ O));  dQ = s·dS K;
+    dK = s·dSᵀ Q.  A fused Pallas backward kernel is the planned
+    upgrade; this XLA path is numerically exact and lets `jax.grad`
+    flow through the kernel today (ref trains attention via cuDNN
+    autograd — SURVEY.md §2.3).
+    """
+    q, k, v = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = _safe_softmax(s)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    dsum = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - dsum)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     force_reference: bool = False):
@@ -126,22 +195,12 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
 
     TPU → Pallas kernel; CPU → same kernel via the Pallas interpreter
     for small shapes, XLA reference otherwise (identical numerics).
+    Differentiable via a custom VJP (exact softmax-attention backward).
     """
     from ..ndarray.ndarray import NDArray, raw
 
     was_nd = isinstance(q, NDArray)
     q, k, v = raw(q), raw(k), raw(v)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    platform = jax.default_backend()
-    if force_reference:
-        out = attention_reference(q, k, v, causal, scale)
-    elif platform == "cpu":
-        # interpreter is exact but slow — only for kernel-parity tests
-        if q.shape[2] * k.shape[2] <= 256 * 256:
-            out = _flash_core(q, k, v, causal, scale, min(block_q, 64),
-                              min(block_k, 64), True)
-        else:
-            out = attention_reference(q, k, v, causal, scale)
-    else:
-        out = _flash_core(q, k, v, causal, scale, block_q, block_k, False)
+    out = _flash(q, k, v, causal, scale, block_q, block_k, force_reference)
     return NDArray(out) if was_nd else out

@@ -78,17 +78,15 @@ def moe_layer(x, router_w, expert_ws, axis_name: str = "expert",
     # local tokens → per-expert capacity slots: (E, C, D)
     slots = jnp.einsum("tec,td->ecd", dispatch, x)
     # all_to_all over experts: each shard keeps its Elocal experts but
-    # gathers every device's slots for them → (Elocal, n*C, D)
-    slots = slots.reshape(n, Elocal, capacity, D)
-    slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=2, tiled=False)
-    slots = slots.reshape(Elocal, n * capacity, D)
+    # gathers every device's slots for them.  Tiled all_to_all divides
+    # split_axis by n and multiplies concat_axis by n, chunks ordered by
+    # source rank: (E, C, D) → (Elocal, n·C, D).
+    slots = lax.all_to_all(slots, axis_name, split_axis=0, concat_axis=1, tiled=True)
     # expert FFN (batched over local experts — MXU)
     h = activation(jnp.einsum("ecd,edf->ecf", slots, w_in))
     y = jnp.einsum("ecf,efd->ecd", h, w_out)
-    # route back
-    y = y.reshape(Elocal, n, capacity, D)
-    y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0, tiled=False)
-    y = y.reshape(E, capacity, D)
+    # route back: exact inverse of the dispatch all_to_all
+    y = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0, tiled=True)
     out = jnp.einsum("tec,ecd->td", combine, y)
     return out, aux
 

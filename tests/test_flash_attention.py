@@ -1,0 +1,47 @@
+"""Flash-attention kernel vs XLA oracle (fwd + custom-VJP bwd).
+
+Reference oracle pattern: `check_consistency` / numeric-vs-reference op
+tests of `tests/python/unittest/test_operator.py` (SURVEY.md §4) — the
+Pallas kernel (interpret mode on CPU) must match `attention_reference`
+including cross-length causal masks (bottom-right aligned) and
+fully-masked rows (output 0, zero grads).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu.ops.flash_attention import (
+    _flash_core, attention_reference, flash_attention)
+
+
+@pytest.mark.parametrize("tq,tk", [(4, 8), (8, 8), (8, 4), (7, 13)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_vs_reference(tq, tk, causal):
+    ks = jax.random.split(jax.random.PRNGKey(tq * 100 + tk), 3)
+    q = jax.random.normal(ks[0], (2, 2, tq, 8))
+    k = jax.random.normal(ks[1], (2, 2, tk, 8))
+    v = jax.random.normal(ks[2], (2, 2, tk, 8))
+    a = _flash_core(q, k, v, causal, 8 ** -0.5, 4, 4, True)
+    b = attention_reference(q, k, v, causal, 8 ** -0.5)
+    onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("tq,tk", [(4, 8), (8, 8), (8, 4)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_custom_vjp_vs_reference_grads(tq, tk, causal):
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (1, 2, tq, 8))
+    k = jax.random.normal(ks[1], (1, 2, tk, 8))
+    v = jax.random.normal(ks[2], (1, 2, tk, 8))
+
+    def f(fn):
+        return jax.grad(
+            lambda q, k, v: (fn(q, k, v, causal=causal).astype(jnp.float32)
+                             ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+
+    for ga, gb in zip(f(flash_attention), f(attention_reference)):
+        onp.testing.assert_allclose(onp.asarray(ga), onp.asarray(gb),
+                                    rtol=2e-4, atol=2e-5)
+        assert onp.isfinite(onp.asarray(ga)).all()
