@@ -304,7 +304,8 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 preprocess_threads=4, label_width=1, round_batch=True, **kwargs):
+                 preprocess_threads=4, label_width=1, round_batch=True,
+                 resize=0, seed=0, use_native=True, scale=1.0, **kwargs):
         super().__init__(batch_size)
         from .. import recordio as rio
 
@@ -314,6 +315,17 @@ class ImageRecordIter(DataIter):
         self.mean = onp.array([mean_r, mean_g, mean_b], "float32").reshape(3, 1, 1)
         self.std = onp.array([std_r, std_g, std_b], "float32").reshape(3, 1, 1)
         self.shuffle = shuffle
+        self._scale = scale
+        self._resize = resize
+        self._native = None
+        if use_native and label_width == 1:
+            self._native = _NativeImagePipeline.create(
+                path_imgrec, batch_size, self.data_shape, preprocess_threads,
+                shuffle, seed, rand_crop, rand_mirror,
+                (mean_r, mean_g, mean_b), (std_r, std_g, std_b), scale, resize)
+        if self._native is not None:
+            self.keys = None
+            return
         if path_imgidx:
             self.rec = rio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
             self.keys = list(self.rec.keys)
@@ -324,7 +336,9 @@ class ImageRecordIter(DataIter):
         self.reset()
 
     def reset(self):
-        if self.keys is not None:
+        if self._native is not None:
+            self._native.reset()
+        elif self.keys is not None:
             self._order = onp.arange(len(self.keys))
             if self.shuffle:
                 onp.random.shuffle(self._order)
@@ -348,15 +362,29 @@ class ImageRecordIter(DataIter):
         arr = img.asnumpy().astype("float32")
         if arr.ndim == 2:
             arr = onp.stack([arr] * 3, axis=-1)
+        if self._resize > 0 and min(arr.shape[0], arr.shape[1]) != self._resize:
+            from PIL import Image
+
+            ih, iw = arr.shape[:2]
+            if ih < iw:
+                nh, nw = self._resize, int(iw * self._resize / ih)
+            else:
+                nh, nw = int(ih * self._resize / iw), self._resize
+            arr = onp.asarray(Image.fromarray(arr.astype("uint8"))
+                              .resize((nw, nh), Image.BILINEAR), dtype="float32")
         arr = arr.transpose(2, 0, 1)  # HWC→CHW
         c, h, w = self.data_shape
         arr = _center_or_rand_crop(arr, h, w, self.rand_crop)
         if self.rand_mirror and onp.random.rand() < 0.5:
             arr = arr[:, :, ::-1]
-        arr = (arr - self.mean) / self.std
+        arr = (arr * self._scale - self.mean) / self.std
         return arr, onp.float32(header.label if onp.isscalar(header.label) else header.label[0])
 
     def next(self) -> DataBatch:
+        if self._native is not None:
+            d, l = self._native.next()
+            return DataBatch(data=[NDArray(jnp.asarray(d))],
+                             label=[NDArray(jnp.asarray(l))])
         datas, labels = [], []
         for _ in range(self.batch_size):
             d, l = self._read_one()
@@ -365,6 +393,61 @@ class ImageRecordIter(DataIter):
         data = NDArray(jnp.asarray(onp.stack(datas)))
         label = NDArray(jnp.asarray(onp.stack(labels)))
         return DataBatch(data=[data], label=[label])
+
+
+class _NativeImagePipeline:
+    """ctypes wrapper over native/image_pipeline.cc (threaded decode +
+    augment + double-buffered prefetch — ref iter_image_recordio_2)."""
+
+    def __init__(self, lib, handle, batch, shape):
+        self._lib = lib
+        self._h = handle
+        self._batch = batch
+        self._shape = shape  # (C,H,W)
+
+    @classmethod
+    def create(cls, path, batch, data_shape, threads, shuffle, seed,
+               rand_crop, rand_mirror, mean, std, scale, resize):
+        import ctypes
+
+        from ..native import image_pipeline_lib
+
+        lib = image_pipeline_lib()
+        if lib is None:
+            return None
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*mean)
+        std_arr = (ctypes.c_float * 3)(*std)
+        handle = lib.ImRecIterCreate(
+            path.encode(), batch, h, w, c, threads, int(shuffle), seed,
+            int(rand_crop), int(rand_mirror), mean_arr, std_arr, scale, 0,
+            resize)
+        if not handle:
+            return None
+        return cls(lib, handle, batch, (c, h, w))
+
+    def next(self):
+        import ctypes
+
+        c, h, w = self._shape
+        data = onp.empty((self._batch, c, h, w), "float32")
+        label = onp.empty((self._batch,), "float32")
+        ok = self._lib.ImRecIterNext(
+            self._h,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if not ok:
+            raise StopIteration
+        return data, label
+
+    def reset(self):
+        self._lib.ImRecIterReset(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.ImRecIterFree(self._h)
+        except Exception:
+            pass
 
 
 def _center_or_rand_crop(arr, h, w, rand):

@@ -2,9 +2,64 @@
 
 The reference's C++ host runtime (engine, RecordIO, iterators —
 SURVEY.md §2.1/§2.5) has TPU-native equivalents here: XLA owns device
-scheduling, so the native layer covers what stays on the host — a
-dependency-ordered I/O engine and a RecordIO codec.  Built on demand
-with g++ (see build.py); every component has a pure-Python fallback so
-the framework works without a toolchain.
+scheduling, so the native layer covers what stays on the host — the
+RecordIO codec (`recordio.cc`) and the threaded image-decode/augment/
+prefetch pipeline (`image_pipeline.cc`).  Built on demand with g++
+(see build.py); every component has a pure-Python fallback so the
+framework works without a toolchain.
 """
-from . import build  # noqa: F401
+from __future__ import annotations
+
+import ctypes
+import functools
+from typing import Optional
+
+from . import build
+
+__all__ = ["recordio_lib", "image_pipeline_lib", "build"]
+
+
+@functools.lru_cache(maxsize=None)
+def recordio_lib() -> Optional[ctypes.CDLL]:
+    lib = build.load_or_build("recordio")
+    if lib is None:
+        return None
+    lib.RecordIOWriterCreate.restype = ctypes.c_void_p
+    lib.RecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.RecordIOWriterWrite.restype = ctypes.c_int
+    lib.RecordIOWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64]
+    lib.RecordIOWriterTell.restype = ctypes.c_int64
+    lib.RecordIOWriterTell.argtypes = [ctypes.c_void_p]
+    lib.RecordIOWriterFree.argtypes = [ctypes.c_void_p]
+    lib.RecordIOReaderCreate.restype = ctypes.c_void_p
+    lib.RecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.RecordIOReaderNext.restype = ctypes.c_int64
+    lib.RecordIOReaderNext.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_char_p)]
+    lib.RecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.RecordIOReaderTell.restype = ctypes.c_int64
+    lib.RecordIOReaderTell.argtypes = [ctypes.c_void_p]
+    lib.RecordIOReaderFree.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+@functools.lru_cache(maxsize=None)
+def image_pipeline_lib() -> Optional[ctypes.CDLL]:
+    lib = build.load_or_build("image_pipeline", ldflags=("-ljpeg",))
+    if lib is None:
+        return None
+    F = ctypes.POINTER(ctypes.c_float)
+    lib.ImRecIterCreate.restype = ctypes.c_void_p
+    lib.ImRecIterCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int, F, F, ctypes.c_float, ctypes.c_int,
+        ctypes.c_int]
+    lib.ImRecIterNext.restype = ctypes.c_int
+    lib.ImRecIterNext.argtypes = [ctypes.c_void_p, F, F]
+    lib.ImRecIterNumRecords.restype = ctypes.c_int64
+    lib.ImRecIterNumRecords.argtypes = [ctypes.c_void_p]
+    lib.ImRecIterReset.argtypes = [ctypes.c_void_p]
+    lib.ImRecIterFree.argtypes = [ctypes.c_void_p]
+    return lib

@@ -10,16 +10,19 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_DIR, "_build")
 
 
-def load_or_build(name: str) -> Optional[ctypes.CDLL]:
+def load_or_build(name: str, ldflags=()) -> Optional[ctypes.CDLL]:
     """Compile native/<name>.cc → _build/lib<name>.so (cached) and load."""
     src = os.path.join(_DIR, f"{name}.cc")
     if not os.path.exists(src):
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
     so = os.path.join(_BUILD_DIR, f"lib{name}.so")
-    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+    deps = [src] + [os.path.join(_DIR, h) for h in os.listdir(_DIR)
+                    if h.endswith(".h")]
+    newest_dep = max(os.path.getmtime(d) for d in deps)
+    if not os.path.exists(so) or os.path.getmtime(so) < newest_dep:
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               "-o", so, src]
+               "-o", so, src, *ldflags]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (subprocess.CalledProcessError, FileNotFoundError,

@@ -1,0 +1,363 @@
+// Threaded image-record pipeline — the host-side data engine.
+//
+// TPU-native equivalent of the reference's C++ ImageRecordIter stack
+// (`src/io/iter_image_recordio_2.cc`, `image_aug_default.cc`,
+// `iter_prefetcher.h` — SURVEY.md §2.5): sequential RecordIO read,
+// multithreaded JPEG decode + augment (resize-shorter-side, random or
+// center crop, horizontal mirror, mean/std normalize), and a
+// double-buffered prefetch thread so the NEXT batch decodes while the
+// trainer consumes the current one.  Output feeds per-host device
+// batches (`jax.device_put` on the Python side).
+//
+// Record payload layout: IRHeader (uint32 flag, float label, uint64 id,
+// uint64 id2) followed by JPEG bytes — `recordio.pack_img` format.
+//
+// C ABI via ctypes; decode uses libjpeg (present in image: jpeglib.h).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+#include "recordio_core.h"
+
+namespace {
+
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+
+struct Config {
+  int batch, h, w, c;
+  int threads;
+  int shuffle;
+  uint64_t seed;
+  int rand_crop, rand_mirror;
+  float mean[3], std[3];
+  float scale;    // multiply raw pixel (e.g. 1/255)
+  int layout;     // 0 = NCHW, 1 = NHWC
+  int resize;     // shorter-side resize target; 0 = none
+};
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jb;
+};
+
+void ErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<ErrMgr*>(cinfo->err)->jb, 1);
+}
+
+// decode JPEG → RGB uint8 (h, w, 3). Returns false on failure.
+bool DecodeJpeg(const unsigned char* buf, size_t size,
+                std::vector<unsigned char>* out, int* oh, int* ow) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr err;
+  cinfo.err = jpeg_std_error(&err.pub);
+  err.pub.error_exit = ErrExit;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf),
+               static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *oh = cinfo.output_height;
+  *ow = cinfo.output_width;
+  out->resize(static_cast<size_t>(*oh) * *ow * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = out->data() +
+        static_cast<size_t>(cinfo.output_scanline) * *ow * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize RGB uint8
+void Resize(const unsigned char* src, int sh, int sw,
+            unsigned char* dst, int dh, int dw) {
+  for (int y = 0; y < dh; ++y) {
+    float fy = (dh > 1) ? static_cast<float>(y) * (sh - 1) / (dh - 1) : 0.f;
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (dw > 1) ? static_cast<float>(x) * (sw - 1) / (dw - 1) : 0.f;
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      for (int ch = 0; ch < 3; ++ch) {
+        float v =
+            (1 - wy) * ((1 - wx) * src[(y0 * sw + x0) * 3 + ch] +
+                        wx * src[(y0 * sw + x1) * 3 + ch]) +
+            wy * ((1 - wx) * src[(y1 * sw + x0) * 3 + ch] +
+                  wx * src[(y1 * sw + x1) * 3 + ch]);
+        dst[(y * dw + x) * 3 + ch] = static_cast<unsigned char>(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct Iter {
+  Config cfg;
+  std::vector<std::vector<char>> records;  // raw payloads, loaded once
+  std::vector<size_t> order;
+  size_t cursor = 0;  // next record index (into order)
+  std::mt19937_64 rng;
+
+  // double buffering
+  std::vector<float> bufs[2];
+  std::vector<float> label_bufs[2];
+  int ready[2] = {0, 0};        // 1 = batch ready, -1 = epoch end
+  int consumed_slot = 1;        // slot the consumer will read next (flip)
+  std::thread prefetcher;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool stop = false;
+  bool filling = false;   // prefetcher is inside FillBatch
+  bool exhausted = false; // epoch end observed; Next returns 0 until Reset
+  int pending_slot = -1;  // slot the prefetcher should fill next
+
+  ~Iter() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    if (prefetcher.joinable()) prefetcher.join();
+  }
+
+  // decode+augment one record into batch position i of dst
+  void Sample(const std::vector<char>& rec, float* dst, float* label,
+              std::mt19937_64* lrng) {
+    const auto* hdr = reinterpret_cast<const IRHeader*>(rec.data());
+    size_t off = sizeof(IRHeader);
+    *label = hdr->label;
+    if (hdr->flag > 0) {  // multi-label: first label only in this path
+      *label = *reinterpret_cast<const float*>(rec.data() + off);
+      off += static_cast<size_t>(hdr->flag) * 4;
+    }
+    const auto* jpg = reinterpret_cast<const unsigned char*>(rec.data() + off);
+    size_t jpg_size = rec.size() - off;
+    std::vector<unsigned char> rgb;
+    int ih = 0, iw = 0;
+    if (!DecodeJpeg(jpg, jpg_size, &rgb, &ih, &iw)) {
+      std::memset(dst, 0, sizeof(float) * cfg.h * cfg.w * cfg.c);
+      return;
+    }
+    // shorter-side resize
+    std::vector<unsigned char> resized;
+    if (cfg.resize > 0 && (ih < iw ? ih : iw) != cfg.resize) {
+      int nh, nw;
+      if (ih < iw) { nh = cfg.resize; nw = static_cast<int>(1.0 * iw * cfg.resize / ih); }
+      else { nw = cfg.resize; nh = static_cast<int>(1.0 * ih * cfg.resize / iw); }
+      resized.resize(static_cast<size_t>(nh) * nw * 3);
+      Resize(rgb.data(), ih, iw, resized.data(), nh, nw);
+      rgb.swap(resized);
+      ih = nh; iw = nw;
+    }
+    // pad up if still smaller than crop
+    if (ih < cfg.h || iw < cfg.w) {
+      int nh = ih < cfg.h ? cfg.h : ih, nw = iw < cfg.w ? cfg.w : iw;
+      std::vector<unsigned char> padded(static_cast<size_t>(nh) * nw * 3, 0);
+      for (int y = 0; y < ih; ++y)
+        std::memcpy(&padded[static_cast<size_t>(y) * nw * 3],
+                    &rgb[static_cast<size_t>(y) * iw * 3], iw * 3);
+      rgb.swap(padded);
+      ih = nh; iw = nw;
+    }
+    // crop
+    int y0, x0;
+    if (cfg.rand_crop) {
+      y0 = static_cast<int>((*lrng)() % (ih - cfg.h + 1));
+      x0 = static_cast<int>((*lrng)() % (iw - cfg.w + 1));
+    } else {
+      y0 = (ih - cfg.h) / 2;
+      x0 = (iw - cfg.w) / 2;
+    }
+    bool mirror = cfg.rand_mirror && ((*lrng)() & 1);
+    // normalize + layout
+    for (int y = 0; y < cfg.h; ++y) {
+      for (int x = 0; x < cfg.w; ++x) {
+        int sx = mirror ? (cfg.w - 1 - x) : x;
+        const unsigned char* px =
+            &rgb[(static_cast<size_t>(y0 + y) * iw + (x0 + sx)) * 3];
+        for (int ch = 0; ch < cfg.c; ++ch) {
+          float v = px[ch % 3] * cfg.scale;
+          v = (v - cfg.mean[ch % 3]) / cfg.std[ch % 3];
+          size_t di = cfg.layout == 0
+              ? (static_cast<size_t>(ch) * cfg.h + y) * cfg.w + x
+              : (static_cast<size_t>(y) * cfg.w + x) * cfg.c + ch;
+          dst[di] = v;
+        }
+      }
+    }
+  }
+
+  // fill one batch into slot; returns false at epoch end
+  bool FillBatch(int slot) {
+    size_t remaining = order.size() - cursor;
+    if (remaining < static_cast<size_t>(cfg.batch)) return false;  // drop tail
+    size_t base = cursor;
+    cursor += cfg.batch;
+    float* data = bufs[slot].data();
+    float* labels = label_bufs[slot].data();
+    size_t sample_sz = static_cast<size_t>(cfg.h) * cfg.w * cfg.c;
+    int nthreads = cfg.threads > 1 ? cfg.threads : 1;
+    std::vector<std::thread> ts;
+    std::atomic<int> next(0);
+    for (int t = 0; t < nthreads; ++t) {
+      ts.emplace_back([&, t]() {
+        std::mt19937_64 lrng(cfg.seed ^ (base * 1315423911u) ^ (t * 2654435761u));
+        int i;
+        while ((i = next.fetch_add(1)) < cfg.batch) {
+          Sample(records[order[base + i]], data + i * sample_sz, labels + i,
+                 &lrng);
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+    return true;
+  }
+
+  void PrefetchLoop() {
+    while (true) {
+      int slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return stop || pending_slot >= 0; });
+        if (stop) return;
+        slot = pending_slot;
+        pending_slot = -1;
+        filling = true;
+      }
+      bool ok = FillBatch(slot);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready[slot] = ok ? 1 : -1;
+        filling = false;
+      }
+      cv.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ImRecIterCreate(const char* rec_path, int batch, int h, int w, int c,
+                      int threads, int shuffle, uint64_t seed, int rand_crop,
+                      int rand_mirror, const float* mean, const float* stdv,
+                      float scale, int layout, int resize) {
+  auto* it = new Iter();
+  it->cfg = Config{batch, h, w, c, threads, shuffle, seed, rand_crop,
+                   rand_mirror, {mean[0], mean[1], mean[2]},
+                   {stdv[0], stdv[1], stdv[2]}, scale, layout, resize};
+  it->rng.seed(seed);
+  FILE* f = fopen(rec_path, "rb");
+  if (!f) {
+    delete it;
+    return nullptr;
+  }
+  std::vector<char> buf;
+  while (true) {
+    int64_t n = recio::ReadRecord(f, &buf);
+    if (n == -1) break;  // clean EOF
+    if (n < 0) {         // corrupt stream: refuse (Python path raises too)
+      fclose(f);
+      delete it;
+      return nullptr;
+    }
+    it->records.emplace_back(buf.begin(), buf.end());
+  }
+  fclose(f);
+  it->order.resize(it->records.size());
+  for (size_t i = 0; i < it->order.size(); ++i) it->order[i] = i;
+  if (shuffle) std::shuffle(it->order.begin(), it->order.end(), it->rng);
+  size_t sample_sz = static_cast<size_t>(h) * w * c;
+  for (int s = 0; s < 2; ++s) {
+    it->bufs[s].resize(sample_sz * batch);
+    it->label_bufs[s].resize(batch);
+  }
+  it->prefetcher = std::thread([it] { it->PrefetchLoop(); });
+  // kick off the first batch
+  {
+    std::lock_guard<std::mutex> lk(it->mu);
+    it->pending_slot = 0;
+  }
+  it->cv.notify_all();
+  return it;
+}
+
+int64_t ImRecIterNumRecords(void* handle) {
+  return static_cast<Iter*>(handle)->records.size();
+}
+
+// Copy next ready batch into out buffers; returns 1 ok, 0 epoch end.
+int ImRecIterNext(void* handle, float* data_out, float* label_out) {
+  auto* it = static_cast<Iter*>(handle);
+  int slot = 1 - it->consumed_slot;
+  {
+    std::unique_lock<std::mutex> lk(it->mu);
+    if (it->exhausted) return 0;  // repeated Next past epoch end: no hang
+    it->cv.wait(lk, [&] { return it->ready[slot] != 0; });
+    if (it->ready[slot] < 0) {
+      it->ready[slot] = 0;
+      it->exhausted = true;
+      return 0;
+    }
+    it->ready[slot] = 0;
+  }
+  std::memcpy(data_out, it->bufs[slot].data(),
+              it->bufs[slot].size() * sizeof(float));
+  std::memcpy(label_out, it->label_bufs[slot].data(),
+              it->label_bufs[slot].size() * sizeof(float));
+  it->consumed_slot = slot;
+  // schedule the other slot
+  {
+    std::lock_guard<std::mutex> lk(it->mu);
+    it->pending_slot = 1 - slot;
+  }
+  it->cv.notify_all();
+  return 1;
+}
+
+void ImRecIterReset(void* handle) {
+  auto* it = static_cast<Iter*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(it->mu);
+    // drain: no pending request and no fill in flight
+    it->cv.wait(lk, [&] { return it->pending_slot < 0 && !it->filling; });
+    it->cursor = 0;
+    it->ready[0] = it->ready[1] = 0;
+    it->exhausted = false;
+    if (it->cfg.shuffle) std::shuffle(it->order.begin(), it->order.end(), it->rng);
+    it->consumed_slot = 1;
+    it->pending_slot = 0;
+  }
+  it->cv.notify_all();
+}
+
+void ImRecIterFree(void* handle) { delete static_cast<Iter*>(handle); }
+
+}  // extern "C"

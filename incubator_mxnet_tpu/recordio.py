@@ -51,28 +51,59 @@ def _find_magic_splits(data: bytes):
 
 
 class MXRecordIO:
-    """Sequential .rec reader/writer."""
+    """Sequential .rec reader/writer.
 
-    def __init__(self, uri: str, flag: str):
+    Uses the C++ codec (native/recordio.cc, byte-identical format) when
+    the toolchain is available; falls back to the pure-Python path."""
+
+    def __init__(self, uri: str, flag: str, use_native: bool = True):
         self.uri = uri
         self.flag = flag
         self.fid = None
+        self._use_native = use_native
+        self._nh = None      # native handle
+        self._nlib = None
         self.open()
 
+    def _native_lib(self):
+        if not self._use_native:
+            return None
+        from .native import recordio_lib
+
+        return recordio_lib()
+
     def open(self):
+        lib = self._native_lib()
         if self.flag == "w":
-            self.fid = open(self.uri, "wb")
             self.writable = True
+            if lib is not None:
+                self._nlib = lib
+                self._nh = lib.RecordIOWriterCreate(self.uri.encode())
+            if not self._nh:
+                self._nlib = None
+                self.fid = open(self.uri, "wb")
         elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
             self.writable = False
+            if lib is not None:
+                self._nlib = lib
+                self._nh = lib.RecordIOReaderCreate(self.uri.encode())
+            if not self._nh:
+                self._nlib = None
+                self.fid = open(self.uri, "rb")
         else:
             raise ValueError(f"Invalid flag {self.flag}")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.fid.close()
+            if self._nh:
+                if self.writable:
+                    self._nlib.RecordIOWriterFree(self._nh)
+                else:
+                    self._nlib.RecordIOReaderFree(self._nh)
+                self._nh = None
+            if self.fid is not None:
+                self.fid.close()
             self.is_open = False
 
     def __del__(self):
@@ -85,6 +116,8 @@ class MXRecordIO:
         d = dict(self.__dict__)
         d["fid"] = None
         d["is_open"] = False
+        d["_nh"] = None     # native handles are process-local
+        d["_nlib"] = None   # ctypes CDLL is unpicklable
         return d
 
     def __setstate__(self, d):
@@ -100,6 +133,10 @@ class MXRecordIO:
 
     def write(self, buf: bytes):
         assert self.writable
+        if self._nh:
+            if self._nlib.RecordIOWriterWrite(self._nh, buf, len(buf)) != 0:
+                raise MXNetError(f"native RecordIO write failed for {self.uri}")
+            return
         parts = _find_magic_splits(buf)
         n = len(parts)
         for i, part in enumerate(parts):
@@ -118,6 +155,16 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._nh:
+            import ctypes
+
+            ptr = ctypes.c_char_p()
+            n = self._nlib.RecordIOReaderNext(self._nh, ctypes.byref(ptr))
+            if n == -1:
+                return None
+            if n < 0:
+                raise MXNetError(f"corrupt RecordIO stream in {self.uri}")
+            return ctypes.string_at(ptr, n)
         out = b""
         while True:
             hdr = self.fid.read(8)
@@ -140,7 +187,16 @@ class MXRecordIO:
                 return out + _MAGIC_BYTES + data
 
     def tell(self):
+        if self._nh:
+            return (self._nlib.RecordIOWriterTell(self._nh) if self.writable
+                    else self._nlib.RecordIOReaderTell(self._nh))
         return self.fid.tell()
+
+    def _seek(self, pos: int):
+        if self._nh:
+            self._nlib.RecordIOReaderSeek(self._nh, pos)
+        else:
+            self.fid.seek(pos)
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -176,7 +232,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.fid.seek(self.idx[idx])
+        self._seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
