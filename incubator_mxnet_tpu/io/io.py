@@ -317,12 +317,23 @@ class ImageRecordIter(DataIter):
         self.shuffle = shuffle
         self._scale = scale
         self._resize = resize
+        self._round_batch = round_batch
         self._native = None
+        if use_native and path_imgidx:
+            # The native pipeline builds its own sequential index; a
+            # user-supplied .idx (keyed access order) would be silently
+            # ignored — use the Python path, which honours it.
+            import warnings
+            warnings.warn(
+                "ImageRecordIter: path_imgidx is not used by the native "
+                "pipeline; falling back to the Python reader.", stacklevel=2)
+            use_native = False
         if use_native and label_width == 1:
             self._native = _NativeImagePipeline.create(
                 path_imgrec, batch_size, self.data_shape, preprocess_threads,
                 shuffle, seed, rand_crop, rand_mirror,
-                (mean_r, mean_g, mean_b), (std_r, std_g, std_b), scale, resize)
+                (mean_r, mean_g, mean_b), (std_r, std_g, std_b), scale, resize,
+                round_batch)
         if self._native is not None:
             self.keys = None
             return
@@ -336,6 +347,7 @@ class ImageRecordIter(DataIter):
         self.reset()
 
     def reset(self):
+        self._padded_last = False
         if self._native is not None:
             self._native.reset()
         elif self.keys is not None:
@@ -382,17 +394,41 @@ class ImageRecordIter(DataIter):
 
     def next(self) -> DataBatch:
         if self._native is not None:
-            d, l = self._native.next()
+            d, l, pad = self._native.next()
             return DataBatch(data=[NDArray(jnp.asarray(d))],
-                             label=[NDArray(jnp.asarray(l))])
+                             label=[NDArray(jnp.asarray(l))], pad=pad)
+        if getattr(self, "_padded_last", False):
+            self._padded_last = False
+            raise StopIteration  # the padded batch ended the epoch
         datas, labels = [], []
         for _ in range(self.batch_size):
-            d, l = self._read_one()
+            try:
+                d, l = self._read_one()
+            except StopIteration:
+                if not datas or not self._round_batch:
+                    raise  # drop partial tail (round_batch=False)
+                break
             datas.append(d)
             labels.append(l)
+        pad = self.batch_size - len(datas)
+        if pad:
+            # round_batch=True: wrap to the epoch start, report `pad` so
+            # exact-epoch consumers can discard the wrapped samples
+            # (ref ImageRecordIter round-robin overflow handling).
+            self.reset()
+            self._padded_last = True
+            while len(datas) < self.batch_size:
+                try:
+                    d, l = self._read_one()
+                except StopIteration:
+                    self.reset()  # dataset smaller than pad: keep wrapping
+                    self._padded_last = True
+                    continue
+                datas.append(d)
+                labels.append(l)
         data = NDArray(jnp.asarray(onp.stack(datas)))
         label = NDArray(jnp.asarray(onp.stack(labels)))
-        return DataBatch(data=[data], label=[label])
+        return DataBatch(data=[data], label=[label], pad=pad)
 
 
 class _NativeImagePipeline:
@@ -407,7 +443,8 @@ class _NativeImagePipeline:
 
     @classmethod
     def create(cls, path, batch, data_shape, threads, shuffle, seed,
-               rand_crop, rand_mirror, mean, std, scale, resize):
+               rand_crop, rand_mirror, mean, std, scale, resize,
+               round_batch=True):
         import ctypes
 
         from ..native import image_pipeline_lib
@@ -421,24 +458,31 @@ class _NativeImagePipeline:
         handle = lib.ImRecIterCreate(
             path.encode(), batch, h, w, c, threads, int(shuffle), seed,
             int(rand_crop), int(rand_mirror), mean_arr, std_arr, scale, 0,
-            resize)
+            resize, int(round_batch))
         if not handle:
             return None
         return cls(lib, handle, batch, (c, h, w))
 
     def next(self):
+        """Returns (data, label, pad); raises StopIteration / IOError."""
         import ctypes
 
         c, h, w = self._shape
         data = onp.empty((self._batch, c, h, w), "float32")
         label = onp.empty((self._batch,), "float32")
+        pad = ctypes.c_int(0)
         ok = self._lib.ImRecIterNext(
             self._h,
             data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        if not ok:
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(pad))
+        if ok == 0:
             raise StopIteration
-        return data, label
+        if ok < 0:
+            raise IOError(
+                "native image pipeline: record read failure(s) in this "
+                "batch — the .rec file became unreadable mid-stream")
+        return data, label, pad.value
 
     def reset(self):
         self._lib.ImRecIterReset(self._h)

@@ -55,9 +55,10 @@ def image_pipeline_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
         ctypes.c_int, ctypes.c_int, F, F, ctypes.c_float, ctypes.c_int,
-        ctypes.c_int]
+        ctypes.c_int, ctypes.c_int]
     lib.ImRecIterNext.restype = ctypes.c_int
-    lib.ImRecIterNext.argtypes = [ctypes.c_void_p, F, F]
+    lib.ImRecIterNext.argtypes = [ctypes.c_void_p, F, F,
+                                  ctypes.POINTER(ctypes.c_int)]
     lib.ImRecIterNumRecords.restype = ctypes.c_int64
     lib.ImRecIterNumRecords.argtypes = [ctypes.c_void_p]
     lib.ImRecIterReset.argtypes = [ctypes.c_void_p]

@@ -1,13 +1,30 @@
-"""On-demand g++ build + ctypes loader for native components."""
+"""On-demand g++ build + ctypes loader for native components.
+
+Binaries are NOT committed to git (_build/ is gitignored); a content
+hash of the sources is stored next to each .so so staleness detection
+survives fresh clones where mtimes are unreliable.
+"""
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD_DIR = os.path.join(_DIR, "_build")
+
+
+def _source_hash(src: str, cmd_tag: str) -> str:
+    h = hashlib.sha256()
+    h.update(cmd_tag.encode())  # compile flags are part of the cache key
+    deps = [src] + sorted(os.path.join(_DIR, f) for f in os.listdir(_DIR)
+                          if f.endswith(".h"))
+    for d in deps:
+        with open(d, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
 
 
 def load_or_build(name: str, ldflags=()) -> Optional[ctypes.CDLL]:
@@ -17,17 +34,22 @@ def load_or_build(name: str, ldflags=()) -> Optional[ctypes.CDLL]:
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
     so = os.path.join(_BUILD_DIR, f"lib{name}.so")
-    deps = [src] + [os.path.join(_DIR, h) for h in os.listdir(_DIR)
-                    if h.endswith(".h")]
-    newest_dep = max(os.path.getmtime(d) for d in deps)
-    if not os.path.exists(so) or os.path.getmtime(so) < newest_dep:
-        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               "-o", so, src, *ldflags]
+    hashfile = so + ".srchash"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", so, src, *ldflags]
+    want = _source_hash(src, " ".join(c for c in cmd if c != so))
+    have = None
+    if os.path.exists(hashfile):
+        with open(hashfile) as f:
+            have = f.read().strip()
+    if not os.path.exists(so) or have != want:
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (subprocess.CalledProcessError, FileNotFoundError,
                 subprocess.TimeoutExpired):
             return None
+        with open(hashfile, "w") as f:
+            f.write(want)
     try:
         return ctypes.CDLL(so)
     except OSError:

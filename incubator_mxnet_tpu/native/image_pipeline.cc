@@ -21,6 +21,7 @@
 #include <cstring>
 #include <mutex>
 #include <random>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -48,6 +49,7 @@ struct Config {
   float scale;    // multiply raw pixel (e.g. 1/255)
   int layout;     // 0 = NCHW, 1 = NHWC
   int resize;     // shorter-side resize target; 0 = none
+  int round_batch;  // 1 = wrap partial tail to epoch start (report pad)
 };
 
 struct ErrMgr {
@@ -117,10 +119,22 @@ void Resize(const unsigned char* src, int sh, int sw,
   }
 }
 
+// splitmix64 finalizer — decorrelates per-sample RNG seeds.
+inline uint64_t Mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
 struct Iter {
   Config cfg;
-  std::vector<std::vector<char>> records;  // raw payloads, loaded once
+  std::string path;              // .rec file; records are re-read per batch
+  std::vector<int64_t> offsets;  // byte offset of each logical record
   std::vector<size_t> order;
+  uint64_t epoch = 0;            // bumped on Reset: fresh augs per epoch
+  int64_t slot_errors[2] = {0, 0};  // read failures per fill (mutex-ordered)
+  int slot_pad[2] = {0, 0};      // wrapped-sample count of a tail batch
   size_t cursor = 0;  // next record index (into order)
   std::mt19937_64 rng;
 
@@ -213,29 +227,60 @@ struct Iter {
     }
   }
 
-  // fill one batch into slot; returns false at epoch end
+  // fill one batch into slot; returns false at epoch end.
+  // Streaming: each worker re-reads its records from disk (own FILE*,
+  // seek to the indexed offset) — host RAM stays O(batch), not O(file),
+  // unlike a load-everything design which OOMs on ImageNet-scale .rec.
+  // round_batch: a partial tail wraps to the epoch start and reports
+  // the wrapped count via slot_pad (ref round-robin overflow handling);
+  // otherwise the tail is dropped.
   bool FillBatch(int slot) {
     size_t remaining = order.size() - cursor;
-    if (remaining < static_cast<size_t>(cfg.batch)) return false;  // drop tail
-    size_t base = cursor;
-    cursor += cfg.batch;
+    if (remaining == 0) return false;
+    int pad = 0;
+    if (remaining < static_cast<size_t>(cfg.batch)) {
+      if (!cfg.round_batch) return false;  // drop tail
+      pad = cfg.batch - static_cast<int>(remaining);
+    }
+    // batch index list: tail wraps round-robin to the order[] start
+    std::vector<size_t> batch_idx(cfg.batch);
+    for (int i = 0; i < cfg.batch; ++i)
+      batch_idx[i] = order[(cursor + i) % order.size()];
+    cursor += cfg.batch - pad;
     float* data = bufs[slot].data();
     float* labels = label_bufs[slot].data();
     size_t sample_sz = static_cast<size_t>(cfg.h) * cfg.w * cfg.c;
     int nthreads = cfg.threads > 1 ? cfg.threads : 1;
     std::vector<std::thread> ts;
     std::atomic<int> next(0);
+    std::atomic<int64_t> errs(0);
     for (int t = 0; t < nthreads; ++t) {
-      ts.emplace_back([&, t]() {
-        std::mt19937_64 lrng(cfg.seed ^ (base * 1315423911u) ^ (t * 2654435761u));
+      ts.emplace_back([&]() {
+        FILE* f = fopen(path.c_str(), "rb");
+        std::vector<char> rec;
         int i;
         while ((i = next.fetch_add(1)) < cfg.batch) {
-          Sample(records[order[base + i]], data + i * sample_sz, labels + i,
-                 &lrng);
+          size_t ridx = batch_idx[i];
+          // per-sample RNG: augmentation is a pure function of
+          // (seed, record index, epoch) — independent of thread
+          // scheduling, but fresh each epoch.
+          std::mt19937_64 lrng(Mix64(cfg.seed ^ Mix64(ridx) ^
+                                     Mix64(epoch * 0xA5A5A5A5ULL + 1)));
+          if (!f || fseeko(f, static_cast<off_t>(offsets[ridx]), SEEK_SET) != 0 ||
+              recio::ReadRecord(f, &rec) < 0) {
+            std::memset(data + i * sample_sz, 0, sizeof(float) * sample_sz);
+            labels[i] = 0.f;
+            errs.fetch_add(1);
+            continue;
+          }
+          Sample(rec, data + i * sample_sz, labels + i, &lrng);
         }
+        if (f) fclose(f);
       });
     }
     for (auto& th : ts) th.join();
+    slot_errors[slot] = errs.load();  // published under mu with ready flag
+    slot_pad[slot] = pad;
     return true;
   }
 
@@ -268,30 +313,35 @@ extern "C" {
 void* ImRecIterCreate(const char* rec_path, int batch, int h, int w, int c,
                       int threads, int shuffle, uint64_t seed, int rand_crop,
                       int rand_mirror, const float* mean, const float* stdv,
-                      float scale, int layout, int resize) {
+                      float scale, int layout, int resize, int round_batch) {
   auto* it = new Iter();
   it->cfg = Config{batch, h, w, c, threads, shuffle, seed, rand_crop,
                    rand_mirror, {mean[0], mean[1], mean[2]},
-                   {stdv[0], stdv[1], stdv[2]}, scale, layout, resize};
+                   {stdv[0], stdv[1], stdv[2]}, scale, layout, resize,
+                   round_batch};
   it->rng.seed(seed);
+  it->path = rec_path;
   FILE* f = fopen(rec_path, "rb");
   if (!f) {
     delete it;
     return nullptr;
   }
+  // Index pass: record byte offsets only (O(16B/record) host RAM);
+  // payloads are streamed back in per batch by the decode workers.
   std::vector<char> buf;
   while (true) {
+    off_t pos = ftello(f);
     int64_t n = recio::ReadRecord(f, &buf);
     if (n == -1) break;  // clean EOF
-    if (n < 0) {         // corrupt stream: refuse (Python path raises too)
+    if (n < 0 || pos < 0) {  // corrupt stream (Python path raises too)
       fclose(f);
       delete it;
       return nullptr;
     }
-    it->records.emplace_back(buf.begin(), buf.end());
+    it->offsets.push_back(static_cast<int64_t>(pos));
   }
   fclose(f);
-  it->order.resize(it->records.size());
+  it->order.resize(it->offsets.size());
   for (size_t i = 0; i < it->order.size(); ++i) it->order[i] = i;
   if (shuffle) std::shuffle(it->order.begin(), it->order.end(), it->rng);
   size_t sample_sz = static_cast<size_t>(h) * w * c;
@@ -310,11 +360,15 @@ void* ImRecIterCreate(const char* rec_path, int batch, int h, int w, int c,
 }
 
 int64_t ImRecIterNumRecords(void* handle) {
-  return static_cast<Iter*>(handle)->records.size();
+  return static_cast<Iter*>(handle)->offsets.size();
 }
 
-// Copy next ready batch into out buffers; returns 1 ok, 0 epoch end.
-int ImRecIterNext(void* handle, float* data_out, float* label_out) {
+// Copy next ready batch into out buffers.  Returns 1 ok, 0 epoch end,
+// -1 streaming read failure in THIS batch (zero-filled samples —
+// caller should raise rather than train on garbage).  *pad_out = number
+// of wrapped samples when round_batch filled a tail batch.
+int ImRecIterNext(void* handle, float* data_out, float* label_out,
+                  int* pad_out) {
   auto* it = static_cast<Iter*>(handle);
   int slot = 1 - it->consumed_slot;
   {
@@ -327,6 +381,11 @@ int ImRecIterNext(void* handle, float* data_out, float* label_out) {
       return 0;
     }
     it->ready[slot] = 0;
+    if (it->slot_errors[slot] > 0) {
+      it->slot_errors[slot] = 0;
+      return -1;
+    }
+    if (pad_out) *pad_out = it->slot_pad[slot];
   }
   std::memcpy(data_out, it->bufs[slot].data(),
               it->bufs[slot].size() * sizeof(float));
@@ -349,7 +408,10 @@ void ImRecIterReset(void* handle) {
     // drain: no pending request and no fill in flight
     it->cv.wait(lk, [&] { return it->pending_slot < 0 && !it->filling; });
     it->cursor = 0;
+    it->epoch += 1;
     it->ready[0] = it->ready[1] = 0;
+    it->slot_errors[0] = it->slot_errors[1] = 0;
+    it->slot_pad[0] = it->slot_pad[1] = 0;
     it->exhausted = false;
     if (it->cfg.shuffle) std::shuffle(it->order.begin(), it->order.end(), it->rng);
     it->consumed_slot = 1;
