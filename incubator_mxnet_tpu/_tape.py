@@ -30,15 +30,21 @@ __all__ = [
 
 
 class TapeNode:
-    """One recorded op: inputs/outputs are NDArrays, vjp the pullback."""
+    """One recorded op: inputs/outputs are NDArrays, vjp the pullback.
 
-    __slots__ = ("inputs", "outputs", "vjp", "n_out")
+    `pending` is set only by hybridized cached-op calls whose dispatch
+    was deferred (engine.py lazy step composition) — it lets
+    `autograd.backward` and `Trainer.step` fuse the whole step.
+    """
+
+    __slots__ = ("inputs", "outputs", "vjp", "n_out", "pending")
 
     def __init__(self, inputs: Sequence[Any], outputs: Sequence[Any], vjp: Callable, n_out: int):
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.vjp = vjp
         self.n_out = n_out
+        self.pending = None
 
 
 class _State(threading.local):

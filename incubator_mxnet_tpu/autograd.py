@@ -106,6 +106,8 @@ def backward(heads: Sequence[NDArray], head_grads: Optional[Sequence] = None,
     """Reverse tape walk (Imperative::Backward equivalence)."""
     if isinstance(heads, NDArray):
         heads = [heads]
+    if _try_lazy_backward(heads, head_grads, retain_graph):
+        return
     grads = {}  # id(NDArray) -> raw cotangent
     for i, h in enumerate(heads):
         if not h._in_graph:
@@ -144,6 +146,39 @@ def backward(heads: Sequence[NDArray], head_grads: Optional[Sequence] = None,
 
     if not retain_graph:
         _tape.new_tape()
+
+
+def _try_lazy_backward(heads, head_grads, retain_graph) -> bool:
+    """Defer the backward of a single still-lazy hybridized step.
+
+    Conditions (the common training-loop shape): one tape node carrying
+    a pending step whose forward has not been forced, default head
+    grads, all heads are the node's outputs, every grad-carrying input
+    has grad_req='write'.  On success the inputs' `.grad` arrays become
+    LazyRefs; `Trainer.step` can then fuse fwd+bwd+update into one
+    program, or any access forces the staged jits (engine.py).
+    """
+    tape = _tape.current_tape()
+    if head_grads is not None or len(tape) != 1 or retain_graph:
+        return False
+    node = tape[0]
+    pending = getattr(node, "pending", None)
+    if pending is None or pending.fwd_done or pending.bwd_requested:
+        return False
+    if len(heads) != len(node.outputs):
+        return False
+    for h, o in zip(heads, node.outputs):
+        if h is not o or h._grad_req != "null":
+            return False
+    targets = []
+    for pos, inp in enumerate(node.inputs):
+        if inp._grad_req == "add":
+            return False  # accumulation needs the eager walk
+        if inp._grad_req == "write" and inp._grad is not None:
+            targets.append((pos, inp))
+    pending.request_bwd(targets)
+    _tape.new_tape()
+    return True
 
 
 def _accum(grads, arr: NDArray, g):

@@ -1,0 +1,48 @@
+"""Lazy step composition — the dependency-engine equivalence.
+
+Re-design of the reference's async dependency engine
+(`include/mxnet/engine.h`, `src/engine/threaded_engine*.cc`
+[UNVERIFIED], SURVEY.md §1 L2, §3.1): in MXNet every op is pushed
+asynchronously and values materialize only at a sync point
+(`wait_to_read` / `asnumpy`).  On TPU the XLA analogue is *program
+composition*: a hybridized forward, its backward, and the optimizer
+update belong in ONE compiled program so XLA can overlap the
+optimizer's HBM traffic with backward compute and skip intermediate
+materialization.
+
+Mechanism: `HybridBlock.__call__` under `autograd.record()` does not
+dispatch — it returns NDArrays whose `_data` is a :class:`LazyRef`
+into a pending step.  `backward()` on such a head defers too.
+`Trainer.step()` then compiles the whole (fwd + vjp + fused update)
+into a single donated jit.  ANY other access to a lazy value (shape
+and dtype excluded — they come from avals) forces the pending stage to
+execute via the separately-cached fwd/bwd jits, preserving eager
+semantics exactly (the `WaitForVar` equivalence).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+__all__ = ["LazyRef"]
+
+
+class LazyRef:
+    """A placeholder for a raw array that a pending program will produce.
+
+    `aval` carries shape/dtype so metadata access never forces.
+    `force_fn` runs the owning pending stage, which fills `value` for
+    every ref that stage produces (then drops `force_fn`).
+    """
+
+    __slots__ = ("force_fn", "aval", "value")
+
+    def __init__(self, force_fn: Callable[[], None], aval):
+        self.force_fn: Optional[Callable[[], None]] = force_fn
+        self.aval = aval
+        self.value: Any = None
+
+    def force(self):
+        if self.value is None and self.force_fn is not None:
+            self.force_fn()
+            self.force_fn = None
+        return self.value

@@ -28,6 +28,7 @@ from .. import _tape, autograd
 from .. import ndarray as nd_mod
 from .. import random as _random
 from ..base import MXNetError
+from ..engine import LazyRef
 from ..ndarray.ndarray import NDArray, raw, wrap
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
@@ -247,28 +248,148 @@ class Block:
         return s + ")"
 
 
+class _PendingStep:
+    """A deferred hybridized step (engine.py lazy composition).
+
+    Holds everything needed to run the cached forward / backward jits
+    later — or to let `Trainer.step` compile fwd+vjp+update as ONE
+    program.  Values materialize through LazyRef cells on demand.
+    """
+
+    __slots__ = ("block", "training", "none_mask", "train_raws", "aux_raws",
+                 "rng", "rng_ctr", "input_raws", "out_treedef", "out_avals",
+                 "out_cells", "aux_params", "aux_cells", "fwd_done", "pullback",
+                 "bwd_requested", "bwd_done", "grad_cells", "n_train")
+
+    def __init__(self, block, training, none_mask, train_raws, aux_raws, rng,
+                 rng_ctr, input_raws, out_treedef, out_avals, aux_params):
+        self.block = block
+        self.training = training
+        self.none_mask = none_mask
+        self.train_raws = train_raws
+        self.aux_raws = aux_raws
+        self.rng = rng
+        self.rng_ctr = rng_ctr
+        self.input_raws = tuple(input_raws)
+        self.out_treedef = out_treedef
+        self.out_avals = list(out_avals)
+        self.out_cells = [LazyRef(self.force_fwd, a) for a in out_avals]
+        self.aux_params = aux_params
+        self.aux_cells = []
+        self.fwd_done = False
+        self.pullback = None
+        self.bwd_requested = False
+        self.bwd_done = False
+        self.grad_cells: Dict[int, LazyRef] = {}  # input position -> cell
+        self.n_train = len(train_raws)
+
+    # -- stage execution (the WaitForVar equivalences) ------------------- #
+    def force_fwd(self):
+        if self.fwd_done:
+            return
+        blk = self.block
+        # rebind aux params to their captured concrete values first —
+        # apply_fn's save/rebind would otherwise force our own cells
+        for p, cell, a in zip(self.aux_params, self.aux_cells, self.aux_raws):
+            if p._data_nd._lazy is cell:
+                p._data_nd._data = a
+        out_raws, new_aux, pullback = blk._cached_fwd_record(
+            self.training, self.none_mask, self.train_raws, self.aux_raws,
+            self.rng, self.rng_ctr, self.input_raws)
+        leaves = jax.tree_util.tree_leaves(out_raws)
+        for cell, v in zip(self.out_cells, leaves):
+            cell.value = v
+        for p, cell, v in zip(self.aux_params, self.aux_cells, new_aux):
+            cell.value = v
+            p._data_nd._data = v
+        self.pullback = pullback
+        self.fwd_done = True
+
+    def request_bwd(self, targets):
+        """targets: [(input_position, param_NDArray)] with grad_req='write'."""
+        force = self.force_bwd
+        cells = self.grad_cells
+        for pos, nd in targets:
+            g = nd._grad
+            # reuse the existing grad buffer's aval (or a previous lazy
+            # cell's) — constructing ShapeDtypeStructs per param per step
+            # costs real milliseconds at BERT scale
+            aval = g._lazy.aval if g._lazy is not None else g._raw.aval
+            cell = LazyRef(force, aval)
+            g._data = cell
+            cells[pos] = cell
+        self.bwd_requested = True
+
+    def force_bwd(self):
+        if self.bwd_done:
+            return
+        self.force_fwd()
+        cts = [jnp.ones(a.shape, a.dtype) for a in self.out_avals]
+        cot_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
+        d_train, d_ins = self.block._cached_bwd_record(self.pullback, cot_tree)
+        all_d = tuple(d_train) + tuple(d_ins)
+        for pos, cell in self.grad_cells.items():
+            cell.value = all_d[pos]
+        self.bwd_done = True
+
+    def fill_from_full_step(self, out_leaves, new_aux, grads):
+        """Called by Trainer after the fused single-program step ran."""
+        for cell, v in zip(self.out_cells, out_leaves):
+            cell.value = v
+        for p, cell, v in zip(self.aux_params, self.aux_cells, new_aux):
+            cell.value = v
+            if p._data_nd._lazy is cell:
+                p._data_nd._data = v
+        for pos, cell in self.grad_cells.items():
+            if pos < self.n_train:
+                cell.value = grads[pos]
+        self.fwd_done = True
+        self.bwd_done = True
+        self.pullback = None
+
+
 class HybridBlock(Block):
     """Block that can be compiled: ``hybridize()`` → `jax.jit` cache."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix, params)
         self._active = False
+        self._remat_backward = False
         self._jit_kwargs: Dict[str, Any] = {}
         self._cached_fn = None
         self._cached_param_order: Optional[List[Parameter]] = None
+        self._aval_cache: Dict = {}
+        self._cache_version = 0  # bumped on every _build_cache (Trainer key)
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
-                  static_shape: bool = False, **kwargs):
+                  static_shape: bool = False, remat_backward: bool = False,
+                  **kwargs):
         """Enable compiled execution (CachedOp ≡ jax.jit, SURVEY.md §3.3).
 
         static_alloc/static_shape accepted for reference parity; XLA is
         always static — they are no-ops.
+
+        remat_backward (TPU extension): when True, the cached backward
+        recomputes the forward instead of saving residuals between the
+        forward and backward jits (`jax.checkpoint`-style FLOPs-for-HBM
+        trade — use for long-context / memory-bound training).  Default
+        False: forward saves residuals, backward reuses them — the
+        standard 1-fwd + 1-bwd FLOP budget.
         """
         self._active = active
+        self._remat_backward = remat_backward
         self._cached_fn = None
+        self._aval_cache = {}
         for c in self._children.values():
             if isinstance(c, HybridBlock):
                 c.hybridize(active, static_alloc=static_alloc, static_shape=static_shape, **kwargs)
+        return self
+
+    def cast(self, dtype):
+        """Parameter dtype changes invalidate cached programs and avals."""
+        super().cast(dtype)
+        self._cached_fn = None
+        self._aval_cache = {}
         return self
 
     def infer_shape(self, *args):
@@ -296,31 +417,62 @@ class HybridBlock(Block):
 
     # -- the CachedOp equivalence ---------------------------------------- #
     def _build_cache(self):
+        self._cache_version += 1
+        self._aval_cache = {}
         params = self.collect_params()
         trainable = [p for p in params.values() if p.grad_req != "null" and p._data_nd is not None]
         aux = [p for p in params.values() if p.grad_req == "null" and p._data_nd is not None]
         self._cached_param_order = (trainable, aux)
         apply_fn = _make_apply_fn(self, trainable, aux, call_forward=True)
 
-        def raw_fn(training: bool, train_raws: Tuple, aux_raws: Tuple, rng_key, *input_raws):
-            return apply_fn(train_raws, aux_raws, rng_key, *input_raws,
+        def raw_fn(training: bool, none_mask: Tuple, train_raws: Tuple,
+                   aux_raws: Tuple, rng_key, rng_ctr, *input_raws):
+            # none_mask marks positional args that were None at call time
+            # (e.g. optional token_types/valid_length) — static, part of
+            # the jit cache key like any shape/dtype signature change.
+            # rng_ctr is folded in HERE so callers pass a stable base key
+            # + a python counter: zero eager RNG dispatches per step.
+            it = iter(input_raws)
+            full = [None if m else next(it) for m in none_mask]
+            key = jax.random.fold_in(rng_key, rng_ctr)
+            return apply_fn(train_raws, aux_raws, key, *full,
                             training=training)
 
-        self._cached_fn = jax.jit(raw_fn, static_argnums=0)
+        self._cached_fn = jax.jit(raw_fn, static_argnums=(0, 1))
 
-        def grad_fn(training, train_raws, aux_raws, rng, input_raws, cots):
+        def grad_fn(training, none_mask, train_raws, aux_raws, rng, rng_ctr,
+                    input_raws, cots):
             def f(tr, ins):
-                out, _new_aux = raw_fn(training, tr, aux_raws, rng, *ins)
+                out, _new_aux = raw_fn(training, none_mask, tr, aux_raws,
+                                       rng, rng_ctr, *ins)
                 return out
 
             _out, vjp = jax.vjp(f, tuple(train_raws), tuple(input_raws))
             d_train, d_ins = vjp(cots)
             return d_train, d_ins
 
-        # CachedOp::Backward equivalence: the backward graph is itself
-        # compiled once per shape (forward recomputed inside — full
-        # rematerialization, HBM-friendly and avoids cross-jit residuals)
-        self._cached_grad = jax.jit(grad_fn, static_argnums=0)
+        # CachedOp::Backward equivalence, remat flavor: the backward
+        # graph recomputes the forward inside (jax.checkpoint-style
+        # FLOPs-for-HBM trade, opt-in via hybridize(remat_backward=True))
+        self._cached_grad = jax.jit(grad_fn, static_argnums=(0, 1))
+
+        def fwd_record_fn(training, none_mask, train_raws, aux_raws, rng,
+                          rng_ctr, input_raws):
+            def f(tr, ins):
+                return raw_fn(training, none_mask, tr, aux_raws,
+                              rng, rng_ctr, *ins)  # (out, new_aux)
+
+            out, pullback, new_aux = jax.vjp(
+                f, tuple(train_raws), tuple(input_raws), has_aux=True)
+            # pullback is a jax.tree_util.Partial pytree: its leaves are
+            # the forward residuals, so it round-trips through jit — the
+            # backward jit below consumes them without recomputing the
+            # forward (standard fwd+bwd FLOP budget, CachedOp::Backward
+            # with saved intermediates)
+            return out, new_aux, pullback
+
+        self._cached_fwd_record = jax.jit(fwd_record_fn, static_argnums=(0, 1))
+        self._cached_bwd_record = jax.jit(lambda pullback, cots: pullback(cots))
 
     def _call_cached_op(self, *args):
         if self._cached_fn is None:
@@ -329,22 +481,86 @@ class HybridBlock(Block):
         trainable, aux = self._cached_param_order
         train_raws = tuple(p._data_nd._data for p in trainable)
         aux_raws = tuple(p._data_nd._data for p in aux)
-        input_nds = [wrap(a) for a in args]
+        none_mask = tuple(a is None for a in args)
+        input_nds = [wrap(a) for a in args if a is not None]
         input_raws = [a._data for a in input_nds]
-        rng = _random.next_key()
+        rng, rng_ctr = _random.step_key()
         training = _tape.is_training()
         fn = self._cached_fn
 
         recording = _tape.is_recording()
         if not recording:
-            out_raws, new_aux = fn(training, train_raws, aux_raws, rng, *input_raws)
+            out_raws, new_aux = fn(training, none_mask, train_raws, aux_raws,
+                                   rng, rng_ctr, *input_raws)
             for p, r in zip(aux, new_aux):
                 p._data_nd._data = r
             return jax.tree_util.tree_map(NDArray, out_raws)
 
-        # one tape node for the whole compiled program; backward runs the
-        # separately-jitted cached grad (no per-call retracing)
-        out_raws, new_aux = fn(training, train_raws, aux_raws, rng, *input_raws)
+        if self._remat_backward:
+            return self._record_remat(training, none_mask, trainable, aux,
+                                      train_raws, aux_raws, rng, rng_ctr,
+                                      input_nds, input_raws)
+
+        # LAZY recording path (dependency-engine equivalence, engine.py):
+        # do NOT dispatch — return LazyRef-backed NDArrays and register a
+        # pending step.  Trainer.step() may compile the whole
+        # fwd+backward+update as one donated program; any eager value
+        # access instead forces the staged fwd/bwd jits.
+        sig = (training, none_mask,
+               tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
+        spec = self._aval_cache.get(sig)
+        if spec is None:
+            import functools
+
+            out_shape, aux_shape = jax.eval_shape(
+                functools.partial(fn, training, none_mask),
+                train_raws, aux_raws, rng, rng_ctr, *input_raws)
+            leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
+            spec = (treedef, leaves_avals)
+            self._aval_cache[sig] = spec
+        treedef, out_avals = spec
+
+        pending = _PendingStep(self, training, none_mask, train_raws, aux_raws,
+                               rng, rng_ctr, input_raws, treedef, out_avals, aux)
+        # aux params go lazy too: they are rebound to cells the pending
+        # fills (a read before the step forces the staged forward)
+        for p, a in zip(aux, aux_raws):
+            cell = LazyRef(pending.force_fwd,
+                           jax.ShapeDtypeStruct(a.shape, a.dtype))
+            pending.aux_cells.append(cell)
+            p._data_nd._data = cell
+
+        out_nds = []
+        for cell in pending.out_cells:
+            ndo = NDArray(cell)
+            ndo._in_graph = True
+            out_nds.append(ndo)
+
+        tape_inputs = [p._data_nd for p in trainable] + input_nds
+        cached_bwd = self._cached_bwd_record
+        out_dtypes = [a.dtype for a in out_avals]
+
+        def node_vjp(cotangents):
+            # eager tape walk (multi-node tapes, custom head grads):
+            # force the staged forward, then run the cached backward
+            pending.force_fwd()
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            cts = tuple(c.astype(dt) if c.dtype != dt else c
+                        for c, dt in zip(cts, out_dtypes))
+            cot_tree = jax.tree_util.tree_unflatten(treedef, list(cts))
+            d_train, d_ins = cached_bwd(pending.pullback, cot_tree)
+            return tuple(d_train) + tuple(d_ins)
+
+        node = _tape.TapeNode(tape_inputs, out_nds, node_vjp, len(out_nds))
+        node.pending = pending
+        _tape.append_node(node)
+        return jax.tree_util.tree_unflatten(treedef, out_nds)
+
+    def _record_remat(self, training, none_mask, trainable, aux, train_raws,
+                      aux_raws, rng, rng_ctr, input_nds, input_raws):
+        """Eager recording with rematerializing backward (long-context mode)."""
+        out_raws, new_aux = self._cached_fn(training, none_mask, train_raws,
+                                            aux_raws, rng, rng_ctr, *input_raws)
         for p, r in zip(aux, new_aux):
             p._data_nd._data = r
         leaves, treedef = jax.tree_util.tree_flatten(out_raws)
@@ -356,11 +572,15 @@ class HybridBlock(Block):
 
         tape_inputs = [p._data_nd for p in trainable] + input_nds
         cached_grad = self._cached_grad
+        out_dtypes = [o.dtype for o in leaves]
 
         def node_vjp(cotangents):
             cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            cts = tuple(c.astype(dt) if c.dtype != dt else c
+                        for c, dt in zip(cts, out_dtypes))
             cot_tree = jax.tree_util.tree_unflatten(treedef, list(cts))
-            d_train, d_ins = cached_grad(training, train_raws, aux_raws, rng,
+            d_train, d_ins = cached_grad(training, none_mask, train_raws,
+                                         aux_raws, rng, rng_ctr,
                                          tuple(input_raws), cot_tree)
             return tuple(d_train) + tuple(d_ins)
 
@@ -482,7 +702,8 @@ def _make_apply_fn(block: Block, trainable: List[Parameter], aux: List[Parameter
                 p._data_nd._data = r
             with _random.TraceKeyProvider(rng_key):
                 fn = block.forward if call_forward else block
-                outs = fn(*[wrap(i) for i in input_raws])
+                outs = fn(*[wrap(i) if i is not None else None
+                            for i in input_raws])
             out_raws = jax.tree_util.tree_map(
                 raw, outs, is_leaf=lambda v: isinstance(v, NDArray))
             new_aux = tuple(p._data_nd._data for p in aux)

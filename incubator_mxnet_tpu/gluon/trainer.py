@@ -2,21 +2,33 @@
 
 Re-design of `python/mxnet/gluon/trainer.py` [UNVERIFIED]
 (SURVEY.md §2.6, §3.2): owns the optimizer + a KVStore facade.
-`step(batch_size)` = allreduce_grads + update.  On TPU, parameters are
-single global (optionally mesh-sharded) arrays, so the per-key
-push/pull of the reference becomes: grads are already globally
-reduced by XLA collectives when the loss was computed under a sharded
-batch; the KVStore facade still runs `push/pull` for API and semantics
-parity (and applies gradient compression / dist scaling when
-configured).
+`step(batch_size)` = allreduce_grads + update.
+
+TPU-first fast path: when the configuration allows (no dist kvstore, no
+server-side updater, no gradient compression), `step()` compiles ONE
+jitted multi-tensor update over the whole parameter set — every
+parameter's `optimizer.pure_update` stacked in a single XLA program
+with the weight/state buffers donated.  This is the equivalent of the
+reference's fused `multi_sgd_update`/`multi_lamb` multi-tensor ops
+(SURVEY.md §2.3 "Optimizer ops"), generalized to all optimizers, and
+is what lets the public `autograd.record()` → `trainer.step()` loop
+run at hand-rolled-JAX speed instead of dispatching one kernel per
+parameter.
+
+On the slow (reference-parity) path, grads go per-key through the
+KVStore facade (push/pull, compression, dist reduction) and the
+optimizer runs per-parameter — identical observable semantics.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Union
 
+import jax
+
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
 from ..base import MXNetError
+from ..ndarray.ndarray import raw
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -25,7 +37,8 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params: Union[ParameterDict, List[Parameter], Dict],
                  optimizer, optimizer_params: Optional[dict] = None,
-                 kvstore="device", compression_params=None, update_on_kvstore=None):
+                 kvstore="device", compression_params=None, update_on_kvstore=None,
+                 fuse_step: bool = True, donate: bool = True):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -52,6 +65,13 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore if update_on_kvstore is not None else False
         self._kv_initialized = False
         self._states: Dict[int, object] = {}
+        # fused-step machinery
+        self._fuse_step = fuse_step
+        self._donate = donate
+        self._fused_fn = None
+        self._fused_key = None
+        self._fullstep_ctx = None
+        self._states_stale = False
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -82,13 +102,232 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    # ------------------------------------------------------------------ #
+    # fused fast path
+    # ------------------------------------------------------------------ #
+    def _can_fuse(self) -> bool:
+        if not self._fuse_step or self._update_on_kvstore:
+            return False
+        kv = self._kvstore
+        if kv is not None:
+            if kv._compression is not None or kv._updater is not None:
+                return False
+            if kv._is_dist and jax.process_count() > 1:
+                return False  # cross-host reduction needs the kvstore path
+        if type(self._optimizer).pure_update is opt_mod.Optimizer.pure_update:
+            return False  # custom optimizer without a pure rule
+        return True
+
+    def _build_fused(self, idxs):
+        opt = self._optimizer
+        lr_mults = [opt._lr_mult_for(i) for i in idxs]
+        wd_mults = [opt._wd_mult_for(i) for i in idxs]
+        clip = opt.clip_gradient
+        needs_rng = opt.needs_rng
+
+        def fused(weights, grads, states, t, lr, wd, rescale, keys):
+            new_w, new_s = [], []
+            for j in range(len(weights)):
+                k = keys[j] if needs_rng else None
+                nw, ns = opt.pure_update_multi_precision(
+                    weights[j], grads[j], states[j], t,
+                    lr * lr_mults[j], wd * wd_mults[j], rescale, clip, k)
+                new_w.append(nw)
+                new_s.append(ns)
+            return tuple(new_w), tuple(new_s)
+
+        donate = (0, 2) if self._donate else ()
+        self._fused_fn = jax.jit(fused, donate_argnums=donate)
+
+    def _fused_step(self):
+        opt = self._optimizer
+        self._sync_states()
+        # this path donates/replaces the state buffers the fullstep ctx
+        # still references — drop the ctx so the next full step re-reads
+        self._fullstep_ctx = None
+        idxs = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null" and p._data_nd is not None]
+        key = tuple(idxs)
+        if self._fused_fn is None or self._fused_key != key:
+            self._fused_key = key
+            for i in idxs:
+                if i not in self._states:
+                    self._states[i] = opt.create_state_multi_precision(
+                        i, self._params[i].data())
+            self._build_fused(idxs)
+        # bookkeeping identical to the eager per-param path
+        for i in idxs:
+            opt._update_count(i)
+        t = float(opt.num_update)
+        lr = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
+        keys = None
+        if opt.needs_rng:
+            from .. import random as _random
+
+            keys = tuple(_random.next_key() for _ in idxs)
+        weights = tuple(self._params[i]._data_nd._data for i in idxs)
+        grads = tuple(raw(self._params[i].grad()) for i in idxs)
+        states = tuple(self._states[i] for i in idxs)
+        new_w, new_s = self._fused_fn(weights, grads, states, t, lr, opt.wd,
+                                      opt.rescale_grad, keys)
+        for i, nw, ns in zip(idxs, new_w, new_s):
+            self._params[i]._data_nd._data = nw
+            self._states[i] = ns
+
+    # ------------------------------------------------------------------ #
+    # public step API
+    # ------------------------------------------------------------------ #
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update; grads rescaled by 1/batch_size."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._can_fuse():
+            pending = self._detect_pending()
+            if pending is not None and self._try_full_step(pending):
+                return
+            self._fused_step()
+            return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    # ------------------------------------------------------------------ #
+    # single-program step: fwd + vjp + update in ONE donated jit
+    # (the dependency-engine composition, engine.py)
+    # ------------------------------------------------------------------ #
+    def _detect_pending(self):
+        """All managed grads must be LazyRefs of ONE unforced pending step."""
+        pending = None
+        for p in self._params:
+            if p.grad_req == "null" or p._data_nd is None:
+                continue
+            g = p._data_nd._grad
+            if g is None or g._lazy is None:
+                return None
+            pend = getattr(g._lazy.force_fn, "__self__", None)
+            if pend is None or (pending is not None and pend is not pending):
+                return None
+            pending = pend
+        if (pending is None or pending.fwd_done or pending.bwd_done
+                or not pending.bwd_requested):
+            return None
+        # non-parameter graph inputs wanting grads (x.attach_grad()) need
+        # the staged bwd path — the full-step program differentiates
+        # w.r.t. parameters only and would leave their cells unfillable
+        for pos in pending.grad_cells:
+            if pos >= pending.n_train:
+                return None
+        return pending
+
+    def _try_full_step(self, pending) -> bool:
+        opt = self._optimizer
+        block = pending.block
+        sig = (id(block), block._cache_version, pending.training,
+               pending.none_mask,
+               tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
+        ctx = self._fullstep_ctx
+        if ctx is None or ctx["sig"] != sig:
+            ctx = self._prepare_full_step(pending, sig)
+            if ctx is None:
+                return False
+            self._fullstep_ctx = ctx
+        idx_of = ctx["idx_of"]
+        # bookkeeping identical to the eager per-param path
+        for i in idx_of:
+            opt._update_count(i)
+        t = float(opt.num_update)
+        lr = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
+        keys = None
+        if opt.needs_rng:
+            from .. import random as _random
+
+            keys = tuple(_random.next_key() for _ in idx_of)
+        states = ctx["states"]
+        out_leaves, new_aux, grads, new_w, new_s = ctx["fn"](
+            pending.train_raws, pending.aux_raws, states, pending.rng,
+            pending.rng_ctr, pending.input_raws, t, lr, opt.wd,
+            opt.rescale_grad, keys)
+        pending.fill_from_full_step(out_leaves, new_aux, grads)
+        for nd, nw in zip(ctx["nds"], new_w):
+            nd._data = nw
+        ctx["states"] = new_s
+        self._states_stale = True  # dict synced lazily (save_states/fallback)
+        return True
+
+    def _prepare_full_step(self, pending, sig):
+        """Resolve block→trainer param mapping, states, and the jitted fn."""
+        opt = self._optimizer
+        block = pending.block
+        trainable, _aux = block._cached_param_order
+        nd2idx = {id(p._data_nd): i for i, p in enumerate(self._params)}
+        idx_of = []
+        for bp in trainable:
+            i = nd2idx.get(id(bp._data_nd))
+            if i is None:
+                return None  # block param not managed by this trainer
+            idx_of.append(i)
+        managed = {i for i, p in enumerate(self._params)
+                   if p.grad_req != "null" and p._data_nd is not None}
+        if set(idx_of) != managed:
+            return None  # stale grads would go unnoticed — fall back
+        self._sync_states()
+        for i in idx_of:
+            if i not in self._states:
+                self._states[i] = opt.create_state_multi_precision(
+                    i, self._params[i].data())
+        fn = self._build_full_step(pending, idx_of)
+        return {
+            "sig": sig,
+            "idx_of": idx_of,
+            "nds": [self._params[i]._data_nd for i in idx_of],
+            "states": tuple(self._states[i] for i in idx_of),
+            "fn": fn,
+        }
+
+    def _sync_states(self):
+        """Write the fullstep ctx's states back into the per-index dict."""
+        ctx = self._fullstep_ctx
+        if ctx is not None and self._states_stale:
+            self._states.update(zip(ctx["idx_of"], ctx["states"]))
+        self._states_stale = False
+
+    def _build_full_step(self, pending, idx_of):
+        import jax.numpy as jnp
+
+        opt = self._optimizer
+        block = pending.block
+        raw_fn_jit = block._cached_fn  # jitted; inlines when traced inside jit
+        training, none_mask = pending.training, pending.none_mask
+        treedef = pending.out_treedef
+        lr_mults = [opt._lr_mult_for(i) for i in idx_of]
+        wd_mults = [opt._wd_mult_for(i) for i in idx_of]
+        clip = opt.clip_gradient
+        needs_rng = opt.needs_rng
+
+        def full(train_raws, aux_raws, states, rng, rng_ctr, input_raws, t, lr,
+                 wd, rescale, keys):
+            def f(tr):
+                out, new_aux = raw_fn_jit(training, none_mask, tr, aux_raws,
+                                          rng, rng_ctr, *input_raws)
+                return out, new_aux
+
+            out, pullback, new_aux = jax.vjp(f, tuple(train_raws), has_aux=True)
+            cot = jax.tree_util.tree_map(jnp.ones_like, out)
+            (grads,) = pullback(cot)
+            new_w, new_s = [], []
+            for j in range(len(train_raws)):
+                k = keys[j] if needs_rng else None
+                nw, ns = opt.pure_update_multi_precision(
+                    train_raws[j], grads[j], states[j], t,
+                    lr * lr_mults[j], wd * wd_mults[j], rescale, clip, k)
+                new_w.append(nw)
+                new_s.append(ns)
+            out_leaves = jax.tree_util.tree_leaves(out)
+            return (tuple(out_leaves), new_aux, tuple(grads),
+                    tuple(new_w), tuple(new_s))
+
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(full, donate_argnums=donate)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -112,6 +351,8 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        self._sync_states()
+        self._fullstep_ctx = None  # eager updates replace ctx-held states
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data_nd is None:
                 continue
@@ -127,6 +368,7 @@ class Trainer:
 
         import jax
 
+        self._sync_states()
         with open(fname, "wb") as f:
             states_host = jax.tree_util.tree_map(lambda x: jax.device_get(x), self._states)
             pickle.dump({"states": states_host,
@@ -137,13 +379,13 @@ class Trainer:
     def load_states(self, fname):
         import pickle
 
-        import jax.numpy as jnp
-
         with open(fname, "rb") as f:
             blob = pickle.load(f)
         self._states = {k: _to_device(v) for k, v in blob["states"].items()}
         self._optimizer.num_update = blob["num_update"]
         self._optimizer._index_update_count = blob["index_update_count"]
+        self._fullstep_ctx = None  # loaded states invalidate the cached tuple
+        self._states_stale = False
 
 
 def _to_device(v):

@@ -47,9 +47,20 @@ def load_or_build(name: str, ldflags=()) -> Optional[ctypes.CDLL]:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (subprocess.CalledProcessError, FileNotFoundError,
                 subprocess.TimeoutExpired):
-            return None
-        with open(hashfile, "w") as f:
-            f.write(want)
+            if os.path.exists(so):
+                # no compiler on this host but a previously built lib is
+                # present (e.g. pre-.srchash build): loading it beats
+                # silently dropping to the slow Python fallback
+                import warnings
+
+                warnings.warn(
+                    f"native/{name}: rebuild failed; loading existing "
+                    f"lib{name}.so of unverified provenance")
+            else:
+                return None
+        else:
+            with open(hashfile, "w") as f:
+                f.write(want)
     try:
         return ctypes.CDLL(so)
     except OSError:

@@ -382,7 +382,15 @@ int ImRecIterNext(void* handle, float* data_out, float* label_out,
     }
     it->ready[slot] = 0;
     if (it->slot_errors[slot] > 0) {
+      // consume the bad batch and keep the pipeline moving — otherwise a
+      // caller that catches the error and retries Next() waits forever on
+      // a slot nothing will ever refill
       it->slot_errors[slot] = 0;
+      if (pad_out) *pad_out = 0;
+      it->consumed_slot = slot;
+      it->pending_slot = 1 - slot;
+      lk.unlock();
+      it->cv.notify_all();
       return -1;
     }
     if (pad_out) *pad_out = it->slot_pad[slot];

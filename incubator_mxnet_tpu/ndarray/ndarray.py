@@ -24,6 +24,7 @@ import numpy as onp
 from .. import _tape
 from ..base import MXNetError
 from ..context import Context, current_context
+from ..engine import LazyRef as _LazyRef
 
 __all__ = [
     "NDArray",
@@ -66,24 +67,35 @@ def _is_tracer(x) -> bool:
 
 
 class NDArray:
-    """Imperative N-dimensional array backed by a `jax.Array` (or tracer)."""
+    """Imperative N-dimensional array backed by a `jax.Array` (or tracer).
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_in_graph", "_ctx")
+    `_data` may also be bound to an `engine.LazyRef` — a placeholder for
+    the output of a pending compiled step (the async dependency-engine
+    equivalence, see `engine.py`).  Reading `_data` forces the pending
+    program; `shape`/`dtype`/`ndim` read the aval and never force.
+    """
+
+    __slots__ = ("_raw", "_lazy", "_grad", "_grad_req", "_in_graph", "_ctx")
     __array_priority__ = 100.0
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
         if isinstance(data, NDArray):
             data = data._data
-        if not isinstance(data, (jax.Array, _TracerBase)):
-            data = jnp.asarray(data, dtype=dtype)
-        elif dtype is not None and data.dtype != jnp.dtype(dtype):
-            data = data.astype(dtype)
-        if ctx is not None and not _is_tracer(data):
-            dev = ctx.to_jax_device()
-            if dev is not None and getattr(data, "devices", None) is not None:
-                if dev not in data.devices():
-                    data = jax.device_put(data, dev)
-        self._data = data
+        if isinstance(data, _LazyRef):
+            self._raw = None
+            self._lazy = data
+        else:
+            if not isinstance(data, (jax.Array, _TracerBase)):
+                data = jnp.asarray(data, dtype=dtype)
+            elif dtype is not None and data.dtype != jnp.dtype(dtype):
+                data = data.astype(dtype)
+            if ctx is not None and not _is_tracer(data):
+                dev = ctx.to_jax_device()
+                if dev is not None and getattr(data, "devices", None) is not None:
+                    if dev not in data.devices():
+                        data = jax.device_put(data, dev)
+            self._raw = data
+            self._lazy = None
         self._grad: Optional[NDArray] = None
         self._grad_req = "null"
         self._in_graph = False
@@ -93,12 +105,32 @@ class NDArray:
     # properties
     # ------------------------------------------------------------------ #
     @property
+    def _data(self):
+        lazy = self._lazy
+        if lazy is not None:
+            self._raw = lazy.force()
+            self._lazy = None
+        return self._raw
+
+    @_data.setter
+    def _data(self, value):
+        if isinstance(value, _LazyRef):
+            self._raw = None
+            self._lazy = value
+        else:
+            self._raw = value
+            self._lazy = None
+
+    @property
     def shape(self):
-        return tuple(self._data.shape)
+        if self._lazy is not None:
+            return tuple(self._lazy.aval.shape)
+        return tuple(self._raw.shape)
 
     @property
     def dtype(self):
-        return onp.dtype(str(self._data.dtype)) if self._data.dtype != jnp.bfloat16 else self._data.dtype
+        d = self._lazy.aval.dtype if self._lazy is not None else self._raw.dtype
+        return onp.dtype(str(d)) if d != jnp.bfloat16 else d
 
     @property
     def size(self):
@@ -106,7 +138,9 @@ class NDArray:
 
     @property
     def ndim(self):
-        return self._data.ndim
+        if self._lazy is not None:
+            return len(self._lazy.aval.shape)
+        return self._raw.ndim
 
     @property
     def context(self) -> Context:

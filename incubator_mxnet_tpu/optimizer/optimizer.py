@@ -3,15 +3,25 @@
 Re-design of the reference optimizer stack (SURVEY.md §2.6
 `python/mxnet/optimizer/optimizer.py` + §2.3 optimizer ops
 `src/operator/optimizer_op.cc`, `contrib/multi_lamb.cc` [UNVERIFIED]).
-Each update rule is ONE jitted functional kernel (weight, grad, state)
-→ (weight', state') with hyper-parameters passed as traced scalars so
-lr/wd changes never trigger recompiles.  XLA fuses the whole chain
-(rescale → clip → wd → moment update → apply) into a single elementwise
-kernel — the equivalent of the reference's hand-fused `sgd_mom_update`
-/ `adam_update` CUDA ops, for free.
+
+Every update rule is a PURE function ``pure_update(w, g, state, t, lr,
+wd, rescale, clip, key)`` → ``(w', state')`` with all step-varying
+hyper-parameters passed as traced scalars so lr/wd/step changes never
+trigger recompiles.  Two consumers:
+
+* eager `update()` / `update_multi_precision()` — reference API parity;
+  jits the pure function per optimizer instance (the equivalent of the
+  reference's hand-fused `sgd_mom_update` / `adam_update` CUDA ops).
+* `Trainer`'s fused step — stacks EVERY parameter's pure_update inside
+  ONE jit with buffer donation (the reference's `multi_sgd_update` /
+  `multi_lamb` multi-tensor fused ops, generalized to all optimizers).
 
 Multi-precision (`multi_precision=True`) keeps fp32 master weights for
-bf16 params — parity with the reference `mp_*` op variants.
+bf16/fp16 params — parity with the reference `mp_*` op variants.
+
+Note: rule-constant hyper-parameters (beta1/momentum/rho/...) are baked
+in at trace time; mutating them mid-run re-traces on the next call only
+if the jit cache is cleared (they practically never change mid-run).
 """
 from __future__ import annotations
 
@@ -44,6 +54,8 @@ def _prep(g, w, rescale, clip, wd):
 class Optimizer:
     """Base optimizer: per-weight state, lr/wd multipliers, loss-scale-aware."""
 
+    needs_rng = False  # subclasses that draw randomness set True (SGLD)
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  param_dict=None, multi_precision=False, begin_num_update=0, **kwargs):
@@ -62,6 +74,7 @@ class Optimizer:
         self.param_dict = param_dict or {}
         self.lr_mult: Dict = {}
         self.wd_mult: Dict = {}
+        self._jit_cache: Dict[bool, object] = {}
 
     # -- hyper-parameter plumbing (reference API parity) ---------------- #
     def set_learning_rate(self, lr):
@@ -89,21 +102,22 @@ class Optimizer:
 
     def _get_lr(self, index):
         lr = self.lr_scheduler(self.num_update) if self.lr_scheduler is not None else self.lr
+        return lr * self._lr_mult_for(index)
+
+    def _lr_mult_for(self, index) -> float:
         p = self.param_dict.get(index)
         if p is not None:
-            lr *= getattr(p, "lr_mult", 1.0)
-        else:
-            lr *= self.lr_mult.get(index, self.lr_mult.get(self.idx2name.get(index, ""), 1.0))
-        return lr
+            return getattr(p, "lr_mult", 1.0)
+        return self.lr_mult.get(index, self.lr_mult.get(self.idx2name.get(index, ""), 1.0))
 
     def _get_wd(self, index):
-        wd = self.wd
+        return self.wd * self._wd_mult_for(index)
+
+    def _wd_mult_for(self, index) -> float:
         p = self.param_dict.get(index)
         if p is not None:
-            wd *= getattr(p, "wd_mult", 1.0)
-        else:
-            wd *= self.wd_mult.get(index, self.wd_mult.get(self.idx2name.get(index, ""), 1.0))
-        return wd
+            return getattr(p, "wd_mult", 1.0)
+        return self.wd_mult.get(index, self.wd_mult.get(self.idx2name.get(index, ""), 1.0))
 
     # -- state ---------------------------------------------------------- #
     def create_state(self, index, weight: NDArray):
@@ -115,149 +129,67 @@ class Optimizer:
             return (master, self.create_state(index, NDArray(master)))
         return self.create_state(index, weight)
 
-    # -- update --------------------------------------------------------- #
-    def update(self, index, weight: NDArray, grad: NDArray, state):
+    # -- functional core ------------------------------------------------ #
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        """Pure update rule: raw arrays in → (new_w, new_state) out.
+
+        `t` (update count), `lr`, `wd`, `rescale`, `clip` are traced
+        scalars; `key` is a PRNG key for stochastic rules (needs_rng).
+        Must be side-effect free — it runs under jit (alone in the eager
+        path, stacked across all params in the Trainer's fused step).
+        """
         raise NotImplementedError
 
-    def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight._data.dtype in (jnp.float16, jnp.bfloat16):
+    def pure_update_multi_precision(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        """Multi-precision wrapper: state = (fp32 master, sub_state)."""
+        if self.multi_precision and w.dtype in (jnp.float16, jnp.bfloat16):
             master, sub = state
-            mw = NDArray(master)
-            new_sub = self.update(index, mw, grad, sub)
-            weight._data = mw._data.astype(weight._data.dtype)
-            return (mw._data, new_sub if new_sub is not None else sub)
-        return self.update(index, weight, grad, state)
+            new_master, new_sub = self.pure_update(
+                master, g, sub, t, lr, wd, rescale, clip, key)
+            return new_master.astype(w.dtype), (new_master, new_sub)
+        return self.pure_update(w, g, state, t, lr, wd, rescale, clip, key)
+
+    def _jitted(self, mp: bool):
+        fn = self._jit_cache.get(mp)
+        if fn is None:
+            target = self.pure_update_multi_precision if mp else self.pure_update
+            fn = jax.jit(target)
+            self._jit_cache[mp] = fn
+        return fn
+
+    # -- eager update (reference API) ----------------------------------- #
+    def _eager_update(self, index, weight, grad, state, mp: bool):
+        self._update_count(index)
+        t = float(self._index_update_count[index])
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        key = None
+        if self.needs_rng:
+            from .. import random as _random
+
+            key = _random.next_key()
+        new_w, new_state = self._jitted(mp)(
+            weight._data, raw(grad), state, t, lr, wd,
+            self.rescale_grad, self.clip_gradient, key)
+        weight._data = new_w
+        return new_state
+
+    def update(self, index, weight: NDArray, grad: NDArray, state):
+        return self._eager_update(index, weight, grad, state, mp=False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        return self._eager_update(index, weight, grad, state, mp=True)
 
     def __repr__(self):
         return f"{type(self).__name__}(lr={self.lr})"
 
 
 # ---------------------------------------------------------------------- #
-# jitted update kernels
-# ---------------------------------------------------------------------- #
-@jax.jit
-def _k_sgd(w, g, lr, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    return w - lr * g
-
-
-@jax.jit
-def _k_sgd_mom(w, g, mom, lr, momentum, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    mom = momentum * mom - lr * g
-    return w + mom, mom
-
-
-@jax.jit
-def _k_nag(w, g, mom, lr, momentum, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    mom = momentum * mom + g
-    return w - lr * (g + momentum * mom), mom
-
-
-@jax.jit
-def _k_adam(w, g, m, v, lr, beta1, beta2, eps, wd, rescale, clip, coef1, coef2):
-    g = _prep(g, w, rescale, clip, wd)
-    m = beta1 * m + (1 - beta1) * g
-    v = beta2 * v + (1 - beta2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(coef2) / coef1
-    return w - lr_t * m / (jnp.sqrt(v) + eps), m, v
-
-
-@jax.jit
-def _k_adamw(w, g, m, v, lr, beta1, beta2, eps, wd, rescale, clip, coef1, coef2):
-    g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)  # decoupled wd
-    m = beta1 * m + (1 - beta1) * g
-    v = beta2 * v + (1 - beta2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(coef2) / coef1
-    return w - lr_t * (m / (jnp.sqrt(v) + eps)) - lr * wd * w, m, v
-
-
-@jax.jit
-def _k_rmsprop(w, g, n, lr, rho, eps, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    n = rho * n + (1 - rho) * jnp.square(g)
-    return w - lr * g / (jnp.sqrt(n) + eps), n
-
-
-@jax.jit
-def _k_rmsprop_alex(w, g, n, gm, delta, lr, rho, momentum, eps, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    n = rho * n + (1 - rho) * jnp.square(g)
-    gm = rho * gm + (1 - rho) * g
-    delta = momentum * delta - lr * g / jnp.sqrt(n - jnp.square(gm) + eps)
-    return w + delta, n, gm, delta
-
-
-@jax.jit
-def _k_adagrad(w, g, h, lr, eps, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    h = h + jnp.square(g)
-    return w - lr * g / (jnp.sqrt(h) + eps), h
-
-
-@jax.jit
-def _k_adadelta(w, g, acc_g, acc_d, rho, eps, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
-    d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
-    acc_d = rho * acc_d + (1 - rho) * jnp.square(d)
-    return w - d, acc_g, acc_d
-
-
-@jax.jit
-def _k_ftrl(w, g, z, n, lr, lamda1, beta, wd, rescale, clip):
-    g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)
-    n_new = n + jnp.square(g)
-    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
-    z = z + g - sigma * w
-    w = jnp.where(jnp.abs(z) > lamda1,
-                  -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n_new)) / lr + wd),
-                  0.0)
-    return w, z, n_new
-
-
-@jax.jit
-def _k_signum(w, g, mom, lr, momentum, wd_lh, wd, rescale, clip):
-    g = _prep(g, w, rescale, clip, wd)
-    mom = momentum * mom - (1 - momentum) * g
-    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
-
-
-@jax.jit
-def _k_lamb(w, g, m, v, lr, beta1, beta2, eps, wd, rescale, clip, coef1, coef2, lower, upper):
-    """LAMB phase1+phase2 fused (ref: lamb_update_phase1/2 + multi_lamb.cc)."""
-    g = jnp.clip(g.astype(jnp.float32) * rescale, -clip, clip)
-    w32 = w.astype(jnp.float32)
-    m = beta1 * m + (1 - beta1) * g
-    v = beta2 * v + (1 - beta2) * jnp.square(g)
-    m_hat = m / coef1
-    v_hat = v / coef2
-    update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w32
-    wnorm = jnp.linalg.norm(w32)
-    unorm = jnp.linalg.norm(update)
-    ratio = jnp.where((wnorm > 0) & (unorm > 0),
-                      jnp.clip(wnorm, lower, upper) / unorm, 1.0)
-    return (w32 - lr * ratio * update).astype(w.dtype), m, v
-
-
-@jax.jit
-def _k_lars(w, g, mom, lr, momentum, eta, eps, wd, rescale, clip):
-    g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)
-    wnorm = jnp.linalg.norm(w)
-    gnorm = jnp.linalg.norm(g)
-    local_lr = jnp.where((wnorm > 0) & (gnorm > 0),
-                         eta * wnorm / (gnorm + wd * wnorm + eps), 1.0)
-    g = g + wd * w
-    mom = momentum * mom + local_lr * lr * g
-    return w - mom, mom
-
-
-# ---------------------------------------------------------------------- #
-# optimizer classes
+# optimizer classes (pure update rules)
 # ---------------------------------------------------------------------- #
 @register
 class SGD(Optimizer):
+    """SGD(+momentum); ref `sgd_update`/`sgd_mom_update` fused ops."""
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -267,15 +199,12 @@ class SGD(Optimizer):
             return jnp.zeros_like(weight._data)
         return None
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
         if self.momentum == 0.0:
-            weight._data = _k_sgd(weight._data, raw(grad), lr, wd, self.rescale_grad, self.clip_gradient)
-            return None
-        weight._data, new_state = _k_sgd_mom(weight._data, raw(grad), state, lr,
-                                             self.momentum, wd, self.rescale_grad, self.clip_gradient)
-        return new_state
+            return w - lr * g, None
+        mom = self.momentum * state - lr * g
+        return w + mom, mom
 
 
 @register
@@ -287,12 +216,10 @@ class NAG(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(weight._data)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        weight._data, new_state = _k_nag(weight._data, raw(grad), state, lr,
-                                         self.momentum, wd, self.rescale_grad, self.clip_gradient)
-        return new_state
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
+        mom = self.momentum * state + g
+        return w - lr * (g + self.momentum * mom), mom
 
 
 @register
@@ -304,32 +231,28 @@ class Adam(Optimizer):
     def create_state(self, index, weight):
         return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
         m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        weight._data, m, v = _k_adam(weight._data, raw(grad), m, v, lr, self.beta1,
-                                     self.beta2, self.epsilon, wd, self.rescale_grad,
-                                     self.clip_gradient, coef1, coef2)
-        return (m, v)
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        return w - lr_t * m / (jnp.sqrt(v) + self.epsilon), (m, v)
 
 
 @register
 class AdamW(Adam):
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)  # decoupled wd
         m, v = state
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        weight._data, m, v = _k_adamw(weight._data, raw(grad), m, v, lr, self.beta1,
-                                      self.beta2, self.epsilon, wd, self.rescale_grad,
-                                      self.clip_gradient, coef1, coef2)
-        return (m, v)
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        return w - lr_t * (m / (jnp.sqrt(v) + self.epsilon)) - lr * wd * w, (m, v)
 
 
 @register
@@ -341,16 +264,13 @@ class Adamax(Optimizer):
     def create_state(self, index, weight):
         return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index) / (1.0 - self.beta1 ** t), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
         m, u = state
-        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
+        lr_t = lr / (1.0 - self.beta1 ** t)
         m = self.beta1 * m + (1 - self.beta1) * g
         u = jnp.maximum(self.beta2 * u, jnp.abs(g))
-        weight._data = weight._data - lr * m / (u + 1e-8)
-        return (m, u)
+        return w - lr_t * m / (u + 1e-8), (m, u)
 
 
 @register
@@ -360,30 +280,28 @@ class Nadam(Optimizer):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
 
     def create_state(self, index, weight):
-        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
+        # (m, v, momentum-schedule product) — the schedule product is
+        # per-param state, not python-side mutation, so the rule stays pure
+        return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data),
+                jnp.ones((), jnp.float32))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        m, v = state
-        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
+        m, v, m_schedule = state
         mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
         mom_t1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
-        self.m_schedule *= mom_t
-        sched1 = self.m_schedule
-        sched2 = self.m_schedule * mom_t1
+        m_schedule = m_schedule * mom_t
+        sched1 = m_schedule
+        sched2 = m_schedule * mom_t1
         g_prime = g / (1.0 - sched1)
         m = self.beta1 * m + (1 - self.beta1) * g
         m_prime = m / (1.0 - sched2)
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         v_prime = v / (1.0 - self.beta2 ** t)
         m_bar = (1.0 - mom_t) * g_prime + mom_t1 * m_prime
-        weight._data = weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
-        return (m, v)
+        return w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon), (m, v, m_schedule)
 
 
 @register
@@ -394,23 +312,22 @@ class RMSProp(Optimizer):
         self.rho, self.momentum, self.epsilon, self.centered = rho, momentum, epsilon, centered
 
     def create_state(self, index, weight):
-        z = jnp.zeros_like(weight._data)
         if self.centered:
-            return (z, z, z)
-        return z
+            # three DISTINCT buffers: state is donated by the fused step
+            return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data),
+                    jnp.zeros_like(weight._data))
+        return jnp.zeros_like(weight._data)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
         if self.centered:
             n, gm, delta = state
-            weight._data, n, gm, delta = _k_rmsprop_alex(
-                weight._data, raw(grad), n, gm, delta, lr, self.rho, self.momentum,
-                self.epsilon, wd, self.rescale_grad, self.clip_gradient)
-            return (n, gm, delta)
-        weight._data, n = _k_rmsprop(weight._data, raw(grad), state, lr, self.rho,
-                                     self.epsilon, wd, self.rescale_grad, self.clip_gradient)
-        return n
+            n = self.rho * n + (1 - self.rho) * jnp.square(g)
+            gm = self.rho * gm + (1 - self.rho) * g
+            delta = self.momentum * delta - lr * g / jnp.sqrt(n - jnp.square(gm) + self.epsilon)
+            return w + delta, (n, gm, delta)
+        n = self.rho * state + (1 - self.rho) * jnp.square(g)
+        return w - lr * g / (jnp.sqrt(n) + self.epsilon), n
 
 
 @register
@@ -422,12 +339,10 @@ class AdaGrad(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(weight._data)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        weight._data, h = _k_adagrad(weight._data, raw(grad), state, lr,
-                                     self.float_stable_eps, wd, self.rescale_grad, self.clip_gradient)
-        return h
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
+        h = state + jnp.square(g)
+        return w - lr * g / (jnp.sqrt(h) + self.float_stable_eps), h
 
 
 @register
@@ -439,14 +354,13 @@ class AdaDelta(Optimizer):
     def create_state(self, index, weight):
         return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
         acc_g, acc_d = state
-        weight._data, acc_g, acc_d = _k_adadelta(weight._data, raw(grad), acc_g, acc_d,
-                                                 self.rho, self.epsilon, wd,
-                                                 self.rescale_grad, self.clip_gradient)
-        return (acc_g, acc_d)
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        d = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * jnp.square(d)
+        return w - d, (acc_g, acc_d)
 
 
 @register
@@ -458,13 +372,17 @@ class Ftrl(Optimizer):
     def create_state(self, index, weight):
         return (jnp.zeros_like(weight._data), jnp.zeros_like(weight._data))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)
         z, n = state
-        weight._data, z, n = _k_ftrl(weight._data, raw(grad), z, n, lr, self.lamda1,
-                                     self.beta, wd, self.rescale_grad, self.clip_gradient)
-        return (z, n)
+        n_new = n + jnp.square(g)
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        w = jnp.where(jnp.abs(z) > self.lamda1,
+                      -(z - jnp.sign(z) * self.lamda1)
+                      / ((self.beta + jnp.sqrt(n_new)) / lr + wd),
+                      0.0)
+        return w, (z, n_new)
 
 
 @register
@@ -482,18 +400,22 @@ class LAMB(Optimizer):
     def create_state(self, index, weight):
         return (jnp.zeros(weight.shape, jnp.float32), jnp.zeros(weight.shape, jnp.float32))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        t = self._index_update_count[index]
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = jnp.clip(g.astype(jnp.float32) * rescale, -clip, clip)
         m, v = state
+        w32 = w.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
         coef1 = 1.0 - self.beta1 ** t if self.bias_correction else 1.0
         coef2 = 1.0 - self.beta2 ** t if self.bias_correction else 1.0
-        weight._data, m, v = _k_lamb(weight._data, raw(grad), m, v, lr, self.beta1,
-                                     self.beta2, self.epsilon, wd, self.rescale_grad,
-                                     self.clip_gradient, coef1, coef2,
-                                     self.lower_bound, self.upper_bound)
-        return (m, v)
+        m_hat = m / coef1
+        v_hat = v / coef2
+        update = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * w32
+        wnorm = jnp.linalg.norm(w32)
+        unorm = jnp.linalg.norm(update)
+        ratio = jnp.where((wnorm > 0) & (unorm > 0),
+                          jnp.clip(wnorm, self.lower_bound, self.upper_bound) / unorm, 1.0)
+        return (w32 - lr * ratio * update).astype(w.dtype), (m, v)
 
 
 @register
@@ -505,13 +427,15 @@ class LARS(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(weight._data)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        weight._data, mom = _k_lars(weight._data, raw(grad), state, lr, self.momentum,
-                                    self.eta, self.epsilon, wd, self.rescale_grad,
-                                    self.clip_gradient)
-        return mom
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = jnp.clip(g.astype(w.dtype) * rescale, -clip, clip)
+        wnorm = jnp.linalg.norm(w)
+        gnorm = jnp.linalg.norm(g)
+        local_lr = jnp.where((wnorm > 0) & (gnorm > 0),
+                             self.eta * wnorm / (gnorm + wd * wnorm + self.epsilon), 1.0)
+        g = g + wd * w
+        mom = self.momentum * state + local_lr * lr * g
+        return w - mom, mom
 
 
 @register
@@ -521,17 +445,15 @@ class DCASGD(Optimizer):
         self.momentum, self.lamda = momentum, lamda
 
     def create_state(self, index, weight):
-        return (jnp.zeros_like(weight._data), weight._data)
+        # copy: the previous-weight slot must not alias the live weight
+        # buffer (both are donated by the Trainer's fused step)
+        return (jnp.zeros_like(weight._data), jnp.copy(weight._data))
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
         mom, prev = state
-        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
-        mom = self.momentum * mom - lr * (g + self.lamda * g * g * (weight._data - prev))
-        prev = weight._data
-        weight._data = weight._data + mom
-        return (mom, prev)
+        g = _prep(g, w, rescale, clip, wd)
+        mom = self.momentum * mom - lr * (g + self.lamda * g * g * (w - prev))
+        return w + mom, (mom, w)
 
 
 @register
@@ -543,28 +465,23 @@ class Signum(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(weight._data)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        weight._data, mom = _k_signum(weight._data, raw(grad), state, lr, self.momentum,
-                                      self.wd_lh, wd, self.rescale_grad, self.clip_gradient)
-        return mom
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
+        mom = self.momentum * state - (1 - self.momentum) * g
+        return (1 - lr * self.wd_lh) * w + lr * jnp.sign(mom), mom
 
 
 @register
 class SGLD(Optimizer):
+    needs_rng = True
+
     def create_state(self, index, weight):
         return None
 
-    def update(self, index, weight, grad, state):
-        from .. import random as _random
-
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = _prep(raw(grad), weight._data, self.rescale_grad, self.clip_gradient, wd)
-        noise = jnp.sqrt(lr) * jax.random.normal(_random.next_key(), weight.shape, weight._data.dtype)
-        weight._data = weight._data - lr / 2 * g + noise
-        return None
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        g = _prep(g, w, rescale, clip, wd)
+        noise = jnp.sqrt(lr) * jax.random.normal(key, w.shape, w.dtype)
+        return w - lr / 2 * g + noise, None
 
 
 @register
@@ -574,10 +491,8 @@ class Test(Optimizer):
     def create_state(self, index, weight):
         return jnp.zeros_like(weight._data)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        weight._data = weight._data - raw(grad) * self.rescale_grad
-        return state
+    def pure_update(self, w, g, state, t, lr, wd, rescale, clip, key=None):
+        return w - g.astype(w.dtype) * rescale, state
 
 
 class Updater:

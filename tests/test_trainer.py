@@ -1,0 +1,237 @@
+"""Trainer fused-step tests (VERDICT r1 #2).
+
+Verifies the PUBLIC training path — autograd.record() → backward() →
+Trainer.step() — is numerically identical to (a) the eager per-param
+reference path and (b) a hand-rolled raw-JAX train loop (the r1
+bench.py pattern), so the bench's MFU is earned by the framework API.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.gluon import Trainer, nn
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _make_net(seed=0, dtype=None):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.ones((8, 12))
+    net(x)  # materialize deferred shapes
+    if dtype is not None:
+        net.cast(dtype)
+    return net
+
+
+def _data(seed=1):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    x = jax.random.normal(k1, (8, 12), jnp.float32)
+    y = jax.random.normal(k2, (8, 4), jnp.float32)
+    return x, y
+
+
+def _train_steps(net, trainer, x, y, n=4):
+    for _ in range(n):
+        with autograd.record():
+            out = net(NDArray(x))
+            loss = ((out - NDArray(y)) ** 2).mean()
+        loss.backward()
+        trainer.step(1)
+    return [onp.asarray(p.data().asnumpy())
+            for p in net.collect_params().values()]
+
+
+@pytest.mark.parametrize("opt,opt_args", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 1e-3}),
+    ("lamb", {"learning_rate": 1e-3}),
+    ("nadam", {"learning_rate": 1e-3}),
+    ("rmsprop", {"learning_rate": 1e-3, "centered": True}),
+    ("ftrl", {"learning_rate": 0.1}),
+])
+def test_fused_matches_eager(opt, opt_args):
+    x, y = _data()
+    net_a = _make_net()
+    net_b = _make_net()
+    tr_a = Trainer(net_a.collect_params(), opt, dict(opt_args), fuse_step=True)
+    tr_b = Trainer(net_b.collect_params(), opt, dict(opt_args), fuse_step=False)
+    pa = _train_steps(net_a, tr_a, x, y)
+    pb = _train_steps(net_b, tr_b, x, y)
+    assert tr_a._fused_fn is not None, "fused path was not taken"
+    for a, b in zip(pa, pb):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_trainer_matches_handrolled_sgd_momentum():
+    """The r1 bench pattern (raw value_and_grad + momentum SGD) must equal
+    the public record/backward/Trainer.step path bit-for-bit-ish."""
+    from incubator_mxnet_tpu.gluon.block import functionalize
+
+    x, y = _data(seed=3)
+    lr, mom = 0.05, 0.9
+
+    # --- public Gluon path ------------------------------------------- #
+    net = _make_net(seed=7)
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": lr, "momentum": mom})
+    public = _train_steps(net, trainer, x, y, n=5)
+
+    # --- hand-rolled raw JAX path ------------------------------------ #
+    net2 = _make_net(seed=7)
+    apply_fn, train_raws, aux_raws = functionalize(net2, mx.nd.NDArray(x))
+    rng = jax.random.PRNGKey(0)
+
+    def loss_fn(params, xx, yy):
+        out, _ = apply_fn(params, aux_raws, rng, xx)
+        return jnp.mean((out - yy) ** 2)
+
+    def step(params, vel, xx, yy):
+        loss, grads = jax.value_and_grad(loss_fn)(params, xx, yy)
+        vel = jax.tree_util.tree_map(lambda v, g: mom * v - lr * g, vel, grads)
+        params = jax.tree_util.tree_map(lambda p, v: p + v, params, vel)
+        return params, vel, loss
+
+    step = jax.jit(step)
+    params = train_raws
+    vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+    for _ in range(5):
+        params, vel, _ = step(params, vel, x, y)
+
+    # match by structural names ('0.weight' etc.) — stable across instances
+    hand_by_id = {id(p): r for p, r in
+                  zip([q for q in net2.collect_params().values()
+                       if q.grad_req != "null"], params)}
+    hand_struct = {k: hand_by_id[id(p)]
+                   for k, p in net2._collect_params_with_prefix().items()
+                   if id(p) in hand_by_id}
+    pub_by_id = {id(p): a for p, a in zip(net.collect_params().values(), public)}
+    pub_struct = {k: pub_by_id[id(p)]
+                  for k, p in net._collect_params_with_prefix().items()}
+    for k, r in hand_struct.items():
+        onp.testing.assert_allclose(
+            pub_struct[k], onp.asarray(r), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_multi_precision():
+    """bf16 params + fp32 master weights through the fused path."""
+    x, y = _data(seed=5)
+    net_a = _make_net(seed=2, dtype="bfloat16")
+    net_b = _make_net(seed=2, dtype="bfloat16")
+    args = {"learning_rate": 0.05, "momentum": 0.9, "multi_precision": True}
+    tr_a = Trainer(net_a.collect_params(), "sgd", dict(args), fuse_step=True)
+    tr_b = Trainer(net_b.collect_params(), "sgd", dict(args), fuse_step=False)
+    xb = x.astype(jnp.bfloat16)
+    pa = _train_steps(net_a, tr_a, xb, y)
+    pb = _train_steps(net_b, tr_b, xb, y)
+    assert tr_a._fused_fn is not None
+    for a, b in zip(pa, pb):
+        onp.testing.assert_allclose(a.astype(onp.float32), b.astype(onp.float32),
+                                    rtol=2e-2, atol=2e-2)
+    # master weights exist and are fp32
+    st = next(iter(tr_a._states.values()))
+    assert st[0].dtype == jnp.float32
+
+
+def test_fused_respects_mults_and_scheduler():
+    from incubator_mxnet_tpu import lr_scheduler
+
+    x, y = _data(seed=9)
+    net_a = _make_net(seed=4)
+    net_b = _make_net(seed=4)
+    for net in (net_a, net_b):
+        list(net.collect_params().values())[0].lr_mult = 0.1
+        list(net.collect_params().values())[1].wd_mult = 0.0
+    sched_a = lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    sched_b = lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    tr_a = Trainer(net_a.collect_params(), "sgd",
+                   {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01,
+                    "lr_scheduler": sched_a}, fuse_step=True)
+    tr_b = Trainer(net_b.collect_params(), "sgd",
+                   {"learning_rate": 0.05, "momentum": 0.9, "wd": 0.01,
+                    "lr_scheduler": sched_b}, fuse_step=False)
+    pa = _train_steps(net_a, tr_a, x, y, n=6)
+    pb = _train_steps(net_b, tr_b, x, y, n=6)
+    assert tr_a._fused_fn is not None
+    for a, b in zip(pa, pb):
+        onp.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_fused_fallback_on_compression():
+    """Gradient compression must force the reference kvstore path."""
+    x, y = _data()
+    net = _make_net(seed=11)
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05},
+                 compression_params={"type": "2bit", "threshold": 0.5})
+    _train_steps(net, tr, x, y, n=1)
+    assert tr._fused_fn is None  # fell back
+
+
+def test_input_grads_survive_trainer_step():
+    """x.attach_grad() + hybridized net + trainer.step: the input grad
+    must be real (code-review r2 finding: the single-program step path
+    must fall back when non-parameter inputs want gradients)."""
+    x, y = _data(seed=21)
+    net = _make_net(seed=8)
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9})
+    xnd = NDArray(x)
+    xnd.attach_grad()
+    with autograd.record():
+        out = net(xnd)
+        loss = ((out - NDArray(y)) ** 2).mean()
+    loss.backward()
+    trainer.step(1)
+    gx = xnd.grad.asnumpy()
+    assert onp.isfinite(gx).all() and onp.abs(gx).sum() > 0
+
+    # oracle
+    from incubator_mxnet_tpu.gluon.block import functionalize
+
+    net2 = _make_net(seed=8)
+    apply_fn, train_raws, aux_raws = functionalize(net2, mx.nd.NDArray(x))
+    rng = jax.random.PRNGKey(0)
+
+    def f(xx):
+        out, _ = apply_fn(train_raws, aux_raws, rng, xx)
+        return jnp.mean((out - y) ** 2)
+
+    onp.testing.assert_allclose(gx, onp.asarray(jax.grad(f)(x)),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_record_backward_grads_match_jax_oracle():
+    """Residual-sharing hybrid backward == jax.grad of the same function."""
+    from incubator_mxnet_tpu.gluon.block import functionalize
+
+    x, _ = _data(seed=13)
+    net = _make_net(seed=6)
+    net.hybridize()
+    with autograd.record():
+        out = net(NDArray(x))
+        loss = (out ** 2).sum()
+    loss.backward()
+    got = {p.name: onp.asarray(p.grad().asnumpy())
+           for p in net.collect_params().values() if p.grad_req != "null"}
+
+    net2 = _make_net(seed=6)
+    apply_fn, train_raws, aux_raws = functionalize(net2, mx.nd.NDArray(x))
+    rng = jax.random.PRNGKey(0)
+
+    def f(params):
+        out, _ = apply_fn(params, aux_raws, rng, x)
+        return (out ** 2).sum()
+
+    oracle = jax.grad(f)(train_raws)
+    tp = [p for p in net2.collect_params().values() if p.grad_req != "null"]
+    got2 = {p.name: onp.asarray(g) for p, g in zip(tp, oracle)}
+    for (n1, g1), (n2, g2) in zip(sorted(got.items()), sorted(got2.items())):
+        onp.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
