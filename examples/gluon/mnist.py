@@ -1,0 +1,117 @@
+"""Gluon MNIST — the first demo gate (BASELINE config #1).
+
+TPU-native rendition of the reference `example/gluon/mnist/mnist.py`
+[UNVERIFIED] (SURVEY.md §2.8, §7 P2): LeNet trained with the canonical
+Gluon loop — `autograd.record()` → `loss.backward()` →
+`trainer.step()` — hybridized, checkpointed, ≥98% val accuracy.
+
+Data: real MNIST when `--data-dir` points at the ubyte files
+(`mx.gluon.data.vision.MNIST` layout); otherwise a deterministic
+synthetic image dataset stands in so the gate runs in any sandbox
+(this environment has no network egress).
+
+Run: python examples/gluon/mnist.py --epochs 3
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Gluon MNIST LeNet")
+    p.add_argument("--batch-size", type=int, default=100)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--data-dir", type=str, default=None,
+                   help="dir with MNIST ubyte files; synthetic data if absent")
+    p.add_argument("--no-hybridize", action="store_true")
+    p.add_argument("--save-prefix", type=str, default=None,
+                   help="checkpoint prefix (writes .params + trainer states)")
+    p.add_argument("--train-samples", type=int, default=4000,
+                   help="synthetic train set size")
+    return p
+
+
+def get_data(args):
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.vision import (MNIST,
+                                                       SyntheticImageDataset,
+                                                       transforms)
+
+    tf = lambda x, y: (transforms.ToTensor()(x), y)  # HWC uint8 -> CHW float
+    if args.data_dir and os.path.exists(args.data_dir):
+        train_ds = MNIST(root=args.data_dir, train=True, transform=tf)
+        val_ds = MNIST(root=args.data_dir, train=False, transform=tf)
+    else:
+        train_ds = SyntheticImageDataset(num_samples=args.train_samples,
+                                         num_classes=10, seed=1,
+                                         template_seed=7, transform=tf)
+        val_ds = SyntheticImageDataset(num_samples=1000, num_classes=10,
+                                       seed=2, template_seed=7, transform=tf)
+    return (DataLoader(train_ds, batch_size=args.batch_size, shuffle=True),
+            DataLoader(val_ds, batch_size=args.batch_size))
+
+
+def evaluate(net, val_dl, metric):
+    metric.reset()
+    for x, y in val_dl:
+        metric.update([y], [net(x)])
+    return metric.get()[1]
+
+
+def train(args):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, metric as metric_mod
+    from incubator_mxnet_tpu.gluon import Trainer, loss as loss_mod
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import LeNet
+
+    train_dl, val_dl = get_data(args)
+    mx.random.seed(0)
+    net = LeNet()
+    net.initialize()
+    if not args.no_hybridize:
+        net.hybridize()
+    loss_fn = loss_mod.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": args.momentum})
+    acc = metric_mod.Accuracy()
+
+    val_acc = 0.0
+    for epoch in range(args.epochs):
+        tic = time.time()
+        n = 0
+        for x, y in train_dl:
+            with autograd.record():
+                out = net(x)
+                L = loss_fn(out, y)
+            L.backward()
+            trainer.step(x.shape[0])
+            n += x.shape[0]
+        val_acc = evaluate(net, val_dl, acc)
+        print(f"Epoch {epoch}: val_acc={val_acc:.4f} "
+              f"({n / (time.time() - tic):.0f} samples/s)")
+
+    if args.save_prefix:
+        net.save_parameters(args.save_prefix + ".params")
+        trainer.save_states(args.save_prefix + ".states")
+        print(f"saved checkpoint to {args.save_prefix}.params/.states")
+    return val_acc
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    val_acc = train(args)
+    gate = 0.98
+    status = "PASS" if val_acc >= gate else "FAIL"
+    print(f"MNIST gate: val_acc={val_acc:.4f} (target >= {gate}) {status}")
+    return val_acc
+
+
+if __name__ == "__main__":
+    main()

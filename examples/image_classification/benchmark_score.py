@@ -1,0 +1,66 @@
+"""Inference throughput scorer (ref `benchmark_score.py`).
+
+TPU-native rendition of the reference
+`example/image-classification/benchmark_score.py` [UNVERIFIED]
+(SURVEY.md §2.8, §6 "Measurement conventions"): forward-only img/s for
+any model-zoo network across batch sizes, synthetic device-resident
+input (measures the model, not the input pipeline).
+
+Run: python examples/image_classification/benchmark_score.py \
+        --network resnet50_v1 --batch-sizes 1,8,32
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="inference img/s scorer")
+    p.add_argument("--network", type=str, default="resnet50_v1")
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--batch-sizes", type=str, default="1,8,32")
+    p.add_argument("--num-batches", type=int, default=20)
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    return p
+
+
+def score(args):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    mx.random.seed(0)
+    net = vision.get_model(args.network, classes=args.num_classes)
+    net.initialize()
+    net(NDArray(mx.nd.zeros((1,) + shape)._data))
+    if args.dtype == "bfloat16":
+        net.cast("bfloat16")
+    net.hybridize()
+
+    results = []
+    for bs in (int(b) for b in args.batch_sizes.split(",")):
+        x = mx.nd.zeros((bs,) + shape)
+        if args.dtype == "bfloat16":
+            x = x.astype("bfloat16")
+        out = net(x)  # compile
+        float(out.asnumpy().ravel()[0])
+        tic = time.time()
+        for _ in range(args.num_batches):
+            out = net(x)
+        float(out.asnumpy().ravel()[0])  # sync
+        img_s = bs * args.num_batches / (time.time() - tic)
+        results.append((bs, img_s))
+        print(f"batchsize={bs:4d}  {img_s:10.1f} img/s  ({args.network}, {args.dtype})")
+    return results
+
+
+if __name__ == "__main__":
+    score(build_parser().parse_args())

@@ -1,0 +1,140 @@
+"""Image-classification training over the native RecordIO pipeline.
+
+TPU-native rendition of the reference
+`example/image-classification/train_imagenet.py` + `common/fit.py`
+[UNVERIFIED] (SURVEY.md §2.8): any model-zoo network (default
+ResNet-50 v1) fed by the C++ threaded RecordIO decode/augment pipeline
+(`mx.io.ImageRecordIter`), Speedometer logging, epoch checkpoints, and
+an images/sec report — the metric of record for this config
+(BASELINE.md ResNet-50 img/s).
+
+Without `--data-train` a synthetic RecordIO file is packed on the fly
+(JPEG-encoded class templates) so the full path — .rec container → C++
+decode → augment → device — is exercised in any sandbox.
+
+Run: python examples/image_classification/train.py --network resnet50_v1 \
+        --image-shape 3,224,224 --batch-size 64 --num-epochs 1
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="image-classification trainer")
+    p.add_argument("--network", type=str, default="resnet50_v1")
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--image-shape", type=str, default="3,64,64")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--max-batches", type=int, default=0,
+                   help="cap batches/epoch (0 = full epoch)")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=1e-4)
+    p.add_argument("--data-train", type=str, default=None,
+                   help=".rec file; synthetic data packed if absent")
+    p.add_argument("--synthetic-samples", type=int, default=256)
+    p.add_argument("--disp-batches", type=int, default=20,
+                   help="Speedometer frequency")
+    p.add_argument("--model-prefix", type=str, default=None)
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    return p
+
+
+def make_synthetic_rec(path, num_samples, num_classes, hw):
+    """Pack JPEG class templates into a .rec (exercises the real codec)."""
+    import numpy as onp
+
+    from incubator_mxnet_tpu import recordio
+
+    rng = onp.random.RandomState(7)
+    templates = rng.randint(0, 255, (num_classes, hw, hw, 3), dtype=onp.uint8)
+    rec = recordio.MXRecordIO(path, "w")
+    order = onp.random.RandomState(1).randint(0, num_classes, num_samples)
+    for i, cls in enumerate(order):
+        noise = rng.randint(-20, 20, templates[cls].shape).astype(onp.int16)
+        img = onp.clip(templates[cls].astype(onp.int16) + noise, 0, 255).astype(onp.uint8)
+        hdr = recordio.IRHeader(0, float(cls), i, 0)
+        rec.write(recordio.pack_img(hdr, img, quality=90))
+    rec.close()
+    return path
+
+
+def train(args):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, callback, metric as metric_mod
+    from incubator_mxnet_tpu.gluon import Trainer, loss as loss_mod
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    rec_path = args.data_train
+    if not rec_path:
+        rec_path = os.path.join("/tmp", f"synthetic_{shape[1]}.rec")
+        if not os.path.exists(rec_path):
+            make_synthetic_rec(rec_path, args.synthetic_samples,
+                               args.num_classes, shape[1])
+        print(f"using synthetic RecordIO at {rec_path}")
+
+    train_iter = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, data_shape=shape, batch_size=args.batch_size,
+        shuffle=True, rand_mirror=True,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.1, std_b=57.4)
+
+    mx.random.seed(0)
+    net = vision.get_model(args.network, classes=args.num_classes)
+    net.initialize()
+    # materialize deferred shapes before optional bf16 cast
+    net(NDArray(mx.nd.zeros((args.batch_size,) + shape)._data))
+    if args.dtype == "bfloat16":
+        net.cast("bfloat16")
+    net.hybridize()
+    loss_fn = loss_mod.SoftmaxCrossEntropyLoss()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": args.lr, "momentum": args.momentum,
+                       "wd": args.wd,
+                       "multi_precision": args.dtype == "bfloat16"})
+    acc = metric_mod.Accuracy()
+
+    total_samples = 0
+    t_start = time.time()
+    for epoch in range(args.num_epochs):
+        speed = callback.Speedometer(args.batch_size, args.disp_batches)
+        train_iter.reset()
+        acc.reset()
+        for nbatch, batch in enumerate(train_iter):
+            if args.max_batches and nbatch >= args.max_batches:
+                break
+            x = batch.data[0]
+            if args.dtype == "bfloat16":
+                x = x.astype("bfloat16")
+            y = batch.label[0]
+            with autograd.record():
+                out = net(x)
+                L = loss_fn(out, y)
+            L.backward()
+            trainer.step(args.batch_size)
+            acc.update([y], [out])
+            total_samples += args.batch_size
+            speed(callback.BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                         eval_metric=acc, locals=locals()))
+        print(f"Epoch {epoch}: train_acc={acc.get()[1]:.4f}")
+        if args.model_prefix:
+            net.save_parameters(f"{args.model_prefix}-{epoch:04d}.params")
+
+    dt = time.time() - t_start
+    img_s = total_samples / dt
+    print(f"TOTAL {total_samples} images in {dt:.1f}s = {img_s:.1f} img/s")
+    return img_s, acc.get()[1]
+
+
+if __name__ == "__main__":
+    train(build_parser().parse_args())

@@ -3,11 +3,16 @@ SURVEY.md §5.5): Speedometer samples/sec lines (the format
 `tools/parse_log.py` scrapes), checkpointing, log-validation."""
 from __future__ import annotations
 
+import collections
 import logging
 import time
 
-__all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
+__all__ = ["BatchEndParam", "Speedometer", "do_checkpoint", "log_train_metric",
            "LogValidationMetricsCallback", "module_checkpoint"]
+
+# ref python/mxnet/model.py BatchEndParam — the record batch callbacks receive
+BatchEndParam = collections.namedtuple(
+    "BatchEndParam", ["epoch", "nbatch", "eval_metric", "locals"])
 
 
 class Speedometer:

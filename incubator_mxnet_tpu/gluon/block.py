@@ -382,7 +382,9 @@ class HybridBlock(Block):
         self._aval_cache = {}
         for c in self._children.values():
             if isinstance(c, HybridBlock):
-                c.hybridize(active, static_alloc=static_alloc, static_shape=static_shape, **kwargs)
+                c.hybridize(active, static_alloc=static_alloc,
+                            static_shape=static_shape,
+                            remat_backward=remat_backward, **kwargs)
         return self
 
     def cast(self, dtype):

@@ -1,6 +1,6 @@
 """AlexNet (ref model_zoo/vision/alexnet.py [UNVERIFIED])."""
 from ....base import MXNetError
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 
 __all__ = ["AlexNet", "alexnet"]

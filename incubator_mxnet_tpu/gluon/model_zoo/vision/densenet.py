@@ -1,7 +1,7 @@
 """DenseNet 121/161/169/201 (ref model_zoo/vision/densenet.py [UNVERIFIED])."""
 from ....base import MXNetError
 from ...block import HybridBlock
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]

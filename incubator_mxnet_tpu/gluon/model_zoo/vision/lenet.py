@@ -1,5 +1,5 @@
 """LeNet-5 (the `example/gluon/mnist` model, BASELINE config #1)."""
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 
 

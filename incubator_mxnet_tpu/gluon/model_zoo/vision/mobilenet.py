@@ -5,7 +5,7 @@ lowers these efficiently without im2col.
 """
 from ....base import MXNetError
 from ...block import HybridBlock
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",

@@ -6,7 +6,7 @@ the compiled path.
 """
 from ....base import MXNetError
 from ...block import HybridBlock
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",

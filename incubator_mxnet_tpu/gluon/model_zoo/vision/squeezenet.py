@@ -1,7 +1,7 @@
 """SqueezeNet 1.0/1.1 (ref model_zoo/vision/squeezenet.py [UNVERIFIED])."""
 from ....base import MXNetError
 from ...block import HybridBlock
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 from ..vision_helpers import HybridConcat
 

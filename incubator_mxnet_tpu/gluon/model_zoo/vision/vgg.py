@@ -1,7 +1,7 @@
 """VGG 11/13/16/19 (ref model_zoo/vision/vgg.py [UNVERIFIED])."""
 from ....base import MXNetError
 from ...block import HybridBlock
-from ...nn import basic_layers as nn
+from ... import nn
 from ...nn import conv_layers as conv
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "get_vgg"]

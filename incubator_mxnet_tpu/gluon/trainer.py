@@ -118,26 +118,48 @@ class Trainer:
             return False  # custom optimizer without a pure rule
         return True
 
-    def _build_fused(self, idxs):
+    # -- shared machinery of the two fused paths ------------------------ #
+    def _mults_key(self, idxs):
+        """Per-param lr/wd multipliers + clip — recomputed every step and
+        part of every fused cache key, so param.lr_mult / clip_gradient
+        changes mid-run rebuild the program instead of being ignored."""
         opt = self._optimizer
-        lr_mults = [opt._lr_mult_for(i) for i in idxs]
-        wd_mults = [opt._wd_mult_for(i) for i in idxs]
-        clip = opt.clip_gradient
+        return (tuple(opt._lr_mult_for(i) for i in idxs),
+                tuple(opt._wd_mult_for(i) for i in idxs),
+                opt.clip_gradient)
+
+    def _make_stacked_update(self, lr_mults, wd_mults, clip):
+        """Stacked multi-tensor update over all params (one traced body —
+        the reference's `multi_sgd_update`/`multi_lamb` generalization)."""
+        opt = self._optimizer
         needs_rng = opt.needs_rng
 
-        def fused(weights, grads, states, t, lr, wd, rescale, keys):
+        def stacked(weights, grads, states, ts, lr, wd, rescale, keys):
             new_w, new_s = [], []
             for j in range(len(weights)):
                 k = keys[j] if needs_rng else None
                 nw, ns = opt.pure_update_multi_precision(
-                    weights[j], grads[j], states[j], t,
+                    weights[j], grads[j], states[j], ts[j],
                     lr * lr_mults[j], wd * wd_mults[j], rescale, clip, k)
                 new_w.append(nw)
                 new_s.append(ns)
             return tuple(new_w), tuple(new_s)
 
-        donate = (0, 2) if self._donate else ()
-        self._fused_fn = jax.jit(fused, donate_argnums=donate)
+        return stacked
+
+    def _step_scalars(self, idxs):
+        """Advance update counts; return traced (per-index ts, lr, keys)."""
+        opt = self._optimizer
+        for i in idxs:
+            opt._update_count(i)
+        ts = tuple(float(opt._index_update_count[i]) for i in idxs)
+        lr = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
+        keys = None
+        if opt.needs_rng:
+            from .. import random as _random
+
+            keys = tuple(_random.next_key() for _ in idxs)
+        return ts, lr, keys
 
     def _fused_step(self):
         opt = self._optimizer
@@ -147,28 +169,23 @@ class Trainer:
         self._fullstep_ctx = None
         idxs = [i for i, p in enumerate(self._params)
                 if p.grad_req != "null" and p._data_nd is not None]
-        key = tuple(idxs)
+        lr_mults, wd_mults, clip = self._mults_key(idxs)
+        key = (tuple(idxs), lr_mults, wd_mults, clip)
         if self._fused_fn is None or self._fused_key != key:
             self._fused_key = key
             for i in idxs:
                 if i not in self._states:
                     self._states[i] = opt.create_state_multi_precision(
                         i, self._params[i].data())
-            self._build_fused(idxs)
-        # bookkeeping identical to the eager per-param path
-        for i in idxs:
-            opt._update_count(i)
-        t = float(opt.num_update)
-        lr = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
-        keys = None
-        if opt.needs_rng:
-            from .. import random as _random
-
-            keys = tuple(_random.next_key() for _ in idxs)
+            donate = (0, 2) if self._donate else ()
+            self._fused_fn = jax.jit(
+                self._make_stacked_update(lr_mults, wd_mults, clip),
+                donate_argnums=donate)
+        ts, lr, keys = self._step_scalars(idxs)
         weights = tuple(self._params[i]._data_nd._data for i in idxs)
         grads = tuple(raw(self._params[i].grad()) for i in idxs)
         states = tuple(self._states[i] for i in idxs)
-        new_w, new_s = self._fused_fn(weights, grads, states, t, lr, opt.wd,
+        new_w, new_s = self._fused_fn(weights, grads, states, ts, lr, opt.wd,
                                       opt.rescale_grad, keys)
         for i, nw, ns in zip(idxs, new_w, new_s):
             self._params[i]._data_nd._data = nw
@@ -222,30 +239,23 @@ class Trainer:
     def _try_full_step(self, pending) -> bool:
         opt = self._optimizer
         block = pending.block
+        ctx = self._fullstep_ctx
+        idx_of = ctx["idx_of"] if ctx is not None else None
+        mults = self._mults_key(idx_of) if idx_of is not None else None
         sig = (id(block), block._cache_version, pending.training,
                pending.none_mask,
                tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
-        ctx = self._fullstep_ctx
-        if ctx is None or ctx["sig"] != sig:
+        if ctx is None or ctx["sig"] != sig or ctx["mults"] != mults:
             ctx = self._prepare_full_step(pending, sig)
             if ctx is None:
                 return False
             self._fullstep_ctx = ctx
         idx_of = ctx["idx_of"]
-        # bookkeeping identical to the eager per-param path
-        for i in idx_of:
-            opt._update_count(i)
-        t = float(opt.num_update)
-        lr = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
-        keys = None
-        if opt.needs_rng:
-            from .. import random as _random
-
-            keys = tuple(_random.next_key() for _ in idx_of)
+        ts, lr, keys = self._step_scalars(idx_of)
         states = ctx["states"]
         out_leaves, new_aux, grads, new_w, new_s = ctx["fn"](
             pending.train_raws, pending.aux_raws, states, pending.rng,
-            pending.rng_ctr, pending.input_raws, t, lr, opt.wd,
+            pending.rng_ctr, pending.input_raws, ts, lr, opt.wd,
             opt.rescale_grad, keys)
         pending.fill_from_full_step(out_leaves, new_aux, grads)
         for nd, nw in zip(ctx["nds"], new_w):
@@ -275,9 +285,11 @@ class Trainer:
             if i not in self._states:
                 self._states[i] = opt.create_state_multi_precision(
                     i, self._params[i].data())
-        fn = self._build_full_step(pending, idx_of)
+        mults = self._mults_key(idx_of)
+        fn = self._build_full_step(pending, mults)
         return {
             "sig": sig,
+            "mults": mults,
             "idx_of": idx_of,
             "nds": [self._params[i]._data_nd for i in idx_of],
             "states": tuple(self._states[i] for i in idx_of),
@@ -291,21 +303,16 @@ class Trainer:
             self._states.update(zip(ctx["idx_of"], ctx["states"]))
         self._states_stale = False
 
-    def _build_full_step(self, pending, idx_of):
+    def _build_full_step(self, pending, mults):
         import jax.numpy as jnp
 
-        opt = self._optimizer
         block = pending.block
         raw_fn_jit = block._cached_fn  # jitted; inlines when traced inside jit
         training, none_mask = pending.training, pending.none_mask
-        treedef = pending.out_treedef
-        lr_mults = [opt._lr_mult_for(i) for i in idx_of]
-        wd_mults = [opt._wd_mult_for(i) for i in idx_of]
-        clip = opt.clip_gradient
-        needs_rng = opt.needs_rng
+        stacked = self._make_stacked_update(*mults)
 
-        def full(train_raws, aux_raws, states, rng, rng_ctr, input_raws, t, lr,
-                 wd, rescale, keys):
+        def full(train_raws, aux_raws, states, rng, rng_ctr, input_raws, ts,
+                 lr, wd, rescale, keys):
             def f(tr):
                 out, new_aux = raw_fn_jit(training, none_mask, tr, aux_raws,
                                           rng, rng_ctr, *input_raws)
@@ -314,17 +321,10 @@ class Trainer:
             out, pullback, new_aux = jax.vjp(f, tuple(train_raws), has_aux=True)
             cot = jax.tree_util.tree_map(jnp.ones_like, out)
             (grads,) = pullback(cot)
-            new_w, new_s = [], []
-            for j in range(len(train_raws)):
-                k = keys[j] if needs_rng else None
-                nw, ns = opt.pure_update_multi_precision(
-                    train_raws[j], grads[j], states[j], t,
-                    lr * lr_mults[j], wd * wd_mults[j], rescale, clip, k)
-                new_w.append(nw)
-                new_s.append(ns)
+            new_w, new_s = stacked(train_raws, grads, states, ts, lr, wd,
+                                   rescale, keys)
             out_leaves = jax.tree_util.tree_leaves(out)
-            return (tuple(out_leaves), new_aux, tuple(grads),
-                    tuple(new_w), tuple(new_s))
+            return (tuple(out_leaves), new_aux, tuple(grads), new_w, new_s)
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(full, donate_argnums=donate)

@@ -8,7 +8,8 @@ from __future__ import annotations
 import math
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
-           "PolyScheduler", "CosineScheduler", "LinearScheduler"]
+           "PolyScheduler", "CosineScheduler", "LinearScheduler",
+           "InvSqrtScheduler"]
 
 
 class LRScheduler:
@@ -117,6 +118,20 @@ class CosineScheduler(LRScheduler):
             self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
                 (1 + math.cos(math.pi * (num_update - self.warmup_steps) / self.max_steps)) / 2
         return self.base_lr
+
+
+class InvSqrtScheduler(LRScheduler):
+    """Noam / inverse-sqrt schedule (the Transformer recipe's default;
+    GluonNLP-era `scripts/machine_translation` parity):
+    lr = base_lr * min(step^-0.5, step * warmup^-1.5)."""
+
+    def __init__(self, warmup_steps=4000, base_lr=0.01):
+        super().__init__(base_lr, warmup_steps=0)
+        self.warmup = max(1, warmup_steps)
+
+    def __call__(self, num_update):
+        step = max(1, num_update)
+        return self.base_lr * min(step ** -0.5, step * self.warmup ** -1.5)
 
 
 class LinearScheduler(LRScheduler):

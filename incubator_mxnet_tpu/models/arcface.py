@@ -56,9 +56,11 @@ def _sharded_loss(emb, w_shard, labels, *, axis_name, scale, m2, m3):
     target = _margin_cos(cos, m2, m3)
     logits = scale * jnp.where(onehot.astype(bool), target, cos)
 
-    # distributed stable log-softmax: global max then global denom (psum/pmax)
+    # distributed stable log-softmax: global max then global denom (psum/pmax).
+    # stop_gradient: the max shift cancels in d(log-softmax) and pmax has no
+    # VJP rule — without it the backward pass cannot be built at all
     local_max = jnp.max(logits, axis=1)
-    gmax = lax.pmax(local_max, axis_name)
+    gmax = lax.pmax(lax.stop_gradient(local_max), axis_name)
     e = jnp.exp(logits - gmax[:, None])
     denom = lax.psum(jnp.sum(e, axis=1), axis_name)
     # numerator: the target logit lives on exactly one shard

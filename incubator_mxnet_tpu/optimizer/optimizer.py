@@ -177,6 +177,19 @@ class Optimizer:
         return self._eager_update(index, weight, grad, state, mp=False)
 
     def update_multi_precision(self, index, weight, grad, state):
+        if (type(self).pure_update is Optimizer.pure_update
+                and type(self).update is not Optimizer.update):
+            # legacy extension point: a subclass overriding only the eager
+            # update() (the reference's custom-optimizer contract) — run
+            # the master-weight wrapper over it instead of pure_update
+            if self.multi_precision and weight._data.dtype in (jnp.float16,
+                                                               jnp.bfloat16):
+                master, sub = state
+                mw = NDArray(master)
+                new_sub = self.update(index, mw, grad, sub)
+                weight._data = mw._data.astype(weight._data.dtype)
+                return (mw._data, new_sub)
+            return self.update(index, weight, grad, state)
         return self._eager_update(index, weight, grad, state, mp=True)
 
     def __repr__(self):

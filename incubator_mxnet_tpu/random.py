@@ -94,11 +94,17 @@ def step_key():
 
 
 def get_state():
-    return _STATE.key
+    """Full RNG state: (key, step_counter) — both are needed to replay a
+    hybridized training run (step_key folds the counter per step)."""
+    return (_STATE.key, getattr(_STATE, "step_counter", 0))
 
 
-def set_state(key):
-    _STATE.key = key
+def set_state(state):
+    if isinstance(state, tuple) and len(state) == 2:
+        _STATE.key, _STATE.step_counter = state
+    else:  # bare key (older snapshots): restart the step stream
+        _STATE.key = state
+        _STATE.step_counter = 0
     _STATE.cache = None
     _STATE.cache_pos = 0
 
