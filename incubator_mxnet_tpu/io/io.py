@@ -26,11 +26,12 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
 
 class DataBatch:
     def __init__(self, data, label=None, pad=0, index=None,
-                 provide_data=None, provide_label=None):
+                 bucket_key=None, provide_data=None, provide_label=None):
         self.data = data
         self.label = label
         self.pad = pad
         self.index = index
+        self.bucket_key = bucket_key  # BucketingModule routing (ref parity)
         self.provide_data = provide_data
         self.provide_label = provide_label
 

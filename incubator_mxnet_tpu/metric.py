@@ -344,4 +344,6 @@ def create(metric, *args, **kwargs) -> EvalMetric:
         for m in metric:
             comp.add(create(m, *args, **kwargs))
         return comp
-    return _REG.create(metric, *args, **kwargs)
+    # reference short aliases (mx.metric.create('acc') etc.)
+    aliases = {"acc": "accuracy", "cross-entropy": "ce", "top_k_acc": "top_k_accuracy"}
+    return _REG.create(aliases.get(metric, metric), *args, **kwargs)

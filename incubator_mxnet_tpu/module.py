@@ -155,18 +155,19 @@ class Module(BaseModule):
         self.params_initialized = True
 
     def _infer_param_shapes(self):
-        """Shape inference by abstract evaluation of the symbol graph."""
-        import jax
+        """Shape inference over the symbol graph (ref InferShape pass)."""
+        from . import symbol as sym_mod
 
-        shapes = dict(self._shapes)
-        known = {}
+        from .base import MXNetError
 
-        # iterative: evaluate with zeros of known shapes, growing outward
-        # (simple symbolic graphs in tests bind all shapes directly)
-        for name in self._param_names:
-            if name in shapes:
-                known[name] = shapes[name]
-        return known
+        known = dict(self._shapes)
+        try:
+            return sym_mod.infer_param_shapes(self._symbol, known)
+        except MXNetError:
+            # a variable the walker can't see (e.g. a label var bound only
+            # at run time): fall back to explicitly-bound shapes; other
+            # exception types propagate — they are real bugs
+            return {n: known[n] for n in self._param_names if n in known}
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):
@@ -287,7 +288,9 @@ class BucketingModule(BaseModule):
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
-        key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:  # non-bucketing iterators leave it unset/None
+            key = self._default_bucket_key
         m = self._get_module(key)
         if not m.binded:
             m.bind(data_batch.provide_data, data_batch.provide_label, self.for_training)

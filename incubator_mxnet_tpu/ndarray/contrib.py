@@ -64,12 +64,29 @@ def while_loop(cond_fn: Callable, func: Callable, loop_vars, max_iterations: int
             ok = jnp.logical_and(ok, i < max_iterations)
         return ok
 
+    lv_struct = jax.tree_util.tree_structure(tuple(lv_raw))
+
+    def _interpret(res):
+        """Accept BOTH the reference contract `func -> (outputs, new_vars)`
+        (outputs discarded — not stacked, documented deviation) and the
+        bare `func -> new_vars` form, disambiguated by pytree structure."""
+        if isinstance(res, tuple) and len(res) == 2:
+            cand = res[1]
+            cand_t = tuple(cand) if isinstance(cand, (tuple, list)) else (cand,)
+            try:
+                if jax.tree_util.tree_structure(
+                        _tree_raw(cand_t)) == lv_struct:
+                    return cand_t
+            except Exception:
+                pass
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        return tuple(res)
+
     def b(state):
         i, vars_ = state
-        new_vars = func(*_tree_wrap(vars_))
-        if not isinstance(new_vars, (tuple, list)):
-            new_vars = (new_vars,)
-        return i + 1, _tree_raw(tuple(new_vars))
+        new_vars = _interpret(func(*_tree_wrap(vars_)))
+        return i + 1, _tree_raw(new_vars)
 
     _, final = lax.while_loop(c, b, (jnp.asarray(0), tuple(lv_raw)))
     return None, list(_tree_wrap(final))

@@ -299,9 +299,11 @@ def masked_log_softmax(data, mask, axis: int = -1):
     return apply_op(f, data, wrap(mask))
 
 
-def SoftmaxOutput(data, label, grad_scale: float = 1.0, ignore_label: float = -1.0,
+def SoftmaxOutput(data, label=None, grad_scale: float = 1.0, ignore_label: float = -1.0,
                   use_ignore: bool = False, multi_output: bool = False, **kwargs):
-    """Legacy fused softmax+CE-grad op; forward = softmax (ref: softmax_output.cc)."""
+    """Legacy fused softmax+CE-grad op; forward = softmax (ref:
+    softmax_output.cc).  `label` only shapes the backward (handled by
+    Module's implicit-CE loss), so it is optional here."""
     return softmax(data, axis=1 if multi_output else -1)
 
 

@@ -9,7 +9,8 @@ the real IR (SURVEY.md §7 table); this layer exists for API parity
 `HybridBlock.export` / `SymbolBlock.imports` round-trips.
 """
 from .symbol import (Symbol, Variable, Group, var, load, load_json,
-                     evaluate, block_to_symbol_json, Executor)
+                     evaluate, block_to_symbol_json, Executor,
+                     infer_param_shapes)
 
 import sys as _sys
 from .. import ndarray as _nd

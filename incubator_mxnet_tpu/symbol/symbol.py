@@ -7,10 +7,22 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax
 
 from ..base import MXNetError
-from ..ndarray.ndarray import NDArray, wrap
+from ..ndarray.ndarray import NDArray, raw, wrap
 
 __all__ = ["Symbol", "Variable", "Group", "var", "load", "load_json",
            "evaluate", "block_to_symbol_json", "Executor"]
+
+
+# layer ops whose parameter variables the reference auto-creates from the
+# layer name (ref nnvm registry FListInputNames)
+_IMPLICIT_PARAM_SLOTS = {
+    "FullyConnected": ("weight", "bias"),
+    "Convolution": ("weight", "bias"),
+    "Deconvolution": ("weight", "bias"),
+    "Embedding": ("weight",),
+    "BatchNorm": ("gamma", "beta", "moving_mean", "moving_var"),
+    "LayerNorm": ("gamma", "beta"),
+}
 
 
 class Symbol:
@@ -51,6 +63,14 @@ class Symbol:
                 attrs.setdefault("_sym_kwargs", []).append(k)
             else:
                 attrs[k] = v
+        # reference parity: layer ops auto-create their parameter variables
+        # ('fc_weight', 'fc_bias', ...) when only the data input is given
+        slots = _IMPLICIT_PARAM_SLOTS.get(op_name)
+        if slots and len(inputs) == 1:
+            for slot in slots:
+                if slot == "bias" and attrs.get("no_bias"):
+                    continue
+                inputs.append(cls.var(f"{name}_{slot}"))
         return cls(op_name, name, inputs, attrs)
 
     # -- properties ------------------------------------------------------ #
@@ -188,33 +208,115 @@ def Variable(name, **kwargs) -> Symbol:
 var = Variable
 
 
-def evaluate(sym: Symbol, bindings: Dict[str, Any]):
-    """Interpret the DAG through the nd namespace."""
-    from .. import ndarray as nd
+def _interpret(sym: Symbol, leaf_value, apply_node, pre_op=None):
+    """Shared graph walker behind `evaluate` and `infer_param_shapes`.
 
+    leaf_value(sym) -> value for a variable node; apply_node(s, ins) ->
+    value for an op node; pre_op(s, walk) runs before an op's inputs are
+    needed (shape-rule hook)."""
     cache: Dict[int, Any] = {}
 
     def ev(s: Symbol):
         if id(s) in cache:
             return cache[id(s)]
         if s.op is None:
-            if s._name not in bindings:
-                raise MXNetError(f"unbound symbol variable {s._name!r}")
-            out = wrap(bindings[s._name])
+            out = leaf_value(s)
         elif s.op == "_group":
             out = [ev(i) for i in s.inputs]
         elif s.op == "_index":
             out = ev(s.inputs[0])[s.attrs["index"]]
         else:
-            fn = getattr(nd, s.op)
-            ins = [ev(i) for i in s.inputs]
-            kwargs = {k: v for k, v in s.attrs.items() if not k.startswith("_")}
-            pos = s.attrs.get("_pos_args", [])
-            out = fn(*ins, *pos, **kwargs)
+            if pre_op is not None:
+                pre_op(s, ev)
+            out = apply_node(s, [ev(i) for i in s.inputs])
         cache[id(s)] = out
         return out
 
     return ev(sym)
+
+
+def _node_call(s: Symbol, ins):
+    from .. import ndarray as nd
+
+    fn = getattr(nd, s.op)
+    kwargs = {k: v for k, v in s.attrs.items() if not k.startswith("_")}
+    pos = s.attrs.get("_pos_args", [])
+    return fn(*ins, *pos, **kwargs)
+
+
+def evaluate(sym: Symbol, bindings: Dict[str, Any]):
+    """Interpret the DAG through the nd namespace."""
+
+    def leaf(s):
+        if s._name not in bindings:
+            raise MXNetError(f"unbound symbol variable {s._name!r}")
+        return wrap(bindings[s._name])
+
+    return _interpret(sym, leaf, _node_call)
+
+
+def infer_param_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
+    """Shape inference for implicit layer params (ref InferShape pass).
+
+    `known` maps data/label variable names to shapes.  Walks the graph
+    ABSTRACTLY (jax.eval_shape per op — zero FLOPs at any batch size),
+    assigning parameter-variable shapes from each layer op's rule before
+    the op is evaluated.  Returns name→shape for every variable."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    var_shapes: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
+
+    def setvar(v: Symbol, shape):
+        var_shapes.setdefault(v._name, tuple(int(x) for x in shape))
+
+    def leaf(s):
+        if s._name not in var_shapes:
+            raise MXNetError(
+                f"infer_param_shapes: cannot infer shape for variable "
+                f"{s._name!r}; bind its shape explicitly")
+        return jax.ShapeDtypeStruct(var_shapes[s._name], jnp.float32)
+
+    def pre_op(s, walk):
+        if len(s.inputs) < 2:
+            return
+        data = walk(s.inputs[0])
+        if s.op == "FullyConnected":
+            nh = int(s.attrs["num_hidden"])
+            flatten = bool(s.attrs.get("flatten", True))
+            in_units = int(onp.prod(data.shape[1:])) if flatten else int(data.shape[-1])
+            setvar(s.inputs[1], (nh, in_units))
+            if len(s.inputs) >= 3:
+                setvar(s.inputs[2], (nh,))
+        elif s.op in ("Convolution", "Deconvolution"):
+            kh, kw = (int(k) for k in s.attrs["kernel"])
+            nf = int(s.attrs["num_filter"])
+            grp = int(s.attrs.get("num_group", 1))
+            cin = int(data.shape[1])
+            wshape = ((nf, cin // grp, kh, kw) if s.op == "Convolution"
+                      else (cin, nf // grp, kh, kw))
+            setvar(s.inputs[1], wshape)
+            if len(s.inputs) >= 3:
+                setvar(s.inputs[2], (nf,))
+        elif s.op == "Embedding":
+            setvar(s.inputs[1], (int(s.attrs["input_dim"]),
+                                 int(s.attrs["output_dim"])))
+        elif s.op in ("BatchNorm", "LayerNorm"):
+            c = int(data.shape[1 if s.op == "BatchNorm" else -1])
+            for inp in s.inputs[1:]:
+                setvar(inp, (c,))
+
+    def apply_abstract(s, ins):
+        def f(*raws):
+            out = _node_call(s, [wrap(r) for r in raws])
+            first = out[0] if isinstance(out, (tuple, list)) else out
+            return raw(first)
+
+        return jax.eval_shape(f, *ins)
+
+    _interpret(sym, leaf, apply_abstract, pre_op)
+    return var_shapes
 
 
 class Executor:
@@ -248,7 +350,9 @@ class Executor:
 
         raws = [self.arg_dict[n]._data for n in names]
         out_val, vjp = jax.vjp(f, raws)
-        seed = out_grads[0]._data if out_grads else jnp.ones_like(out_val)
+        og = out_grads if isinstance(out_grads, (list, tuple)) \
+            else ([out_grads] if out_grads is not None else [])
+        seed = og[0]._data if og else jnp.ones_like(out_val)
         (grads,) = vjp(seed)
         for n, g in zip(names, grads):
             self.grad_dict[n] = NDArray(g)

@@ -1,0 +1,104 @@
+"""Legacy mx.io iterators + RecordIO python roundtrip
+(SURVEY.md §2.5; ref tests/python/unittest/test_io.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio
+
+
+def test_ndarray_iter_batching_and_pad():
+    X = onp.arange(50, dtype="float32").reshape(10, 5)
+    Y = onp.arange(10, dtype="float32")
+    it = mx.io.NDArrayIter(X, Y, batch_size=4)  # 10 = 4+4+2(pad 2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 5)
+    assert batches[-1].pad == 2
+    # reset + re-iterate
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    X = onp.arange(12, dtype="float32").reshape(12, 1)
+    it = mx.io.NDArrayIter(X, onp.arange(12, dtype="float32"),
+                           batch_size=4, shuffle=True)
+    seen = []
+    for b in it:
+        seen.extend(b.label[0].asnumpy().ravel().tolist())
+    assert sorted(seen) == list(range(12))
+
+
+def test_ndarray_iter_provide_data():
+    X = onp.zeros((8, 3), "float32")
+    it = mx.io.NDArrayIter(X, onp.zeros(8, "float32"), batch_size=2)
+    (name, shape) = it.provide_data[0][0], tuple(it.provide_data[0][1])
+    assert name == "data" and shape == (2, 3)
+
+
+def test_csv_iter(tmp_path):
+    data = onp.random.RandomState(0).randn(6, 3).astype("float32")
+    f = str(tmp_path / "d.csv")
+    onp.savetxt(f, data, delimiter=",")
+    it = mx.io.CSVIter(data_csv=f, data_shape=(3,), batch_size=2)
+    got = onp.concatenate([b.data[0].asnumpy() for b in it])
+    onp.testing.assert_allclose(got, data, rtol=1e-5)
+
+
+def test_recordio_python_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b""]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        got.append(item)
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idxp = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(5):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert sorted(r.keys) == [0, 1, 2, 3, 4]
+
+
+def test_pack_unpack_img(tmp_path):
+    img = onp.random.RandomState(0).randint(0, 255, (8, 8, 3), dtype=onp.uint8)
+    hdr = recordio.IRHeader(0, 3.0, 7, 0)
+    packed = recordio.pack_img(hdr, img, quality=95)
+    hdr2, payload = recordio.unpack(packed)
+    assert hdr2.label == 3.0 and hdr2.id == 7
+    arr = recordio.unpack_img(packed)[1] if hasattr(recordio, "unpack_img") else None
+    if arr is not None:
+        assert arr.shape[:2] == (8, 8)
+
+
+def test_image_record_iter_python_path(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    rng = onp.random.RandomState(1)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(10):
+        img = rng.randint(0, 255, (16, 16, 3), dtype=onp.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                               batch_size=4, use_native=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 16, 16)
+    assert b.label[0].shape[0] == 4

@@ -1,0 +1,113 @@
+"""KVStore facade semantics (SURVEY.md §4 "Distributed" invariants,
+single-process slice; multi-process invariants live in
+tests/test_dist_kvstore.py)."""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import kvstore as kvs_mod
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _nd(a):
+    return NDArray(jnp.asarray(onp.asarray(a, "float32")))
+
+
+def test_create_types():
+    for t in ("local", "device", "nccl", "dist_sync"):
+        kv = kvs_mod.create(t)
+        assert kv.type == t
+    with pytest.raises(Exception):
+        kvs_mod.create("dist_async")  # documented drop
+    with pytest.raises(Exception):
+        kvs_mod.create("bogus")
+
+
+def test_push_pull_sum_semantics():
+    kv = kvs_mod.create("local")
+    kv.init(3, _nd(onp.zeros((2, 2))))
+    # push a LIST of device values -> pull returns their SUM
+    vals = [_nd(onp.full((2, 2), float(i))) for i in range(1, 4)]
+    kv.push(3, vals)
+    out = _nd(onp.zeros((2, 2)))
+    kv.pull(3, out)
+    onp.testing.assert_allclose(out.asnumpy(), 6.0 * onp.ones((2, 2)))
+
+
+def test_push_pull_list_keys_and_pushpull():
+    kv = kvs_mod.create("device")
+    keys = [5, 7]
+    kv.init(keys, [_nd(onp.zeros(3)), _nd(onp.zeros(3))])
+    kv.push(keys, [[_nd(onp.ones(3))], [_nd(2 * onp.ones(3))]])
+    outs = [_nd(onp.zeros(3)), _nd(onp.zeros(3))]
+    kv.pull(keys, outs)
+    onp.testing.assert_allclose(outs[0].asnumpy(), 1.0)
+    onp.testing.assert_allclose(outs[1].asnumpy(), 2.0)
+    out = _nd(onp.zeros(3))
+    kv.pushpull(5, _nd(3 * onp.ones(3)), out)
+    onp.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_uninitialized_key_raises():
+    kv = kvs_mod.create("local")
+    with pytest.raises(Exception):
+        kv.pull(99, _nd(onp.zeros(2)))
+    with pytest.raises(Exception):
+        kv.set_optimizer(mx.optimizer.create("sgd"))
+        kv.push(99, _nd(onp.ones(2)))
+
+
+def test_server_side_updater():
+    """set_optimizer -> push applies the update, pull returns weights."""
+    kv = kvs_mod.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    w0 = onp.ones(4, "float32")
+    kv.init(0, _nd(w0))
+    kv.push(0, _nd(onp.ones(4)))
+    out = _nd(onp.zeros(4))
+    kv.pull(0, out)
+    onp.testing.assert_allclose(out.asnumpy(), w0 - 0.5, rtol=1e-6)
+
+
+def test_row_sparse_pull():
+    kv = kvs_mod.create("local")
+    w = onp.arange(12, dtype="float32").reshape(4, 3)
+    kv.init(1, _nd(w))
+    out = _nd(onp.zeros((4, 3)))
+    kv.row_sparse_pull(1, out, row_ids=_nd(onp.array([1, 3])))
+    got = out.asnumpy()
+    onp.testing.assert_allclose(got[[1, 3]], w[[1, 3]])
+    onp.testing.assert_allclose(got[[0, 2]], 0.0)
+
+
+def test_optimizer_states_io(tmp_path):
+    kv = kvs_mod.create("local")
+    kv.set_optimizer(mx.optimizer.create("adam"))
+    kv.init(0, _nd(onp.ones(3)))
+    kv.push(0, _nd(onp.ones(3)))
+    f = str(tmp_path / "states.bin")
+    kv.save_optimizer_states(f)
+    kv2 = kvs_mod.create("local")
+    kv2.set_optimizer(mx.optimizer.create("adam"))
+    kv2.load_optimizer_states(f)
+    assert 0 in kv2._updater.states
+
+
+def test_rank_and_num_workers_single_process():
+    kv = kvs_mod.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.barrier()  # no-op single process
+
+
+def test_gradient_compression_error_feedback():
+    from incubator_mxnet_tpu.kvstore.gradient_compression import GradientCompression
+
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    g = onp.array([0.7, -0.6, 0.1, 0.0], "float32")
+    c1 = onp.asarray(gc.compress(0, jnp.asarray(g)))
+    # quantized to {-t, 0, +t}
+    assert set(onp.unique(onp.abs(c1)).tolist()) <= {0.0, 0.5}
+    # residual carries the quantization error into the next round
+    c2 = onp.asarray(gc.compress(0, jnp.asarray(onp.zeros(4, "float32"))))
+    assert onp.abs(c2).sum() >= 0.0  # error feedback state exists

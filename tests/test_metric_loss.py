@@ -1,0 +1,211 @@
+"""Metric zoo + Gluon losses vs hand-computed NumPy references
+(SURVEY.md §4; ref tests/python/unittest/test_metric.py, test_loss.py)."""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import metric as metric_mod
+from incubator_mxnet_tpu.gluon import loss as loss_mod
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _nd(a):
+    return NDArray(jnp.asarray(onp.asarray(a, "float32")))
+
+
+# --------------------------------------------------------------------- #
+# metrics
+# --------------------------------------------------------------------- #
+def test_accuracy():
+    m = metric_mod.Accuracy()
+    pred = _nd([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = _nd([1, 0, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(2 / 3)
+    m.reset()
+    assert onp.isnan(m.get()[1]) or m.get()[1] == 0.0
+
+
+def test_topk_accuracy():
+    m = metric_mod.TopKAccuracy(top_k=2)
+    pred = _nd([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    label = _nd([1, 0])
+    m.update([label], [pred])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_and_mcc():
+    m = metric_mod.F1()
+    pred = _nd([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = _nd([1, 0, 0, 1])
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=1 -> P=0.5 R=0.5 F1=0.5
+    assert m.get()[1] == pytest.approx(0.5)
+
+    mcc = metric_mod.MCC()
+    mcc.update([label], [pred])
+    v = mcc.get()[1]
+    assert -1.0 <= v <= 1.0
+
+
+def test_regression_metrics():
+    pred = _nd([1.0, 2.0, 3.0])
+    label = _nd([1.5, 2.0, 2.0])
+    mae = metric_mod.MAE(); mae.update([label], [pred])
+    assert mae.get()[1] == pytest.approx(onp.abs([0.5, 0, 1]).mean())
+    mse = metric_mod.MSE(); mse.update([label], [pred])
+    assert mse.get()[1] == pytest.approx(((onp.array([0.5, 0, 1])) ** 2).mean())
+    rmse = metric_mod.RMSE(); rmse.update([label], [pred])
+    assert rmse.get()[1] == pytest.approx(onp.sqrt(((onp.array([0.5, 0, 1])) ** 2).mean()))
+
+
+def test_crossentropy_perplexity():
+    pred = onp.array([[0.7, 0.3], [0.2, 0.8]], "float32")
+    label = onp.array([0, 1], "float32")
+    ce = metric_mod.CrossEntropy()
+    ce.update([_nd(label)], [_nd(pred)])
+    want = -(onp.log(0.7) + onp.log(0.8)) / 2
+    assert ce.get()[1] == pytest.approx(want, rel=1e-5)
+    pp = metric_mod.Perplexity(ignore_label=None)
+    pp.update([_nd(label)], [_nd(pred)])
+    assert pp.get()[1] == pytest.approx(onp.exp(want), rel=1e-5)
+
+
+def test_pearson_and_loss_metric():
+    x = onp.random.RandomState(0).randn(10).astype("float32")
+    pc = metric_mod.PearsonCorrelation()
+    pc.update([_nd(x)], [_nd(2 * x + 1)])
+    assert pc.get()[1] == pytest.approx(1.0, abs=1e-5)
+    lm = metric_mod.Loss()
+    lm.update(None, [_nd([1.0, 3.0])])
+    assert lm.get()[1] == pytest.approx(2.0)
+
+
+def test_composite_and_custom():
+    comp = metric_mod.CompositeEvalMetric()
+    comp.add(metric_mod.Accuracy())
+    comp.add(metric_mod.CrossEntropy())
+    pred = _nd([[0.1, 0.9]])
+    label = _nd([1])
+    comp.update([label], [pred])
+    names, vals = comp.get()
+    assert len(names) == 2 and len(vals) == 2
+
+    cm = metric_mod.CustomMetric(lambda l, p: float(onp.mean(l == p)), name="eq")
+    cm.update([_nd([1, 2])], [_nd([1, 3])])
+    assert cm.get()[1] == pytest.approx(0.5)
+
+
+def test_metric_create_by_name():
+    m = metric_mod.create("accuracy")
+    assert isinstance(m, metric_mod.Accuracy)
+
+
+# --------------------------------------------------------------------- #
+# losses
+# --------------------------------------------------------------------- #
+def test_l2_l1_loss():
+    p = onp.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    l = onp.array([[0.0, 2.0], [4.0, 2.0]], "float32")
+    l2 = loss_mod.L2Loss()(_nd(p), _nd(l)).asnumpy()
+    onp.testing.assert_allclose(l2, ((p - l) ** 2).mean(1) / 2, rtol=1e-6)
+    l1 = loss_mod.L1Loss()(_nd(p), _nd(l)).asnumpy()
+    onp.testing.assert_allclose(l1, onp.abs(p - l).mean(1), rtol=1e-6)
+
+
+def test_softmax_ce_loss_sparse_and_dense():
+    logits = onp.array([[2.0, 1.0, 0.1], [0.5, 2.5, 0.0]], "float32")
+    labels = onp.array([0, 1], "float32")
+    sm = onp.exp(logits) / onp.exp(logits).sum(-1, keepdims=True)
+    want = -onp.log(sm[onp.arange(2), labels.astype(int)])
+    got = loss_mod.SoftmaxCrossEntropyLoss()(_nd(logits), _nd(labels)).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+    onehot = onp.eye(3, dtype="float32")[labels.astype(int)]
+    got2 = loss_mod.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        _nd(logits), _nd(onehot)).asnumpy()
+    onp.testing.assert_allclose(got2, want, rtol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    p = onp.array([[0.5, -1.0], [2.0, 0.0]], "float32")
+    l = onp.array([[1.0, 0.0], [1.0, 1.0]], "float32")
+    sig = 1 / (1 + onp.exp(-p))
+    want = -(l * onp.log(sig) + (1 - l) * onp.log(1 - sig)).mean(1)
+    got = loss_mod.SigmoidBinaryCrossEntropyLoss()(_nd(p), _nd(l)).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_kldiv_loss():
+    logp = onp.log(onp.array([[0.5, 0.5], [0.9, 0.1]], "float32"))
+    target = onp.array([[0.4, 0.6], [0.8, 0.2]], "float32")
+    got = loss_mod.KLDivLoss()(_nd(logp), _nd(target)).asnumpy()
+    want = (target * (onp.log(target) - logp)).mean(1)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_huber_hinge_logistic():
+    p = onp.array([[0.5], [-2.0]], "float32")
+    l = onp.array([[0.0], [0.0]], "float32")
+    hub = loss_mod.HuberLoss(rho=1.0)(_nd(p), _nd(l)).asnumpy()
+    want = onp.where(onp.abs(p - l) > 1, onp.abs(p - l) - 0.5,
+                     0.5 * (p - l) ** 2).mean(1)
+    onp.testing.assert_allclose(hub, want, rtol=1e-5)
+
+    pl = onp.array([[0.5], [-0.5]], "float32")
+    ll = onp.array([[1.0], [-1.0]], "float32")
+    hinge = loss_mod.HingeLoss()(_nd(pl), _nd(ll)).asnumpy()
+    onp.testing.assert_allclose(hinge, onp.maximum(0, 1 - pl * ll).mean(1), rtol=1e-5)
+    sq = loss_mod.SquaredHingeLoss()(_nd(pl), _nd(ll)).asnumpy()
+    onp.testing.assert_allclose(sq, (onp.maximum(0, 1 - pl * ll) ** 2).mean(1), rtol=1e-5)
+    lg = loss_mod.LogisticLoss()(_nd(pl), _nd(ll)).asnumpy()
+    assert lg.shape == (2,) and (lg > 0).all()
+
+
+def test_triplet_and_cosine():
+    a = onp.random.RandomState(1).randn(2, 4).astype("float32")
+    pos = a + 0.01
+    neg = -a
+    tl = loss_mod.TripletLoss(margin=1.0)(_nd(a), _nd(pos), _nd(neg)).asnumpy()
+    assert (tl >= 0).all()
+    x1, x2 = _nd(a), _nd(a.copy())
+    cos_same = loss_mod.CosineEmbeddingLoss()(x1, x2, _nd(onp.ones(2, "float32"))).asnumpy()
+    onp.testing.assert_allclose(cos_same, 0.0, atol=1e-5)
+
+
+def test_poisson_nll():
+    p = onp.array([[1.0, 2.0]], "float32")
+    l = onp.array([[1.0, 1.0]], "float32")
+    got = loss_mod.PoissonNLLLoss(from_logits=True)(_nd(p), _nd(l)).asnumpy()
+    want = (onp.exp(p) - p * l).mean(1)
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_ctc_loss_perfect_alignment():
+    # vocab {blank=0, a=1}; T=4, label 'a': loss must be finite & positive
+    logits = onp.full((1, 4, 3), -5.0, "float32")
+    logits[0, :, 1] = 5.0
+    got = loss_mod.CTCLoss()(_nd(logits), _nd(onp.array([[1.0]], "float32"))).asnumpy()
+    assert onp.isfinite(got).all() and (got >= 0).all()
+
+
+def test_loss_sample_weight():
+    p = onp.ones((2, 3), "float32")
+    l = onp.zeros((2, 3), "float32")
+    sw = onp.array([[1.0], [0.0]], "float32")
+    got = loss_mod.L2Loss()(_nd(p), _nd(l), _nd(sw)).asnumpy()
+    assert got[0] == pytest.approx(0.5) and got[1] == pytest.approx(0.0)
+
+
+def test_losses_differentiable():
+    """Losses must produce grads through autograd.record."""
+    from incubator_mxnet_tpu import autograd
+
+    p = _nd(onp.random.RandomState(2).randn(3, 4).astype("float32"))
+    l = _nd(onp.zeros((3, 4), "float32"))
+    p.attach_grad()
+    with autograd.record():
+        out = loss_mod.L2Loss()(p, l).sum()
+    out.backward()
+    g = p.grad.asnumpy()
+    onp.testing.assert_allclose(g, p.asnumpy() / 4, rtol=1e-5)

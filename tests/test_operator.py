@@ -1,0 +1,489 @@
+"""Per-op numeric matrix vs NumPy + finite-difference gradient checks.
+
+The translation of the reference's `tests/python/unittest/test_operator.py`
+culture (SURVEY.md §4): NumPy is the numeric oracle, gradients are
+checked by central differences (`test_utils.check_numeric_gradient`),
+and a bf16-vs-f32 consistency sweep replaces cpu-vs-gpu
+`check_consistency`.
+"""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils
+from incubator_mxnet_tpu.ndarray import contrib, linalg, nn_ops, ops
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+nd = mx.nd
+
+
+def _nd(a):
+    return NDArray(jnp.asarray(a))
+
+
+def _rand(shape, lo=-1.0, hi=1.0, seed=0):
+    return onp.random.RandomState(seed).uniform(lo, hi, shape).astype("float32")
+
+
+# --------------------------------------------------------------------- #
+# unary matrix
+# --------------------------------------------------------------------- #
+_UNARY = [
+    # (name, numpy_fn, (lo, hi))
+    ("exp", onp.exp, (-2, 2)), ("log", onp.log, (0.1, 5)),
+    ("log2", onp.log2, (0.1, 5)), ("log10", onp.log10, (0.1, 5)),
+    ("log1p", onp.log1p, (-0.5, 5)), ("expm1", onp.expm1, (-2, 2)),
+    ("sqrt", onp.sqrt, (0.01, 9)), ("rsqrt", lambda x: 1 / onp.sqrt(x), (0.1, 9)),
+    ("cbrt", onp.cbrt, (-8, 8)), ("square", onp.square, (-3, 3)),
+    ("reciprocal", onp.reciprocal, (0.2, 4)), ("abs", onp.abs, (-3, 3)),
+    ("sign", onp.sign, (-3, 3)), ("floor", onp.floor, (-3, 3)),
+    ("ceil", onp.ceil, (-3, 3)), ("round", onp.round, (-3, 3)),
+    ("trunc", onp.trunc, (-3, 3)), ("negative", onp.negative, (-3, 3)),
+    ("sigmoid", lambda x: 1 / (1 + onp.exp(-x)), (-4, 4)),
+    ("relu", lambda x: onp.maximum(x, 0), (-3, 3)),
+    ("softsign", lambda x: x / (1 + onp.abs(x)), (-3, 3)),
+    ("sin", onp.sin, (-3, 3)), ("cos", onp.cos, (-3, 3)),
+    ("tan", onp.tan, (-1, 1)), ("arcsin", onp.arcsin, (-0.9, 0.9)),
+    ("arccos", onp.arccos, (-0.9, 0.9)), ("arctan", onp.arctan, (-3, 3)),
+    ("sinh", onp.sinh, (-2, 2)), ("cosh", onp.cosh, (-2, 2)),
+    ("tanh", onp.tanh, (-2, 2)), ("arcsinh", onp.arcsinh, (-3, 3)),
+    ("arccosh", onp.arccosh, (1.1, 4)), ("arctanh", onp.arctanh, (-0.9, 0.9)),
+    ("degrees", onp.degrees, (-3, 3)), ("radians", onp.radians, (-90, 90)),
+    ("erf", None, (-2, 2)), ("gammaln", None, (0.5, 4)),
+]
+
+
+@pytest.mark.parametrize("name,npf,dom", _UNARY, ids=[u[0] for u in _UNARY])
+def test_unary_vs_numpy(name, npf, dom):
+    if npf is None:
+        import scipy.special as sp  # available via jax deps? fall back
+        npf = {"erf": sp.erf, "gammaln": sp.gammaln}[name]
+    x = _rand((3, 4), *dom)
+    got = getattr(ops, name)(_nd(x)).asnumpy()
+    test_utils.assert_almost_equal(got, npf(x).astype("float32"),
+                                   rtol=1e-5, atol=1e-5)
+
+
+_BINARY = [
+    ("add", onp.add), ("subtract", onp.subtract), ("multiply", onp.multiply),
+    ("divide", onp.divide), ("power", lambda a, b: onp.power(onp.abs(a) + 0.5, b)),
+    ("maximum", onp.maximum), ("minimum", onp.minimum), ("hypot", onp.hypot),
+    ("equal", lambda a, b: (a == b).astype("float32")),
+    ("not_equal", lambda a, b: (a != b).astype("float32")),
+    ("greater", lambda a, b: (a > b).astype("float32")),
+    ("lesser", lambda a, b: (a < b).astype("float32")),
+]
+
+
+@pytest.mark.parametrize("name,npf", _BINARY, ids=[b[0] for b in _BINARY])
+def test_binary_vs_numpy(name, npf):
+    a, b = _rand((3, 4), seed=1), _rand((3, 4), 0.5, 2.0, seed=2)
+    aa, bb = (onp.abs(a) + 0.5, b) if name == "power" else (a, b)
+    got = getattr(ops, name)(_nd(aa), _nd(bb)).asnumpy()
+    want = npf(a, b) if name == "power" else npf(aa, bb)
+    test_utils.assert_almost_equal(got, want.astype("float32"), rtol=1e-5, atol=1e-5)
+
+
+def test_binary_broadcasting():
+    a, b = _rand((3, 1, 4)), _rand((1, 5, 4), seed=3)
+    test_utils.assert_almost_equal(
+        ops.broadcast_add(_nd(a), _nd(b)).asnumpy(), a + b, rtol=1e-6, atol=1e-6)
+    test_utils.assert_almost_equal(
+        ops.broadcast_mul(_nd(a), _nd(b)).asnumpy(), a * b, rtol=1e-6, atol=1e-6)
+
+
+# --------------------------------------------------------------------- #
+# reductions / ordering
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("name,npf", [
+    ("sum", onp.sum), ("mean", onp.mean), ("max", onp.max),
+    ("min", onp.min), ("prod", onp.prod), ("nansum", onp.nansum),
+], ids=["sum", "mean", "max", "min", "prod", "nansum"])
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+def test_reduce_vs_numpy(name, npf, axis):
+    x = _rand((4, 5), 0.1, 2.0)
+    got = getattr(ops, name)(_nd(x), axis=axis).asnumpy()
+    test_utils.assert_almost_equal(onp.asarray(got), npf(x, axis=axis),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_argmax_argmin_norm():
+    x = _rand((4, 5))
+    assert onp.array_equal(ops.argmax(_nd(x), axis=1).asnumpy(), x.argmax(1))
+    assert onp.array_equal(ops.argmin(_nd(x), axis=0).asnumpy(), x.argmin(0))
+    test_utils.assert_almost_equal(
+        onp.asarray(ops.norm(_nd(x)).asnumpy()), onp.linalg.norm(x), rtol=1e-5, atol=1e-5)
+
+
+def test_sort_argsort_topk():
+    x = _rand((3, 6))
+    assert onp.allclose(ops.sort(_nd(x), axis=1).asnumpy(), onp.sort(x, 1))
+    assert onp.array_equal(ops.argsort(_nd(x), axis=1).asnumpy().astype(int),
+                           onp.argsort(x, 1, kind="stable"))
+    topv = ops.topk(_nd(x), k=2, ret_typ="value").asnumpy()
+    want = onp.sort(x, 1)[:, ::-1][:, :2]
+    assert onp.allclose(topv, want)
+
+
+# --------------------------------------------------------------------- #
+# shape / indexing ops
+# --------------------------------------------------------------------- #
+def test_matrix_ops():
+    x = _rand((2, 3, 4))
+    assert ops.reshape(_nd(x), (4, 6)).shape == (4, 6)
+    assert ops.transpose(_nd(x), (2, 0, 1)).shape == (4, 2, 3)
+    assert ops.expand_dims(_nd(x), 1).shape == (2, 1, 3, 4)
+    assert ops.flatten(_nd(x)).shape == (2, 12)
+    c = ops.concat(_nd(x), _nd(x), dim=2)
+    assert c.shape == (2, 3, 8)
+    s = ops.stack(_nd(x), _nd(x), axis=0)
+    assert s.shape == (2, 2, 3, 4)
+    parts = ops.split(_nd(x), 2, axis=2)
+    assert parts[0].shape == (2, 3, 2)
+    assert ops.tile(_nd(x), (2, 1, 1)).shape == (4, 3, 4)
+    assert ops.repeat(_nd(x), 2, axis=0).shape == (4, 3, 4)
+    assert ops.reverse(_nd(x), axis=0).asnumpy()[0].sum() == pytest.approx(x[1].sum(), rel=1e-5)
+
+
+def test_slice_family():
+    x = _rand((5, 6))
+    assert onp.allclose(ops.slice(_nd(x), (1, 2), (4, 5)).asnumpy(), x[1:4, 2:5])
+    assert onp.allclose(ops.slice_axis(_nd(x), 1, 1, 4).asnumpy(), x[:, 1:4])
+    like = _nd(onp.zeros((3, 2), "float32"))
+    assert onp.allclose(ops.slice_like(_nd(x), like).asnumpy(), x[:3, :2])
+
+
+def test_pad_depth_space():
+    x = _rand((1, 4, 2, 2))
+    p = ops.pad(_nd(x), mode="constant",
+                pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=0.0)
+    assert p.shape == (1, 4, 4, 4)
+    d2s = ops.depth_to_space(_nd(x), 2)
+    assert d2s.shape == (1, 1, 4, 4)
+    s2d = ops.space_to_depth(d2s, 2)
+    assert onp.allclose(s2d.asnumpy(), x)
+
+
+def test_take_pick_gather_scatter():
+    x = _rand((4, 5))
+    idx = onp.array([0, 2, 3])
+    assert onp.allclose(ops.take(_nd(x), _nd(idx)).asnumpy(), x[idx])
+    pk = ops.pick(_nd(x), _nd(onp.array([0, 1, 2, 3])), axis=1).asnumpy()
+    assert onp.allclose(pk, x[onp.arange(4), [0, 1, 2, 3]])
+    gi = onp.array([[0, 1], [2, 3]])  # gather_nd indices (2, N)
+    g = ops.gather_nd(_nd(x), _nd(gi)).asnumpy()
+    assert onp.allclose(g, x[[0, 1], [2, 3]])
+    sc = ops.scatter_nd(_nd(onp.array([1.0, 2.0], "float32")), _nd(gi), (4, 5)).asnumpy()
+    want = onp.zeros((4, 5), "float32")
+    want[0, 2], want[1, 3] = 1.0, 2.0
+    assert onp.allclose(sc, want)
+
+
+def test_one_hot_embedding():
+    oh = ops.one_hot(_nd(onp.array([0, 2])), 3).asnumpy()
+    assert onp.allclose(oh, onp.eye(3, dtype="float32")[[0, 2]])
+    w = _rand((10, 4))
+    e = ops.embedding(_nd(onp.array([1, 5])), _nd(w)).asnumpy()
+    assert onp.allclose(e, w[[1, 5]])
+
+
+def test_sequence_ops():
+    x = _rand((4, 2, 3))  # (T, B, C)
+    sl = onp.array([2.0, 4.0], "float32")
+    m = ops.sequence_mask(_nd(x), _nd(sl), use_sequence_length=True, value=-1.0).asnumpy()
+    assert onp.all(m[2:, 0] == -1.0) and onp.allclose(m[:, 1], x[:, 1])
+    last = ops.sequence_last(_nd(x), _nd(sl), use_sequence_length=True).asnumpy()
+    assert onp.allclose(last[0], x[1, 0]) and onp.allclose(last[1], x[3, 1])
+    rev = ops.sequence_reverse(_nd(x), _nd(sl), use_sequence_length=True).asnumpy()
+    assert onp.allclose(rev[0, 0], x[1, 0]) and onp.allclose(rev[0, 1], x[3, 1])
+
+
+def test_where_clip_cast():
+    x = _rand((3, 4))
+    c = (x > 0).astype("float32")
+    assert onp.allclose(ops.where(_nd(c), _nd(x), _nd(-x)).asnumpy(), onp.abs(x))
+    assert onp.allclose(ops.clip(_nd(x), -0.5, 0.5).asnumpy(), onp.clip(x, -0.5, 0.5))
+    assert ops.cast(_nd(x), "int32").dtype == onp.dtype("int32")
+
+
+# --------------------------------------------------------------------- #
+# gradient checks (finite differences — the reference oracle)
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("fn,dom", [
+    (lambda x: ops.tanh(x), (-1, 1)),
+    (lambda x: ops.sigmoid(x), (-2, 2)),
+    (lambda x: ops.exp(x), (-1, 1)),
+    (lambda x: ops.log(x), (0.5, 2)),
+    (lambda x: nn_ops.softmax(x), (-1, 1)),
+    (lambda x: nn_ops.log_softmax(x), (-1, 1)),
+    (lambda x: ops.square(x) * ops.sin(x), (-1, 1)),
+    (lambda x: nn_ops.smooth_l1(x), (-2, 2)),
+], ids=["tanh", "sigmoid", "exp", "log", "softmax", "log_softmax",
+        "square_sin", "smooth_l1"])
+def test_numeric_gradient_unary(fn, dom):
+    x = _rand((2, 3), *dom, seed=11)
+    test_utils.check_numeric_gradient(fn, [_nd(x)])
+
+
+def test_numeric_gradient_dot_fc():
+    a, b = _rand((2, 3), seed=5), _rand((3, 2), seed=6)
+    test_utils.check_numeric_gradient(lambda x, y: ops.dot(x, y), [_nd(a), _nd(b)])
+    x, w = _rand((2, 4), seed=7), _rand((3, 4), seed=8)
+    test_utils.check_numeric_gradient(
+        lambda d, ww: nn_ops.FullyConnected(d, ww, num_hidden=3, no_bias=True),
+        [_nd(x), _nd(w)])
+
+
+def test_numeric_gradient_layernorm():
+    x = _rand((2, 4), seed=9)
+    g, b = onp.ones(4, "float32"), onp.zeros(4, "float32")
+    test_utils.check_numeric_gradient(
+        lambda d: nn_ops.LayerNorm(d, _nd(g), _nd(b)), [_nd(x)],
+        rtol=2e-2, atol=2e-3)
+
+
+def test_numeric_gradient_take():
+    x = _rand((4, 3), seed=10)
+    idx = _nd(onp.array([0, 2]))
+    test_utils.check_numeric_gradient(lambda d: ops.take(d, idx), [_nd(x)])
+
+
+# --------------------------------------------------------------------- #
+# dense NN ops vs explicit NumPy implementations
+# --------------------------------------------------------------------- #
+def test_fullyconnected_vs_numpy():
+    x, w, b = _rand((2, 8)), _rand((5, 8), seed=2), _rand((5,), seed=3)
+    got = nn_ops.FullyConnected(_nd(x), _nd(w), _nd(b), num_hidden=5).asnumpy()
+    assert onp.allclose(got, x @ w.T + b, atol=1e-5)
+
+
+def _np_conv2d(x, w, stride, pad):
+    B, C, H, W = x.shape
+    O, _, KH, KW = w.shape
+    xp = onp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    OH = (H + 2 * pad - KH) // stride + 1
+    OW = (W + 2 * pad - KW) // stride + 1
+    out = onp.zeros((B, O, OH, OW), "float32")
+    for i in range(OH):
+        for j in range(OW):
+            patch = xp[:, :, i * stride:i * stride + KH, j * stride:j * stride + KW]
+            out[:, :, i, j] = onp.einsum("bchw,ochw->bo", patch, w)
+    return out
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (1, 1), (2, 1)])
+def test_convolution_vs_numpy(stride, pad):
+    x = _rand((2, 3, 6, 6), seed=4)
+    w = _rand((4, 3, 3, 3), seed=5)
+    got = nn_ops.Convolution(_nd(x), _nd(w), kernel=(3, 3),
+                             stride=(stride, stride), pad=(pad, pad),
+                             num_filter=4, no_bias=True).asnumpy()
+    assert onp.allclose(got, _np_conv2d(x, w, stride, pad), atol=1e-4)
+
+
+def test_pooling_vs_numpy():
+    x = _rand((1, 2, 4, 4), seed=6)
+    mp = nn_ops.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+    want = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert onp.allclose(mp, want)
+    ap = nn_ops.Pooling(_nd(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
+    wanta = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert onp.allclose(ap, wanta, atol=1e-6)
+    gp = nn_ops.Pooling(_nd(x), pool_type="max", global_pool=True).asnumpy()
+    assert onp.allclose(gp.ravel(), x.max(axis=(2, 3)).ravel())
+
+
+def test_norm_layers_vs_numpy():
+    x = _rand((2, 3, 4), seed=7)
+    g, b = onp.ones(4, "float32") * 1.5, onp.ones(4, "float32") * 0.2
+    ln = nn_ops.LayerNorm(_nd(x), _nd(g), _nd(b)).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    assert onp.allclose(ln, (x - mu) / onp.sqrt(var + 1e-5) * g + b, atol=1e-5)
+
+    xc = _rand((2, 4, 3, 3), seed=8)
+    gi, bi = onp.ones(4, "float32"), onp.zeros(4, "float32")
+    inorm = nn_ops.InstanceNorm(_nd(xc), _nd(gi), _nd(bi)).asnumpy()
+    mu = xc.mean(axis=(2, 3), keepdims=True)
+    var = xc.var(axis=(2, 3), keepdims=True)
+    assert onp.allclose(inorm, (xc - mu) / onp.sqrt(var + 1e-5), atol=1e-4)
+
+
+def test_batchnorm_train_and_inference():
+    x = _rand((4, 3, 2, 2), seed=9)
+    g = onp.ones(3, "float32")
+    b = onp.zeros(3, "float32")
+    mm = onp.zeros(3, "float32")
+    mv = onp.ones(3, "float32")
+    out, new_mean, new_var = nn_ops.BatchNorm(
+        _nd(x), _nd(g), _nd(b), _nd(mm), _nd(mv), training=True)
+    mu = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    want = (x - mu.reshape(1, 3, 1, 1)) / onp.sqrt(var.reshape(1, 3, 1, 1) + 1e-5)
+    assert onp.allclose(out.asnumpy(), want, atol=1e-4)
+    # inference path uses moving stats
+    out2 = nn_ops.BatchNorm(_nd(x), _nd(g), _nd(b), _nd(mm), _nd(mv),
+                            training=False)
+    out2 = out2[0] if isinstance(out2, tuple) else out2
+    assert onp.allclose(out2.asnumpy(), x, atol=1e-4)  # mean 0 var 1 -> identity
+
+
+def test_softmax_family_vs_numpy():
+    x = _rand((3, 5), seed=10)
+    sm = onp.exp(x) / onp.exp(x).sum(-1, keepdims=True)
+    assert onp.allclose(nn_ops.softmax(_nd(x)).asnumpy(), sm, atol=1e-6)
+    assert onp.allclose(nn_ops.log_softmax(_nd(x)).asnumpy(), onp.log(sm), atol=1e-5)
+    assert onp.allclose(nn_ops.softmin(_nd(x)).asnumpy(),
+                        onp.exp(-x) / onp.exp(-x).sum(-1, keepdims=True), atol=1e-6)
+    mask = onp.ones_like(x)
+    mask[:, -1] = 0
+    msm = nn_ops.masked_softmax(_nd(x), _nd(mask)).asnumpy()
+    assert onp.allclose(msm[:, -1], 0, atol=1e-6)
+    assert onp.allclose(msm[:, :-1].sum(-1), 1, atol=1e-5)
+
+
+def test_dropout_modes():
+    x = _nd(onp.ones((100, 100), "float32"))
+    out = nn_ops.Dropout(x, p=0.5, training=False)
+    assert onp.allclose(out.asnumpy(), 1.0)  # identity at inference
+    out_t = nn_ops.Dropout(x, p=0.5, training=True).asnumpy()
+    kept = (out_t != 0).mean()
+    assert 0.4 < kept < 0.6
+    assert onp.allclose(out_t[out_t != 0], 2.0, atol=1e-5)  # inverted scaling
+
+
+def test_activation_variants():
+    x = _rand((3, 4), -2, 2)
+    assert onp.allclose(nn_ops.Activation(_nd(x), "relu").asnumpy(), onp.maximum(x, 0))
+    assert onp.allclose(nn_ops.Activation(_nd(x), "tanh").asnumpy(), onp.tanh(x), atol=1e-6)
+    lk = nn_ops.LeakyReLU(_nd(x), act_type="leaky", slope=0.1).asnumpy()
+    assert onp.allclose(lk, onp.where(x > 0, x, 0.1 * x), atol=1e-6)
+
+
+def test_upsampling_nearest():
+    x = _rand((1, 2, 2, 2))
+    up = nn_ops.UpSampling(_nd(x), scale=2, sample_type="nearest").asnumpy()
+    assert up.shape == (1, 2, 4, 4)
+    assert onp.allclose(up[0, 0, :2, :2], x[0, 0, 0, 0])
+
+
+def test_l2_normalization():
+    x = _rand((2, 6))
+    out = nn_ops.L2Normalization(_nd(x)).asnumpy()
+    assert onp.allclose(onp.linalg.norm(out, axis=1), 1.0, atol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# linalg family vs numpy.linalg
+# --------------------------------------------------------------------- #
+def test_linalg_gemm_potrf_trsm():
+    a, b = _rand((3, 4), seed=1), _rand((4, 2), seed=2)
+    c = _rand((3, 2), seed=3)
+    got = linalg.gemm(_nd(a), _nd(b), _nd(c), alpha=2.0, beta=0.5).asnumpy()
+    assert onp.allclose(got, 2.0 * a @ b + 0.5 * c, atol=1e-5)
+    assert onp.allclose(linalg.gemm2(_nd(a), _nd(b)).asnumpy(), a @ b, atol=1e-5)
+
+    m = _rand((3, 3), seed=4)
+    spd = m @ m.T + 3 * onp.eye(3, dtype="float32")
+    L = linalg.potrf(_nd(spd)).asnumpy()
+    assert onp.allclose(L @ L.T, spd, atol=1e-4)
+    x = linalg.trsm(_nd(L), _nd(onp.eye(3, dtype="float32"))).asnumpy()
+    assert onp.allclose(L @ x, onp.eye(3), atol=1e-4)
+
+
+def test_linalg_decompositions():
+    m = _rand((4, 4), seed=5)
+    assert onp.allclose(linalg.det(_nd(m)).asnumpy(), onp.linalg.det(m), atol=1e-4)
+    inv = linalg.inverse(_nd(m)).asnumpy()
+    assert onp.allclose(m @ inv, onp.eye(4), atol=1e-3)
+    q, r = linalg.qr(_nd(m))
+    assert onp.allclose(q.asnumpy() @ r.asnumpy(), m, atol=1e-4)
+    u, s, vt = linalg.svd(_nd(m))
+    assert onp.allclose(u.asnumpy() @ onp.diag(s.asnumpy()) @ vt.asnumpy(), m, atol=1e-4)
+    spd = m @ m.T + 4 * onp.eye(4, dtype="float32")
+    w, v = linalg.eigh(_nd(spd))
+    assert onp.allclose(v.asnumpy() @ onp.diag(w.asnumpy()) @ v.asnumpy().T, spd, atol=1e-3)
+    bb = _rand((4, 2), seed=6)
+    assert onp.allclose(linalg.solve(_nd(m), _nd(bb)).asnumpy(),
+                        onp.linalg.solve(m, bb), atol=1e-3)
+
+
+def test_linalg_diag_trian():
+    m = _rand((3, 3))
+    assert onp.allclose(linalg.extractdiag(_nd(m)).asnumpy(), onp.diag(m))
+    d = onp.array([1.0, 2.0, 3.0], "float32")
+    assert onp.allclose(linalg.makediag(_nd(d)).asnumpy(), onp.diag(d))
+    assert onp.allclose(linalg.syrk(_nd(m)).asnumpy(), m @ m.T, atol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# control flow + contrib
+# --------------------------------------------------------------------- #
+def test_foreach_cumsum():
+    data = _nd(onp.arange(5, dtype="float32"))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = contrib.foreach(body, data, _nd(onp.zeros((), "float32")))
+    assert onp.allclose(outs.asnumpy(), onp.cumsum(onp.arange(5)))
+    assert float(final.asnumpy()) == 10.0
+
+
+def test_while_loop_and_cond():
+    # reference contract: func -> (step_outputs, new_loop_vars)
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return None, (i + 1, s + i)
+
+    _, (i, s) = contrib.while_loop(cond_fn, func,
+                                   (_nd(onp.zeros((), "int32")),
+                                    _nd(onp.zeros((), "int32"))),
+                                   max_iterations=10)
+    assert int(s.asnumpy()) == 10
+    out = contrib.cond(_nd(onp.ones((), "float32")),
+                       lambda x: x * 2, lambda x: x * 3,
+                       (_nd(onp.full((), 5.0, "float32")),))
+    assert float(out.asnumpy() if hasattr(out, "asnumpy") else out[0].asnumpy()) == 10.0
+
+
+def test_boolean_mask_static_shape_deviation():
+    """boolean_mask keeps static shape: selected rows are compacted to the
+    front and the selected count returned (documented TPU deviation)."""
+    x = _rand((4, 3))
+    mask = onp.array([1, 0, 1, 0], "float32")
+    out = contrib.boolean_mask(_nd(x), _nd(mask))
+    n = int(mask.sum())
+    assert onp.allclose(out.asnumpy()[:n], x[[0, 2]])
+
+
+def test_index_copy():
+    old = onp.zeros((4, 3), "float32")
+    new = _rand((2, 3), seed=3)
+    got = contrib.index_copy(_nd(old), _nd(onp.array([1, 3])), _nd(new)).asnumpy()
+    assert onp.allclose(got[[1, 3]], new) and onp.allclose(got[[0, 2]], 0)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = _rand((3, 4), -1, 1)
+    mn = _nd(onp.float32(-1.0))
+    mx_ = _nd(onp.float32(1.0))
+    q = contrib.quantize(_nd(x), mn, mx_)
+    deq = contrib.dequantize(q[0] if isinstance(q, tuple) else q, mn, mx_)
+    assert onp.allclose(deq.asnumpy(), x, atol=2.0 / 255 + 1e-3)
+
+
+# --------------------------------------------------------------------- #
+# bf16 consistency (replaces cpu-vs-gpu check_consistency)
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("fn", [
+    lambda x: ops.tanh(x), lambda x: nn_ops.softmax(x),
+    lambda x: ops.square(x).sum(),
+], ids=["tanh", "softmax", "square_sum"])
+def test_bf16_consistency(fn):
+    x = _rand((4, 8), seed=12)
+    test_utils.check_consistency(fn, [x])

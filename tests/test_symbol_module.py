@@ -1,0 +1,86 @@
+"""Symbol graph / Executor / Module legacy path (SURVEY.md §2.2, §3.4;
+ref tests/python/unittest/test_symbol.py, test_module.py)."""
+import jax.numpy as jnp
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import symbol as sym_mod
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+sym = mx.sym
+
+
+def _nd(a):
+    return NDArray(jnp.asarray(onp.asarray(a, "float32")))
+
+
+def test_symbol_compose_and_eval():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = (x + y) * x
+    out = sym_mod.evaluate(z, {"x": _nd([2.0]), "y": _nd([3.0])})
+    assert float(out.asnumpy()[0]) == 10.0
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    z = sym.FullyConnected(data=x, weight=w, num_hidden=3, no_bias=True) \
+        if hasattr(sym, "FullyConnected") else (x * w)
+    f = str(tmp_path / "sym.json")
+    z.save(f)
+    z2 = sym_mod.load(f)
+    assert sorted(z2.list_arguments()) == sorted(z.list_arguments())
+
+
+def test_executor_forward_backward():
+    x = sym.Variable("x")
+    ex = (x * x).bind(args={"x": _nd([1.0, 2.0, 3.0])})
+    outs = ex.forward()
+    got = outs[0].asnumpy()
+    onp.testing.assert_allclose(got, [1.0, 4.0, 9.0], rtol=1e-6)
+    ex.backward(out_grads=_nd([1.0, 1.0, 1.0]))
+    g = ex.grad_arrays[0] if hasattr(ex, "grad_arrays") else ex.grad_dict["x"]
+    onp.testing.assert_allclose(g.asnumpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+
+def test_module_fit_linear_regression():
+    """Module.fit on a learnable toy problem (3.4 legacy stack)."""
+    rng = onp.random.RandomState(0)
+    X = rng.randn(200, 4).astype("float32")
+    W = onp.array([[1.0, -2.0, 0.5, 3.0]], "float32")
+    Y = (X @ W.T > 0).astype("float32").ravel()
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=2, name="fc")
+    out = sym.SoftmaxOutput(data=net, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",), label_names=("softmax_label",))
+    it = mx.io.NDArrayIter(X, Y, batch_size=20, shuffle=True)
+    mod.fit(it, num_epoch=5,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    it_eval = mx.io.NDArrayIter(X, Y, batch_size=20)
+    metric = mx.metric.Accuracy()
+    mod.score(it_eval, metric)
+    assert metric.get()[1] > 0.85
+
+
+def test_bucketing_module_variable_length():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        out = sym.FullyConnected(data=data, num_hidden=2, name="fc")
+        out = sym.SoftmaxOutput(data=out, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    rng = onp.random.RandomState(1)
+    X8 = rng.randn(16, 8).astype("float32")
+    Y = (X8.sum(1) > 0).astype("float32")
+    bm.bind(data_shapes=[("data", (4, 8))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd")
+    from incubator_mxnet_tpu.io.io import DataBatch
+
+    batch = DataBatch(data=[_nd(X8[:4])], label=[_nd(Y[:4])], bucket_key=8)
+    bm.forward(batch)
+    outs = bm.get_outputs()
+    assert outs[0].shape == (4, 2)
