@@ -47,6 +47,12 @@ class KVStore:
         self._updater = None
         self._compression: Optional[GradientCompression] = None
         self._is_dist = kv_type.startswith("dist")
+        if self._is_dist:
+            # rendezvous with the launcher's coordinator (tools/launch.py
+            # worker contract); no-op when launched single-process
+            from ..parallel import collectives
+
+            collectives.initialize_distributed()
 
     # -- topology ------------------------------------------------------- #
     @property

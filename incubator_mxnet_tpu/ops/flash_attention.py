@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "attention_reference"]
+__all__ = ["flash_attention", "flash_attention_with_lse", "attention_reference"]
 
 
 def _safe_softmax(s):
@@ -54,7 +54,8 @@ def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = 
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, nk, tq, tk):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk,
+               nk, tq, tk):
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
@@ -91,6 +92,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, bq, bk, nk, tq, tk)
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # row logsumexp for the fused backward (−inf on fully-masked rows);
+    # stored 8-wide-broadcast: TPU block shapes need sublane-divisible dims
+    lse = m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30))
+    lse = jnp.where(jnp.isfinite(m[:, 0]), lse, -jnp.inf)
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, bq))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q", "block_k",
@@ -101,8 +107,10 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
 
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    bq = min(block_q, Tq)
-    bk = min(block_k, Tk)
+    # interpret (CPU tests): shrink blocks to the array; TPU: keep the
+    # full tile and pad — Mosaic requires sublane/lane-divisible blocks
+    bq = min(block_q, Tq) if interpret else block_q
+    bk = min(block_k, Tk) if interpret else block_k
     pad_q = (-Tq) % bq
     pad_k = (-Tk) % bk
     qf = q.reshape(B * H, Tq, D)
@@ -118,7 +126,7 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
     grid = (B * H, Tq_p // bq)
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nk=nk, tq=Tq, tk=Tk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -126,46 +134,241 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 8, Tq_p), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out[:, :Tq, :].reshape(B, H, Tq, D)
+    return (out[:, :Tq, :].reshape(B, H, Tq, D),
+            lse[:, 0, :Tq].reshape(B, H, Tq))
+
+
+def _bwd_block_terms(q_blk, k_blk, v_blk, do_blk, lse, delta, qb, kb, *,
+                     scale, causal, bq, bk, tq, tk):
+    """Shared per-(q-block, k-block) backward math: returns (p, ds)."""
+    s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    row = qb * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = jnp.logical_and(row < tq, col < tk)
+    if causal:
+        valid = jnp.logical_and(valid, col <= row + (tk - tq))
+    # minor-dim insert on the f32 BEFORE any bool op: Mosaic only
+    # relayouts 32-bit vectors when adding a lane dimension
+    lse_col = lse[:, None]
+    valid = jnp.logical_and(valid, jnp.isfinite(lse_col))
+    p = jnp.where(valid,
+                  jnp.exp(s - jnp.where(jnp.isfinite(lse_col), lse_col, 0.0)),
+                  0.0)
+    dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _fa_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, bq, bk, tq, tk):
+    """grid (BH, nk, nq): q/do stream through VMEM one block per inner
+    step; the dk/dv output block is revisited across the inner q loop
+    (index map independent of the innermost dim) and accumulated in
+    place — per-step VMEM stays O(block), any sequence length fits."""
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    k_blk = k_ref[0].astype(jnp.float32)   # (bk, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    q_blk = q_ref[0].astype(jnp.float32)   # (bq, d) — streamed
+    do_blk = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]      # (bq,)
+    delta = delta_ref[0, 0]  # (bq,)
+    p, ds = _bwd_block_terms(q_blk, k_blk, v_blk, do_blk, lse, delta, qb, kb,
+                             scale=scale, causal=causal, bq=bq, bk=bk,
+                             tq=tq, tk=tk)
+    dv_ref[0] += jax.lax.dot_general(
+        p, do_blk, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_ref[0] += jax.lax.dot_general(
+        ds, q_blk, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                  scale, causal, bq, bk, tq, tk):
+    """grid (BH, nq, nk): k/v stream; dq block revisited/accumulated."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    q_blk = q_ref[0].astype(jnp.float32)  # (bq, d)
+    do_blk = do_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0].astype(jnp.float32)  # (bk, d) — streamed
+    v_blk = v_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    _p, ds = _bwd_block_terms(q_blk, k_blk, v_blk, do_blk, lse, delta, qi, kb,
+                              scale=scale, causal=causal, bq=bq, bk=bk,
+                              tq=tq, tk=tk)
+    dq_ref[0] += jax.lax.dot_general(
+        ds, k_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def _flash_bwd_core(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
+                    interpret):
+    """Fused Pallas backward: recompute-tiled dQ/dK/dV — O(T) memory,
+    never materializes the (Tq, Tk) score matrix (SURVEY.md §2.3/§5.7:
+    the long-context training enabler)."""
+    from jax.experimental import pallas as pl
+
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(block_q, Tq) if interpret else block_q
+    bk = min(block_k, Tk) if interpret else block_k
+    pad_q = (-Tq) % bq
+    pad_k = (-Tk) % bk
+    qf = q.reshape(B * H, Tq, D)
+    kf = k.reshape(B * H, Tk, D)
+    vf = v.reshape(B * H, Tk, D)
+    dof = do.reshape(B * H, Tq, D)
+    lsef = lse.reshape(B * H, Tq)
+    deltaf = delta.reshape(B * H, Tq)
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+        dof = jnp.pad(dof, ((0, 0), (0, pad_q), (0, 0)))
+        # padded rows: -inf lse marks them fully masked in the kernels
+        lsef = jnp.pad(lsef, ((0, 0), (0, pad_q)), constant_values=-jnp.inf)
+        deltaf = jnp.pad(deltaf, ((0, 0), (0, pad_q)))
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    Tq_p, Tk_p = Tq + pad_q, Tk + pad_k
+    nq, nk = Tq_p // bq, Tk_p // bk
+    # 8-wide broadcast of the row stats (TPU sublane divisibility)
+    lsef = jnp.broadcast_to(lsef[:, None, :], (B * H, 8, Tq_p))
+    deltaf = jnp.broadcast_to(deltaf[:, None, :], (B * H, 8, Tq_p))
+
+    # grid (BH, nk, nq): innermost q-steps stream q/do blocks; the dk/dv
+    # block's index map ignores the inner dim so it stays resident in
+    # VMEM and accumulates (fp32) — per-step VMEM is O(bq·D + bk·D)
+    dkdv = functools.partial(_fa_dkdv_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk, tq=Tq, tk=Tk)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk_p, D), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tk_p, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    dqk = functools.partial(_fa_dq_kernel, scale=scale, causal=causal,
+                            bq=bq, bk=bk, tq=Tq, tk=Tk)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), jnp.float32),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (dq[:, :Tq, :].reshape(B, H, Tq, D).astype(q.dtype),
+            dk[:, :Tk, :].reshape(B, H, Tk, D).astype(k.dtype),
+            dv[:, :Tk, :].reshape(B, H, Tk, D).astype(v.dtype))
+
+
+def _use_pallas(platform, tq, tk, force_reference):
+    if force_reference:
+        return False
+    if platform == "cpu":
+        # interpreter is exact but slow — small shapes only (parity tests)
+        return tq * tk <= 256 * 256
+    return True
+
+
+# crossover for the backward: below this the XLA full-matrix backward is
+# faster (the fused bwd recomputes scores twice — its win is the O(T²)
+# memory it does NOT materialize, which only matters at long context)
+_PALLAS_BWD_MIN_SCORES = 512 * 512
+
+
+def _use_pallas_bwd(platform, tq, tk, force_reference):
+    if not _use_pallas(platform, tq, tk, force_reference):
+        return False
+    if platform == "cpu":
+        return True  # interpret-mode parity tests exercise the kernels
+    return tq * tk >= _PALLAS_BWD_MIN_SCORES
 
 
 def _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference):
+    """Returns (out, lse); lse is None on the reference path."""
     platform = jax.default_backend()
-    if force_reference:
-        return attention_reference(q, k, v, causal, scale)
-    if platform == "cpu":
-        # interpreter is exact but slow — only for kernel-parity tests
-        if q.shape[2] * k.shape[2] <= 256 * 256:
-            return _flash_core(q, k, v, causal, scale, min(block_q, 64),
-                               min(block_k, 64), True)
-        return attention_reference(q, k, v, causal, scale)
-    return _flash_core(q, k, v, causal, scale, block_q, block_k, False)
+    if _use_pallas(platform, q.shape[2], k.shape[2], force_reference):
+        interp = platform == "cpu"
+        bq = min(block_q, 64) if interp else block_q
+        bk = min(block_k, 64) if interp else block_k
+        return _flash_core(q, k, v, causal, scale, bq, bk, interp)
+    return attention_reference(q, k, v, causal, scale), None
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, force_reference):
-    return _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference)
+    out, _ = _dispatch_fwd(q, k, v, causal, scale, block_q, block_k,
+                           force_reference)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, force_reference):
-    out = _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference)
-    return out, (q, k, v)
+    out, lse = _dispatch_fwd(q, k, v, causal, scale, block_q, block_k,
+                             force_reference)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, force_reference, res, do):
-    """Exact attention backward (fp32 score recompute).
+def _flash_bwd_reference(q, k, v, do, causal, scale, delta=None):
+    """Exact XLA backward (materializes the score matrix — reference
+    path fallback; kept as the oracle for the fused kernel's tests).
 
-    dV = Pᵀ dO;  dS = P ∘ (dO Vᵀ − rowsum(dO ∘ O));  dQ = s·dS K;
-    dK = s·dSᵀ Q.  A fused Pallas backward kernel is the planned
-    upgrade; this XLA path is numerically exact and lets `jax.grad`
-    flow through the kernel today (ref trains attention via cuDNN
-    autograd — SURVEY.md §2.3).
-    """
-    q, k, v = res
+    `delta` overrides the row term rowsum(dP∘P) — the lse-cotangent
+    variant passes Δ − dlse here (same formula, one subtraction)."""
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -178,14 +381,109 @@ def _flash_bwd(causal, scale, block_q, block_k, force_reference, res, do):
     p = _safe_softmax(s)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
     dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-    dsum = jnp.sum(dp * p, axis=-1, keepdims=True)
-    ds = p * (dp - dsum)
+    if delta is None:
+        delta = jnp.sum(dp * p, axis=-1)
+    ds = p * (dp - delta[..., None])
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_bwd(causal, scale, block_q, block_k, force_reference, res, do):
+    """Fused Pallas backward (dQ/dK/dV, recompute tiling) when the
+    forward ran the kernel; XLA full-matrix backward on the reference
+    path (ref trains attention via cuDNN autograd — SURVEY.md §2.3)."""
+    q, k, v, out, lse = res
+    platform = jax.default_backend()
+    if lse is None or not _use_pallas_bwd(platform, q.shape[2], k.shape[2],
+                                          force_reference):
+        return _flash_bwd_reference(q, k, v, do, causal, scale)
+    interp = platform == "cpu"
+    # bigger bwd blocks amortize the per-grid-step overhead of the
+    # streaming kernels (measured 512 ≈ best on v5e at T≥2k)
+    bq = min(block_q, 64) if interp else max(block_q, 512)
+    bk = min(block_k, 64) if interp else max(block_k, 512)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    return _flash_bwd_core(q, k, v, do, lse, delta, causal, scale, bq, bk,
+                           interp)
+
+
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _reference_attention_lse(q, k, v, causal, scale):
+    """(out, lse) from ONE score computation — the reference-path unit
+    behind both flash_attention_with_lse and _reference_lse."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
+    l = jnp.sum(e, axis=-1)
+    lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)), -jnp.inf)
+    p = e / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return out, lse
+
+
+def _reference_lse(q, k, causal, scale):
+    B, H, Tq, D = q.shape
+    v0 = jnp.zeros((B, H, k.shape[2], 1), jnp.float32)
+    return _reference_attention_lse(q, k, v0, causal, scale)[1]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, force_reference):
+    """(out, lse) variant — the composable unit for ring attention:
+    per-block results merge exactly via their logsumexp stats."""
+    platform = jax.default_backend()
+    if _use_pallas(platform, q.shape[2], k.shape[2], force_reference):
+        interp = platform == "cpu"
+        bq = min(block_q, 64) if interp else block_q
+        bk = min(block_k, 64) if interp else block_k
+        return _flash_core(q, k, v, causal, scale, bq, bk, interp)
+    # reference path: ONE score computation yields both out and lse
+    return _reference_attention_lse(q, k, v, causal, scale)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, force_reference):
+    out, lse = _flash_lse(q, k, v, causal, scale, block_q, block_k,
+                          force_reference)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, force_reference, res, cots):
+    """d(lse)/ds = P, so the lse cotangent folds into the row term:
+    dS = P ∘ (dP − (Δ − dlse)) — one extra subtraction, same kernels."""
+    q, k, v, out, lse = res
+    do, dlse = cots
+    delta = (jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+             - dlse.astype(jnp.float32))
+    platform = jax.default_backend()
+    if _use_pallas_bwd(platform, q.shape[2], k.shape[2], force_reference):
+        interp = platform == "cpu"
+        bq = min(block_q, 64) if interp else max(block_q, 512)
+        bk = min(block_k, 64) if interp else max(block_k, 512)
+        return _flash_bwd_core(q, k, v, do, lse, delta, causal, scale, bq, bk,
+                               interp)
+    return _flash_bwd_reference(q, k, v, do, causal, scale, delta=delta)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             force_reference: bool = False):
+    """Differentiable (out, logsumexp) attention — ring building block."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_lse(q, k, v, causal, scale, block_q, block_k,
+                      force_reference)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,

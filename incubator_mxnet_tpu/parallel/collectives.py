@@ -15,16 +15,56 @@ import numpy as onp
 __all__ = ["allreduce_across_processes", "barrier", "initialize_distributed"]
 
 
+_initialized = False
+
+
+def _jax_dist_active() -> bool:
+    """Did anyone (us or user code) already call jax.distributed.initialize?"""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        try:
+            return bool(is_init())
+        except Exception:
+            pass
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None, **kwargs):
     """`jax.distributed.initialize` wrapper — replaces the dmlc tracker
-    env-var rendezvous (DMLC_PS_ROOT_URI etc., SURVEY.md §3.5)."""
+    env-var rendezvous (SURVEY.md §3.5).  Reads the `tools/launch.py`
+    worker contract (MXTPU_COORDINATOR / MXTPU_NUM_PROCESSES /
+    MXTPU_PROCESS_ID) when args are not given.  Idempotent, including
+    when user code already called jax.distributed.initialize directly."""
     import os
+    import warnings
 
-    coordinator_address = coordinator_address or os.environ.get("MXTPU_COORDINATOR")
+    global _initialized
+    if _initialized or _jax_dist_active():
+        _initialized = True
+        return
+    env = os.environ
+    coordinator_address = coordinator_address or env.get("MXTPU_COORDINATOR")
+    if num_processes is None and env.get("MXTPU_NUM_PROCESSES"):
+        num_processes = int(env["MXTPU_NUM_PROCESSES"])
+    if process_id is None and env.get("MXTPU_PROCESS_ID"):
+        process_id = int(env["MXTPU_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
         return  # single-process
-    jax.distributed.initialize(coordinator_address, num_processes, process_id, **kwargs)
+    if coordinator_address is None or num_processes is None or process_id is None:
+        warnings.warn(
+            "initialize_distributed: partial MXTPU_* worker env "
+            f"(coordinator={coordinator_address!r}, n={num_processes!r}, "
+            f"id={process_id!r}) — ignoring and running single-process")
+        return
+    jax.distributed.initialize(coordinator_address, num_processes, process_id,
+                               **kwargs)
+    _initialized = True
 
 
 def allreduce_across_processes(x: jax.Array) -> jax.Array:

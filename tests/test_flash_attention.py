@@ -22,9 +22,15 @@ def test_kernel_vs_reference(tq, tk, causal):
     q = jax.random.normal(ks[0], (2, 2, tq, 8))
     k = jax.random.normal(ks[1], (2, 2, tk, 8))
     v = jax.random.normal(ks[2], (2, 2, tk, 8))
-    a = _flash_core(q, k, v, causal, 8 ** -0.5, 4, 4, True)
+    a, lse = _flash_core(q, k, v, causal, 8 ** -0.5, 4, 4, True)
     b = attention_reference(q, k, v, causal, 8 ** -0.5)
     onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                rtol=2e-5, atol=2e-5)
+    # lse must equal the reference logsumexp of the masked scores
+    from incubator_mxnet_tpu.ops.flash_attention import _reference_lse
+
+    onp.testing.assert_allclose(onp.asarray(lse),
+                                onp.asarray(_reference_lse(q, k, causal, 8 ** -0.5)),
                                 rtol=2e-5, atol=2e-5)
 
 
@@ -45,3 +51,123 @@ def test_custom_vjp_vs_reference_grads(tq, tk, causal):
         onp.testing.assert_allclose(onp.asarray(ga), onp.asarray(gb),
                                     rtol=2e-4, atol=2e-5)
         assert onp.isfinite(onp.asarray(ga)).all()
+
+
+@pytest.mark.parametrize("tq,tk", [(8, 8), (16, 8), (8, 16), (7, 13)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_pallas_backward_vs_xla_oracle(tq, tk, causal):
+    """The fused Pallas bwd (dQ/dK/dV recompute tiling) must match the
+    full-matrix XLA backward (VERDICT r1 #6)."""
+    from incubator_mxnet_tpu.ops.flash_attention import (_flash_bwd_core,
+                                                         _flash_bwd_reference,
+                                                         _flash_core,
+                                                         _reference_lse)
+
+    ks = jax.random.split(jax.random.PRNGKey(tq * 31 + tk + causal), 4)
+    q = jax.random.normal(ks[0], (1, 2, tq, 8))
+    k = jax.random.normal(ks[1], (1, 2, tk, 8))
+    v = jax.random.normal(ks[2], (1, 2, tk, 8))
+    do = jax.random.normal(ks[3], (1, 2, tq, 8))
+    scale = 8 ** -0.5
+    out, lse = _flash_core(q, k, v, causal, scale, 4, 4, True)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _flash_bwd_core(q, k, v, do, lse, delta, causal, scale,
+                                 4, 4, True)
+    rq, rk, rv = _flash_bwd_reference(q, k, v, do, causal, scale)
+    onp.testing.assert_allclose(onp.asarray(dq), onp.asarray(rq), rtol=2e-4, atol=2e-4)
+    onp.testing.assert_allclose(onp.asarray(dk), onp.asarray(rk), rtol=2e-4, atol=2e-4)
+    onp.testing.assert_allclose(onp.asarray(dv), onp.asarray(rv), rtol=2e-4, atol=2e-4)
+
+
+def test_fused_backward_long_context_no_score_matrix():
+    """T=2048 grad parity: the fused bwd path never materializes the
+    (T, T) score matrix — peak live memory stays O(T·D)."""
+    from incubator_mxnet_tpu.ops.flash_attention import (_flash_bwd_core,
+                                                         _flash_bwd_reference,
+                                                         _flash_core)
+
+    T = 2048
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (1, 1, T, 8))
+    k = jax.random.normal(ks[1], (1, 1, T, 8))
+    v = jax.random.normal(ks[2], (1, 1, T, 8))
+    do = jax.random.normal(ks[3], (1, 1, T, 8))
+    scale = 8 ** -0.5
+    out, lse = _flash_core(q, k, v, True, scale, 256, 256, True)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _flash_bwd_core(q, k, v, do, lse, delta, True, scale,
+                                 256, 256, True)
+    rq, rk, rv = _flash_bwd_reference(q, k, v, do, True, scale)
+    # spot-check slices (full compare is fine too but this is the slow CPU
+    # interpreter; tolerances loosened for the fp32 recompute ordering)
+    onp.testing.assert_allclose(onp.asarray(dq), onp.asarray(rq), rtol=5e-3, atol=5e-4)
+    onp.testing.assert_allclose(onp.asarray(dk), onp.asarray(rk), rtol=5e-3, atol=5e-4)
+    onp.testing.assert_allclose(onp.asarray(dv), onp.asarray(rv), rtol=5e-3, atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_lse_grads_through_merge(causal):
+    """Gradients must flow correctly through the (out, lse) pair and the
+    ring merge math (lse cotangent folds into the row term)."""
+    from incubator_mxnet_tpu.ops.flash_attention import (
+        attention_reference, flash_attention_with_lse)
+
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (1, 1, 8, 8))
+    k = jax.random.normal(ks[1], (1, 1, 8, 8))
+    v = jax.random.normal(ks[2], (1, 1, 8, 8))
+
+    def loss_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=causal)
+        return (o.astype(jnp.float32) ** 2).sum() + (
+            jnp.where(jnp.isfinite(lse), lse, 0.0) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        from incubator_mxnet_tpu.ops.flash_attention import _reference_lse
+
+        o = attention_reference(q, k, v, causal=causal)
+        lse = _reference_lse(q, k, causal, 8 ** -0.5)
+        return (o.astype(jnp.float32) ** 2).sum() + (
+            jnp.where(jnp.isfinite(lse), lse, 0.0) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_impl_matches_einsum_and_oracle(causal):
+    """Flash-backed ring == einsum ring == single-device oracle, and its
+    gradients match the oracle's."""
+    import incubator_mxnet_tpu.parallel as par
+    from incubator_mxnet_tpu.parallel import ring
+
+    mesh = par.create_mesh(seq=4)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (1, 2, 16, 8))
+    k = jax.random.normal(ks[1], (1, 2, 16, 8))
+    v = jax.random.normal(ks[2], (1, 2, 16, 8))
+    flash_out = ring.ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                            impl="flash")
+    einsum_out = ring.ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                             impl="einsum")
+    oracle = attention_reference(q, k, v, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(flash_out), onp.asarray(oracle),
+                                rtol=2e-5, atol=2e-5)
+    onp.testing.assert_allclose(onp.asarray(einsum_out), onp.asarray(oracle),
+                                rtol=2e-5, atol=2e-5)
+
+    def loss_ring(q, k, v):
+        return (ring.ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                            impl="flash") ** 2).sum()
+
+    def loss_oracle(q, k, v):
+        return (attention_reference(q, k, v, causal=causal) ** 2).sum()
+
+    gf = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4)
