@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Per-op fwd/bwd timing harness (ref `benchmark/opperf/`, SURVEY.md
+§2.8): times every benchmarked op's forward and forward+backward over
+representative shapes, emitting JSON (and optionally markdown).
+
+Run: python benchmark/opperf.py [--ops tanh,dot] [--json out.json]
+     [--shape-scale small|large] [--warmup 2] [--runs 10]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _registry(scale="small"):
+    """op name -> (fn over NDArrays, input-maker)."""
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ndarray import linalg, nn_ops, ops
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    big = scale == "large"
+    V = (1024, 1024) if big else (128, 128)
+    C = (8, 64, 56, 56) if big else (2, 8, 16, 16)
+    key = jax.random.PRNGKey(0)
+
+    def rnd(shape, k=0):
+        return NDArray(jax.random.normal(jax.random.fold_in(key, k), shape))
+
+    reg = {}
+
+    def add(name, fn, maker):
+        reg[name] = (fn, maker)
+
+    for name in ("tanh", "sigmoid", "exp", "log", "sqrt", "relu", "erf",
+                 "square", "abs"):
+        fn = getattr(ops, name)
+        dom = (0.1, 2.0) if name in ("log", "sqrt") else None
+
+        def mk(name=name, dom=dom):
+            x = rnd(V)
+            if dom:
+                x = NDArray(jnp.abs(x._data) + dom[0])
+            return (x,)
+
+        add(name, fn, mk)
+    for name in ("add", "multiply", "maximum", "power"):
+        def mk2(name=name):
+            return (NDArray(jnp.abs(rnd(V, 1)._data) + 0.1), rnd(V, 2))
+
+        add(name, getattr(ops, name), mk2)
+    add("dot", ops.dot, lambda: (rnd(V, 3), rnd(V, 4)))
+    add("sum", lambda x: ops.sum(x), lambda: (rnd(V, 5),))
+    add("softmax", nn_ops.softmax, lambda: (rnd(V, 6),))
+    add("log_softmax", nn_ops.log_softmax, lambda: (rnd(V, 7),))
+    add("LayerNorm",
+        lambda x, g, b: nn_ops.LayerNorm(x, g, b),
+        lambda: (rnd(V, 8), NDArray(jnp.ones(V[1])), NDArray(jnp.zeros(V[1]))))
+    add("FullyConnected",
+        lambda x, w: nn_ops.FullyConnected(x, w, num_hidden=V[1], no_bias=True),
+        lambda: (rnd(V, 9), rnd(V, 10)))
+    add("Convolution",
+        lambda x, w: nn_ops.Convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                        num_filter=C[1], no_bias=True),
+        lambda: (rnd(C, 11), rnd((C[1], C[1], 3, 3), 12)))
+    add("Pooling",
+        lambda x: nn_ops.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="max"),
+        lambda: (rnd(C, 13),))
+    add("transpose", ops.transpose, lambda: (rnd(V, 14),))
+    add("concat", lambda a, b: ops.concat(a, b, dim=1),
+        lambda: (rnd(V, 15), rnd(V, 16)))
+    add("take", lambda x, i: ops.take(x, i),
+        lambda: (rnd(V, 17),
+                 NDArray(jnp.arange(0, V[0], 2, dtype=jnp.int32))))
+    add("gemm2", linalg.gemm2, lambda: (rnd(V, 18), rnd(V, 19)))
+    add("flash_attention",
+        lambda q, k, v: __import__(
+            "incubator_mxnet_tpu.ops.flash_attention",
+            fromlist=["flash_attention"]).flash_attention(q, k, v),
+        lambda: tuple(rnd((2, 4, 64, 32), 20 + i) for i in range(3)))
+    return reg
+
+
+def _time_op(fn, args, warmup, runs, backward=False):
+    import jax
+
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    def fwd():
+        out = fn(*args)
+        return out[0] if isinstance(out, tuple) else out
+
+    def fwd_bwd():
+        for a in args:
+            if isinstance(a, NDArray) and str(a.dtype).startswith("float"):
+                a.attach_grad()
+        with autograd.record():
+            out = fn(*args)
+            o = out[0] if isinstance(out, tuple) else out
+            s = o.sum()
+        s.backward()
+        return s
+
+    run = fwd_bwd if backward else fwd
+    for _ in range(max(1, warmup)):  # at least one compile pass
+        r = run()
+    float(r.asnumpy().ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        r = run()
+    float(r.asnumpy().ravel()[0])
+    return (time.perf_counter() - t0) / runs * 1e3
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="op performance harness")
+    p.add_argument("--ops", type=str, default="",
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--json", type=str, default=None)
+    p.add_argument("--markdown", type=str, default=None)
+    p.add_argument("--shape-scale", type=str, default="small",
+                   choices=["small", "large"])
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--runs", type=int, default=10)
+    p.add_argument("--no-backward", action="store_true")
+    args = p.parse_args(argv)
+
+    reg = _registry(args.shape_scale)
+    names = [s for s in args.ops.split(",") if s] or sorted(reg)
+    results = []
+    for name in names:
+        if name not in reg:
+            print(f"opperf: unknown op {name!r}", file=sys.stderr)
+            continue
+        fn, maker = reg[name]
+        row = {"op": name,
+               "fwd_ms": round(_time_op(fn, maker(), args.warmup, args.runs), 4)}
+        if not args.no_backward:
+            try:
+                row["fwd_bwd_ms"] = round(
+                    _time_op(fn, maker(), args.warmup, args.runs,
+                             backward=True), 4)
+            except Exception as e:
+                row["fwd_bwd_ms"] = None
+                row["bwd_error"] = str(e)[:80]
+        results.append(row)
+        print(json.dumps(row))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write("| op | fwd (ms) | fwd+bwd (ms) |\n|---|---|---|\n")
+            for r in results:
+                f.write(f"| {r['op']} | {r['fwd_ms']} | "
+                        f"{r.get('fwd_bwd_ms', '-')} |\n")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ from . import io
 from . import recordio
 from . import image
 from . import profiler
+from . import onnx
 from . import amp
 from . import parallel
 from . import ops
@@ -47,7 +48,8 @@ from . import symbol as sym
 from . import callback
 from . import test_utils
 from . import util
-from .util import np, npx  # numpy-compat namespaces
+from . import numpy as np  # NumPy-semantics array API (mx.np)
+from . import numpy_extension as npx  # DL extensions (mx.npx)
 
 mod = None  # legacy Module API lives in .module
 from . import module  # noqa: E402

@@ -79,11 +79,24 @@ class KVStore:
         vals = value if isinstance(value, (list, tuple)) else [value]
         summed = _sum_values([wrap(v) for v in vals])
         if self._is_dist and jax.process_count() > 1:
-            # cross-host reduction over the DCN data axis
             from ..parallel import collectives
 
-            summed = collectives.allreduce_across_processes(summed)
-        if self._compression is not None:
+            if self._compression is not None:
+                # compress BEFORE the wire (ref kvstore_dist push): each
+                # process bit-packs its quantized grad (16 values/int32),
+                # the gather moves 1/16 the fp32 bytes, decompressed
+                # shards sum locally (server-side aggregation parity)
+                from jax.experimental import multihost_utils
+
+                packed = self._compression.compress_packed(key, summed)
+                gathered = multihost_utils.process_allgather(packed)
+                summed = sum(
+                    self._compression.decompress(gathered[p], summed.shape)
+                    for p in range(gathered.shape[0]))
+            else:
+                # cross-host reduction over the DCN data axis
+                summed = collectives.allreduce_across_processes(summed)
+        elif self._compression is not None:
             summed = self._compression.compress(key, summed)
         if self._updater is not None:
             # server-side-optimizer parity: run updater, store weights

@@ -63,7 +63,10 @@ class MultiHeadAttention(HybridBlock):
                 p = jax.nn.softmax(s, axis=-1)
                 out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(qkv_raw.dtype)
             else:
-                out = flash_attention(q, k, v, causal=False)
+                # use_flash=False forces the XLA reference (also the
+                # exportable path — pallas_call has no ONNX mapping)
+                out = flash_attention(q, k, v, causal=False,
+                                      force_reference=not self._use_flash)
             return out.transpose(0, 2, 1, 3).reshape(B, T, C)
 
         from ..ndarray.ndarray import apply_op

@@ -524,7 +524,7 @@ def _ax(axis):
 # ---------------------------------------------------------------------- #
 # the universal op-application / autograd-recording hook
 # ---------------------------------------------------------------------- #
-def apply_op(fn: Callable, *args, n_out: int = 1, **kwargs):
+def apply_op(fn: Callable, *args, n_out: int = 1, out_cls=None, **kwargs):
     """Execute ``fn`` over unwrapped args; record a vjp node when taping.
 
     Equivalent of ``Imperative::Invoke`` (+ ``RecordOp`` when
@@ -535,12 +535,17 @@ def apply_op(fn: Callable, *args, n_out: int = 1, **kwargs):
     nd_args = [a for a in args if isinstance(a, NDArray)]
     recording = _tape.is_recording() and any(a._in_graph for a in nd_args)
     raw_args = [raw(a) for a in args]
+    # outputs default to the class of the first NDArray input so the
+    # mx.np `ndarray` subtype propagates through every op (n.b. tape
+    # nodes must reference the SAME objects we return)
+    if out_cls is None:
+        out_cls = type(nd_args[0]) if nd_args else NDArray
 
     if not recording:
         out = fn(*raw_args, **kwargs)
         if n_out == 1 and not isinstance(out, (tuple, list)):
-            return NDArray(out)
-        return tuple(NDArray(o) for o in out)
+            return out_cls(out)
+        return tuple(out_cls(o) for o in out)
 
     positions = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     diff_pos = [i for i in positions if _differentiable(args[i])]
@@ -555,15 +560,15 @@ def apply_op(fn: Callable, *args, n_out: int = 1, **kwargs):
     if not diff_pos:
         out = fn(*raw_args, **kwargs)
         if n_out == 1 and not isinstance(out, (tuple, list)):
-            return NDArray(out)
-        return tuple(NDArray(o) for o in out)
+            return out_cls(out)
+        return tuple(out_cls(o) for o in out)
 
     out_raw, vjp_fn = jax.vjp(f, *primals)
     multi = isinstance(out_raw, (tuple, list))
     outs_raw = list(out_raw) if multi else [out_raw]
     outs = []
     for o in outs_raw:
-        nd = NDArray(o)
+        nd = out_cls(o)
         nd._in_graph = True
         outs.append(nd)
     node = _tape.TapeNode(

@@ -1,0 +1,280 @@
+"""`mx.np` — the NumPy-semantics array API (VERDICT r1 #8).
+
+Re-design of `python/mxnet/numpy/` (~30k LoC of np_* kernels +
+bindings, SURVEY.md §2.3/§2.6 [UNVERIFIED]): on TPU the semantics come
+from `jax.numpy` directly, so this package provides what jnp cannot —
+a distinct `ndarray` TYPE that flows through the framework's autograd
+tape (every op routes via `apply_op`, so `attach_grad`/`record`/
+`backward` work on np arrays exactly like on `mx.nd`), NumPy-style
+repr/creation APIs, and `np.random` / `np.linalg` sub-namespaces.
+
+The dynamic `__getattr__` fall-through covers the long tail of jnp
+functions; everything returns `mx.np.ndarray` (apply_op propagates the
+subtype of the first array input).
+"""
+from __future__ import annotations
+
+import sys
+import types
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray, apply_op, raw, wrap as _nd_wrap
+
+__all__ = ["ndarray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "eye", "zeros_like", "ones_like", "full_like",
+           "asarray", "from_nd", "random", "linalg"]
+
+
+class ndarray(NDArray):
+    """NumPy-semantics array: jnp behavior + framework autograd."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        try:
+            return repr(self.asnumpy()).replace("array(", "array(", 1)
+        except Exception:
+            return f"<np.ndarray {self.shape} {self.dtype} (traced/lazy)>"
+
+    def as_nd_ndarray(self):
+        """Convert to the classic mx.nd handle (shares the buffer)."""
+        out = NDArray.__new__(NDArray)
+        out._raw = self._raw
+        out._lazy = self._lazy
+        out._grad = self._grad
+        out._grad_req = self._grad_req
+        out._in_graph = self._in_graph
+        out._ctx = self._ctx
+        return out
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # numpy-style aliases over the inherited surface
+    def all(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.all(x, axis=axis, keepdims=keepdims), self)
+
+    def any(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.any(x, axis=axis, keepdims=keepdims), self)
+
+    # NumPy semantics: comparisons yield BOOL arrays (the classic mx.nd
+    # surface returns float masks — the reference's legacy behavior)
+    def __gt__(self, other):
+        return apply_op(lambda a, b: a > b, self, _nd_wrap(other))
+
+    def __ge__(self, other):
+        return apply_op(lambda a, b: a >= b, self, _nd_wrap(other))
+
+    def __lt__(self, other):
+        return apply_op(lambda a, b: a < b, self, _nd_wrap(other))
+
+    def __le__(self, other):
+        return apply_op(lambda a, b: a <= b, self, _nd_wrap(other))
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return apply_op(lambda a, b: a == b, self, _nd_wrap(other))
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return apply_op(lambda a, b: a != b, self, _nd_wrap(other))
+
+    __hash__ = None
+
+
+def from_nd(a: NDArray) -> ndarray:
+    """mx.nd.NDArray → mx.np.ndarray (shares the buffer + grad state)."""
+    out = ndarray.__new__(ndarray)
+    out._raw = a._raw
+    out._lazy = a._lazy
+    out._grad = a._grad
+    out._grad_req = a._grad_req
+    out._in_graph = a._in_graph
+    out._ctx = a._ctx
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# creation
+# ---------------------------------------------------------------------- #
+def array(obj, dtype=None, ctx=None) -> ndarray:
+    if isinstance(obj, NDArray):
+        obj = obj._data
+    return ndarray(jnp.asarray(obj, dtype=jnp.dtype(dtype) if dtype else None),
+                   ctx=ctx)
+
+
+asarray = array
+
+
+def zeros(shape, dtype="float32", ctx=None) -> ndarray:
+    return ndarray(jnp.zeros(shape, jnp.dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, dtype="float32", ctx=None) -> ndarray:
+    return ndarray(jnp.ones(shape, jnp.dtype(dtype)), ctx=ctx)
+
+
+def full(shape, fill_value, dtype="float32", ctx=None) -> ndarray:
+    return ndarray(jnp.full(shape, fill_value, jnp.dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, dtype="float32", ctx=None) -> ndarray:
+    return zeros(shape, dtype, ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None) -> ndarray:
+    return ndarray(jnp.arange(start, stop, step,
+                              jnp.dtype(dtype) if dtype else None), ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None) -> ndarray:
+    return ndarray(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=jnp.dtype(dtype) if dtype else None), ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None) -> ndarray:
+    return ndarray(jnp.eye(N, M, k, jnp.dtype(dtype)), ctx=ctx)
+
+
+def zeros_like(a, dtype=None) -> ndarray:
+    return ndarray(jnp.zeros_like(raw(_nd_wrap(a)), dtype=dtype))
+
+
+def ones_like(a, dtype=None) -> ndarray:
+    return ndarray(jnp.ones_like(raw(_nd_wrap(a)), dtype=dtype))
+
+
+def full_like(a, fill_value, dtype=None) -> ndarray:
+    return ndarray(jnp.full_like(raw(_nd_wrap(a)), fill_value, dtype=dtype))
+
+
+# ---------------------------------------------------------------------- #
+# function fall-through (autograd-recording)
+# ---------------------------------------------------------------------- #
+def _wrap_fn(jfn, name):
+    def op(*args, **kwargs):
+        # NDArrays may hide inside lists/tuples (np.concatenate([a, b]))
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda v: isinstance(v, NDArray))
+        nd_idx = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+        if not nd_idx:
+            out = jfn(*args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(ndarray(o) if hasattr(o, "shape") else o for o in out)
+            return ndarray(out) if hasattr(out, "shape") else out
+
+        def f(*xs):
+            ls = list(leaves)
+            for i, x in zip(nd_idx, xs):
+                ls[i] = x
+            a2, kw2 = jax.tree_util.tree_unflatten(treedef, ls)
+            return jfn(*a2, **kw2)
+
+        return apply_op(f, *[leaves[i] for i in nd_idx], out_cls=ndarray)
+
+    op.__name__ = name
+    return op
+
+
+class _Module(types.ModuleType):
+    def __init__(self, name, source):
+        super().__init__(name)
+        self._source = source
+
+    def __getattr__(self, name):
+        target = getattr(self._source, name, None)
+        if target is None:
+            raise AttributeError(f"{self.__name__} has no attribute {name!r}")
+        if callable(target) and not isinstance(target, type):
+            fn = _wrap_fn(target, name)
+            setattr(self, name, fn)
+            return fn
+        return target
+
+
+linalg = _Module("incubator_mxnet_tpu.np.linalg", jnp.linalg)
+
+
+class _RandomModule(types.ModuleType):
+    """np.random over the framework's global key stream."""
+
+    def __init__(self):
+        super().__init__("incubator_mxnet_tpu.np.random")
+
+    @staticmethod
+    def _key():
+        from .. import random as _random
+
+        return _random.next_key()
+
+    def seed(self, s):
+        from .. import random as _random
+
+        _random.seed(int(s))
+
+    def uniform(self, low=0.0, high=1.0, size=()):
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return ndarray(jax.random.uniform(self._key(), size, minval=low,
+                                          maxval=high))
+
+    def normal(self, loc=0.0, scale=1.0, size=()):
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return ndarray(loc + scale * jax.random.normal(self._key(), size))
+
+    def randint(self, low, high=None, size=()):
+        if high is None:
+            low, high = 0, low
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return ndarray(jax.random.randint(self._key(), size, low, high,
+                                          dtype=jnp.int32))
+
+    def rand(self, *shape):
+        return self.uniform(size=shape)
+
+    def randn(self, *shape):
+        return self.normal(size=shape)
+
+    def choice(self, a, size=(), replace=True, p=None):
+        size = (size,) if isinstance(size, int) else tuple(size)
+        arr = raw(_nd_wrap(a)) if not isinstance(a, int) else jnp.arange(a)
+        pr = raw(_nd_wrap(p)) if p is not None else None
+        return ndarray(jax.random.choice(self._key(), arr, size,
+                                         replace=replace, p=pr))
+
+    def shuffle(self, a):
+        perm = jax.random.permutation(self._key(), a.shape[0])
+        a._data = raw(a)[perm]
+
+
+random = _RandomModule()
+
+_pi = onp.pi
+_e = onp.e
+_inf = onp.inf
+_nan = onp.nan
+
+
+def __getattr__(name):
+    if name == "pi":
+        return _pi
+    if name == "e":
+        return _e
+    if name == "inf":
+        return _inf
+    if name == "nan":
+        return _nan
+    target = getattr(jnp, name, None)
+    if target is None:
+        raise AttributeError(f"mx.np has no attribute {name!r}")
+    if isinstance(target, type) or not callable(target):
+        return target
+    fn = _wrap_fn(target, name)
+    globals()[name] = fn
+    return fn

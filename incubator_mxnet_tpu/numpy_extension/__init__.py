@@ -1,0 +1,88 @@
+"""`mx.npx` — NumPy-extension operators (ref `python/mxnet/numpy_extension/`
++ `mx.npx` surface, SURVEY.md §2.6 [UNVERIFIED]).
+
+The deep-learning ops that plain NumPy lacks, expressed over the same
+`mx.np.ndarray` type: activations, norm layers, conv/pool wrappers,
+sequence ops, plus the `set_np`/`is_np_array` mode switches the
+reference uses to flip Gluon into numpy mode.
+"""
+from __future__ import annotations
+
+from ..ndarray import nn_ops as _nn
+from ..ndarray import ops as _ops
+from ..numpy import from_nd, ndarray
+
+_np_active = False
+
+
+def set_np(shape=True, array=True, dtype=False):
+    global _np_active
+    _np_active = True
+
+
+def reset_np():
+    global _np_active
+    _np_active = False
+
+
+def is_np_array():
+    return _np_active
+
+
+def is_np_shape():
+    return _np_active
+
+
+def _reexport(fn):
+    def op(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if isinstance(out, tuple):
+            return tuple(from_nd(o) if hasattr(o, "_raw") else o for o in out)
+        return from_nd(out) if hasattr(out, "_raw") else out
+
+    op.__name__ = fn.__name__
+    return op
+
+
+# the npx op surface (GluonNLP/CV-era names)
+relu = _reexport(_ops.relu)
+sigmoid = _reexport(_ops.sigmoid)
+softmax = _reexport(_nn.softmax)
+log_softmax = _reexport(_nn.log_softmax)
+masked_softmax = _reexport(_nn.masked_softmax)
+masked_log_softmax = _reexport(_nn.masked_log_softmax)
+activation = _reexport(_nn.Activation)
+leaky_relu = _reexport(_nn.LeakyReLU)
+gelu = _reexport(_nn.gelu)
+batch_norm = _reexport(_nn.BatchNorm)
+layer_norm = _reexport(_nn.LayerNorm)
+group_norm = _reexport(_nn.GroupNorm)
+instance_norm = _reexport(_nn.InstanceNorm)
+l2_normalization = _reexport(_nn.L2Normalization)
+convolution = _reexport(_nn.Convolution)
+deconvolution = _reexport(_nn.Deconvolution)
+pooling = _reexport(_nn.Pooling)
+fully_connected = _reexport(_nn.FullyConnected)
+dropout = _reexport(_nn.Dropout)
+embedding = _reexport(_ops.embedding)
+one_hot = _reexport(_ops.one_hot)
+pick = _reexport(_ops.pick)
+topk = _reexport(_ops.topk)
+gather_nd = _reexport(_ops.gather_nd)
+scatter_nd = _reexport(_ops.scatter_nd)
+sequence_mask = _reexport(_ops.sequence_mask)
+reshape_like = _reexport(_ops.reshape_like) if hasattr(_ops, "reshape_like") else None
+slice_axis = _reexport(_ops.slice_axis)
+smooth_l1 = _reexport(_nn.smooth_l1)
+
+
+def __getattr__(name):
+    """Long tail: fall through to the nd op namespace, rewrapping."""
+    from .. import ndarray as _nd
+
+    target = getattr(_nd, name, None)
+    if target is None or not callable(target):
+        raise AttributeError(f"mx.npx has no attribute {name!r}")
+    fn = _reexport(target)
+    globals()[name] = fn
+    return fn

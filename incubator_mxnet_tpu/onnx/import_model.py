@@ -1,0 +1,200 @@
+"""ONNX import — rebuilds a runnable model from an .onnx file.
+
+Counterpart of `python/mxnet/onnx` import (SURVEY.md §2.6): decodes the
+protobuf (serde.py) and interprets the node list over jax.numpy.
+Returns an `ONNXModel` (callable, SymbolBlock-flavored) plus the
+(arg_params, aux_params) dicts for reference-API parity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .serde import decode_model
+
+__all__ = ["import_model", "ONNXModel"]
+
+_ONNX2NP = {1: "float32", 6: "int32", 7: "int64", 9: "bool"}
+
+
+def _run_node(node, env):
+    op = node.op_type
+    a = node.attrs
+    x = [env[i] for i in node.inputs if i]
+
+    def out(v):
+        env[node.outputs[0]] = v
+
+    if op == "Identity":
+        out(x[0])
+    elif op in ("Add", "Sub", "Mul", "Div", "Pow", "Mod"):
+        fn = {"Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+              "Div": jnp.divide, "Pow": jnp.power, "Mod": jnp.mod}[op]
+        out(fn(x[0], x[1]))
+    elif op in ("Max", "Min"):
+        fn = jnp.maximum if op == "Max" else jnp.minimum
+        r = x[0]
+        for other in x[1:]:
+            r = fn(r, other)
+        out(r)
+    elif op in ("Neg", "Exp", "Log", "Tanh", "Sqrt", "Abs", "Sign", "Floor",
+                "Ceil", "Erf", "Sigmoid", "Sin", "Cos", "Reciprocal", "Not"):
+        fn = {"Neg": jnp.negative, "Exp": jnp.exp, "Log": jnp.log,
+              "Tanh": jnp.tanh, "Sqrt": jnp.sqrt, "Abs": jnp.abs,
+              "Sign": jnp.sign, "Floor": jnp.floor, "Ceil": jnp.ceil,
+              "Erf": jax.scipy.special.erf, "Sigmoid": jax.nn.sigmoid,
+              "Sin": jnp.sin, "Cos": jnp.cos,
+              "Reciprocal": jnp.reciprocal,
+              "Not": jnp.logical_not}[op]
+        out(fn(x[0]))
+    elif op == "Relu":
+        out(jax.nn.relu(x[0]))
+    elif op == "Softmax":
+        out(jax.nn.softmax(x[0], axis=a.get("axis", -1)))
+    elif op in ("Less", "LessOrEqual", "Greater", "GreaterOrEqual", "Equal"):
+        fn = {"Less": jnp.less, "LessOrEqual": jnp.less_equal,
+              "Greater": jnp.greater, "GreaterOrEqual": jnp.greater_equal,
+              "Equal": jnp.equal}[op]
+        out(fn(x[0], x[1]))
+    elif op == "Where":
+        out(jnp.where(x[0].astype(bool), x[1], x[2]))
+    elif op in ("And", "Or"):
+        fn = jnp.logical_and if op == "And" else jnp.logical_or
+        out(fn(x[0].astype(bool), x[1].astype(bool)))
+    elif op == "Cast":
+        out(x[0].astype(jnp.dtype(_ONNX2NP.get(a["to"], "float32"))))
+    elif op == "Reshape":
+        out(jnp.reshape(x[0], [int(d) for d in onp.asarray(x[1])]))
+    elif op == "Transpose":
+        out(jnp.transpose(x[0], a.get("perm")))
+    elif op == "Expand":
+        out(jnp.broadcast_to(x[0], tuple(int(d) for d in onp.asarray(x[1]))))
+    elif op == "Squeeze":
+        axes = tuple(int(d) for d in onp.asarray(x[1])) if len(x) > 1 \
+            else tuple(a.get("axes", ()))
+        out(jnp.squeeze(x[0], axis=axes or None))
+    elif op == "Concat":
+        out(jnp.concatenate(x, axis=a["axis"]))
+    elif op == "Slice":
+        starts = onp.asarray(x[1]).tolist()
+        ends = onp.asarray(x[2]).tolist()
+        axes = onp.asarray(x[3]).tolist() if len(x) > 3 else list(range(len(starts)))
+        steps = onp.asarray(x[4]).tolist() if len(x) > 4 else [1] * len(starts)
+        idx = [slice(None)] * x[0].ndim
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            idx[ax] = slice(int(s), int(e), int(st))
+        out(x[0][tuple(idx)])
+    elif op == "ReduceSum":
+        axes = tuple(int(d) for d in onp.asarray(x[1])) if len(x) > 1 else None
+        out(jnp.sum(x[0], axis=axes, keepdims=bool(a.get("keepdims", 1))))
+    elif op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+        fn = {"ReduceMax": jnp.max, "ReduceMin": jnp.min,
+              "ReduceProd": jnp.prod, "ReduceMean": jnp.mean}[op]
+        axes = tuple(a.get("axes", ())) or None
+        out(fn(x[0], axis=axes, keepdims=bool(a.get("keepdims", 1))))
+    elif op in ("ArgMax", "ArgMin"):
+        fn = jnp.argmax if op == "ArgMax" else jnp.argmin
+        r = fn(x[0], axis=a.get("axis", 0))
+        if a.get("keepdims", 1):
+            r = jnp.expand_dims(r, a.get("axis", 0))
+        out(r)
+    elif op == "Einsum":
+        out(jnp.einsum(a["equation"], *x))
+    elif op == "MatMul":
+        out(jnp.matmul(x[0], x[1]))
+    elif op == "Gemm":
+        r = jnp.matmul(x[0].T if a.get("transA") else x[0],
+                       x[1].T if a.get("transB") else x[1])
+        r = r * a.get("alpha", 1.0)
+        if len(x) > 2:
+            r = r + a.get("beta", 1.0) * x[2]
+        out(r)
+    elif op == "Conv":
+        pads = a.get("pads", [0] * (2 * (x[0].ndim - 2)))
+        n = len(pads) // 2
+        padding = list(zip(pads[:n], pads[n:]))
+        out(jax.lax.conv_general_dilated(
+            x[0], x[1], window_strides=a.get("strides", [1] * n),
+            padding=padding, rhs_dilation=a.get("dilations", [1] * n),
+            feature_group_count=a.get("group", 1)))
+    elif op == "Gather":
+        out(jnp.take(x[0], x[1].astype(jnp.int32), axis=a.get("axis", 0)))
+    elif op in ("MaxPool", "AveragePool"):
+        k = a["kernel_shape"]
+        n = len(k)
+        pads = a.get("pads", [0] * (2 * n))
+        padding = list(zip(pads[:n], pads[n:]))
+        dims = (1, 1) + tuple(k)
+        strides = (1, 1) + tuple(a.get("strides", [1] * n))
+        pad4 = [(0, 0), (0, 0)] + padding
+        if op == "MaxPool":
+            out(jax.lax.reduce_window(x[0], -jnp.inf, jax.lax.max, dims,
+                                      strides, pad4))
+        else:
+            s = jax.lax.reduce_window(x[0], 0.0, jax.lax.add, dims,
+                                      strides, pad4)
+            size = 1
+            for kk in k:
+                size *= kk
+            out(s / size)
+    elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+        axes = tuple(range(2, x[0].ndim))
+        fn = jnp.max if op == "GlobalMaxPool" else jnp.mean
+        out(fn(x[0], axis=axes, keepdims=True))
+    elif op == "Split":
+        sizes = onp.asarray(x[1]).tolist() if len(x) > 1 else None
+        pieces = jnp.split(x[0], onp.cumsum(sizes)[:-1].tolist(),
+                           axis=a.get("axis", 0))
+        for name, piece in zip(node.outputs, pieces):
+            env[name] = piece
+        return
+    else:
+        raise NotImplementedError(f"ONNX import: unsupported op {op!r}")
+
+
+class ONNXModel:
+    """Callable inference model decoded from an .onnx file."""
+
+    def __init__(self, model):
+        self.model = model
+        self.graph = model.graph
+        self.input_names = [n for n, _s, _d in self.graph.inputs]
+        self.output_names = [n for n, _s, _d in self.graph.outputs]
+        self._params = {k: jnp.asarray(v)
+                        for k, v in self.graph.initializers.items()}
+        self._jit = jax.jit(self._run)
+
+    def _run(self, *inputs):
+        env = dict(self._params)
+        for name, x in zip(self.input_names, inputs):
+            env[name] = x
+        for node in self.graph.nodes:
+            _run_node(node, env)
+        outs = [env[n] for n in self.output_names]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def __call__(self, *inputs):
+        from ..ndarray.ndarray import NDArray, raw
+
+        raws = [raw(x) if isinstance(x, NDArray) else jnp.asarray(x)
+                for x in inputs]
+        out = self._jit(*raws)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o) for o in out)
+        return NDArray(out)
+
+
+def import_model(path: str):
+    """Returns (model, arg_params, aux_params) — reference API shape;
+    `model` is a callable ONNXModel."""
+    from ..ndarray.ndarray import NDArray
+
+    with open(path, "rb") as f:
+        model = decode_model(f.read())
+    m = ONNXModel(model)
+    arg_params = {k: NDArray(jnp.asarray(v))
+                  for k, v in model.graph.initializers.items()}
+    return m, arg_params, {}

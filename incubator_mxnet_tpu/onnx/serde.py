@@ -1,0 +1,359 @@
+"""Minimal ONNX protobuf (de)serializer — no `onnx` package needed.
+
+Implements the protobuf wire format by hand for the ModelProto subset
+the exporter emits (SURVEY.md §2.6 "ONNX", ref `python/mxnet/onnx/`
+[UNVERIFIED]).  Field numbers follow the public onnx.proto3 schema
+(stable across ONNX releases):
+
+  ModelProto:    ir_version=1, producer_name=2, graph=7, opset_import=8
+  OperatorSetId: domain=1, version=2
+  GraphProto:    node=1, name=2, initializer=5, input=11, output=12
+  NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5
+  AttributeProto:name=1, f=2, i=3, s=4, floats=6, ints=7, type=20
+  TensorProto:   dims=1, data_type=2, name=8, raw_data=9
+  ValueInfoProto:name=1, type=2 / TypeProto.tensor_type=1 /
+  Tensor.elem_type=1, shape=2 / TensorShapeProto.dim=1 / Dim.dim_value=1
+
+Tensors are serialized via raw_data (little-endian), the layout every
+ONNX runtime accepts.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+# ONNX TensorProto.DataType
+FLOAT = 1
+INT64 = 7
+INT32 = 6
+
+# AttributeProto.AttributeType
+ATTR_FLOAT = 1
+ATTR_INT = 2
+ATTR_STRING = 3
+ATTR_FLOATS = 6
+ATTR_INTS = 7
+
+_NP_TO_ONNX = {"float32": FLOAT, "int64": INT64, "int32": INT32}
+_ONNX_TO_NP = {FLOAT: "float32", INT64: "int64", INT32: "int32"}
+
+
+# ---------------------------------------------------------------------- #
+# wire-format primitives
+# ---------------------------------------------------------------------- #
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_delim(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _str_field(field: int, value: str) -> bytes:
+    return _len_delim(field, value.encode())
+
+
+def _float_field(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def eof(self):
+        return self.pos >= len(self.buf)
+
+    def varint(self) -> int:
+        shift = n = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                # protobuf int64 semantics: sign-extend two's complement
+                # (axis=-1 etc. must not decode as 2^64-1)
+                if n >= 1 << 63:
+                    n -= 1 << 64
+                return n
+            shift += 7
+
+    def field(self):
+        tag = self.varint()
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:
+            return field, self.varint()
+        if wire == 2:
+            ln = self.varint()
+            payload = self.buf[self.pos:self.pos + ln]
+            self.pos += ln
+            return field, payload
+        if wire == 5:
+            v = struct.unpack("<f", self.buf[self.pos:self.pos + 4])[0]
+            self.pos += 4
+            return field, v
+        if wire == 1:
+            v = struct.unpack("<d", self.buf[self.pos:self.pos + 8])[0]
+            self.pos += 8
+            return field, v
+        raise ValueError(f"unsupported wire type {wire}")
+
+
+# ---------------------------------------------------------------------- #
+# model objects (plain python)
+# ---------------------------------------------------------------------- #
+class Node:
+    def __init__(self, op_type: str, inputs: List[str], outputs: List[str],
+                 name: str = "", attrs: Optional[dict] = None):
+        self.op_type = op_type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.name = name or (outputs[0] + "_node")
+        self.attrs = attrs or {}
+
+
+class Graph:
+    def __init__(self, name: str = "graph"):
+        self.name = name
+        self.nodes: List[Node] = []
+        self.inputs: List[tuple] = []    # (name, shape, onnx_dtype)
+        self.outputs: List[tuple] = []
+        self.initializers: Dict[str, onp.ndarray] = {}
+
+
+class Model:
+    def __init__(self, graph: Graph, opset: int = 17, producer="incubator_mxnet_tpu"):
+        self.graph = graph
+        self.opset = opset
+        self.producer = producer
+
+
+# ---------------------------------------------------------------------- #
+# encoding
+# ---------------------------------------------------------------------- #
+def _encode_tensor(name: str, arr: onp.ndarray) -> bytes:
+    arr = onp.ascontiguousarray(arr)
+    dt = _NP_TO_ONNX.get(str(arr.dtype))
+    if dt is None:
+        arr = arr.astype("float32")
+        dt = FLOAT
+    out = b""
+    for d in arr.shape:
+        out += _int_field(1, int(d))
+    out += _int_field(2, dt)
+    out += _str_field(8, name)
+    out += _len_delim(9, arr.tobytes())
+    return out
+
+
+def _encode_value_info(name: str, shape, dtype: int) -> bytes:
+    dims = b"".join(_len_delim(1, _int_field(1, int(d))) for d in shape)
+    tensor_type = _int_field(1, dtype) + _len_delim(2, dims)
+    type_proto = _len_delim(1, tensor_type)
+    return _str_field(1, name) + _len_delim(2, type_proto)
+
+
+def _encode_attr(name: str, value) -> bytes:
+    out = _str_field(1, name)
+    if isinstance(value, bool):
+        out += _int_field(3, int(value)) + _int_field(20, ATTR_INT)
+    elif isinstance(value, int):
+        out += _int_field(3, value) + _int_field(20, ATTR_INT)
+    elif isinstance(value, float):
+        out += _float_field(2, value) + _int_field(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        out += _len_delim(4, value.encode()) + _int_field(20, ATTR_STRING)
+    elif isinstance(value, (list, tuple)) and value and isinstance(value[0], float):
+        for v in value:
+            out += _float_field(6, float(v))
+        out += _int_field(20, ATTR_FLOATS)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += _int_field(7, int(v))
+        out += _int_field(20, ATTR_INTS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def _encode_node(n: Node) -> bytes:
+    out = b""
+    for i in n.inputs:
+        out += _str_field(1, i)
+    for o in n.outputs:
+        out += _str_field(2, o)
+    out += _str_field(3, n.name)
+    out += _str_field(4, n.op_type)
+    for k, v in n.attrs.items():
+        out += _len_delim(5, _encode_attr(k, v))
+    return out
+
+
+def encode_model(model: Model) -> bytes:
+    g = model.graph
+    gb = b""
+    for n in g.nodes:
+        gb += _len_delim(1, _encode_node(n))
+    gb += _str_field(2, g.name)
+    for name, arr in g.initializers.items():
+        gb += _len_delim(5, _encode_tensor(name, arr))
+    for name, shape, dt in g.inputs:
+        gb += _len_delim(11, _encode_value_info(name, shape, dt))
+    for name, shape, dt in g.outputs:
+        gb += _len_delim(12, _encode_value_info(name, shape, dt))
+    opset = _str_field(1, "") + _int_field(2, model.opset)
+    out = _int_field(1, 8)  # ir_version 8
+    out += _str_field(2, model.producer)
+    out += _len_delim(7, gb)
+    out += _len_delim(8, opset)
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# decoding
+# ---------------------------------------------------------------------- #
+def _decode_tensor(buf: bytes):
+    r = _Reader(buf)
+    dims, dt, name, raw = [], FLOAT, "", b""
+    while not r.eof():
+        f, v = r.field()
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dt = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    arr = onp.frombuffer(raw, dtype=_ONNX_TO_NP[dt]).reshape(dims)
+    return name, arr
+
+
+def _decode_value_info(buf: bytes):
+    r = _Reader(buf)
+    name, shape, dt = "", [], FLOAT
+    while not r.eof():
+        f, v = r.field()
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            tr = _Reader(v)
+            while not tr.eof():
+                tf, tv = tr.field()
+                if tf == 1:
+                    tt = _Reader(tv)
+                    while not tt.eof():
+                        ttf, ttv = tt.field()
+                        if ttf == 1:
+                            dt = ttv
+                        elif ttf == 2:
+                            sr = _Reader(ttv)
+                            while not sr.eof():
+                                sf, sv = sr.field()
+                                if sf == 1:
+                                    dr = _Reader(sv)
+                                    while not dr.eof():
+                                        df, dv = dr.field()
+                                        if df == 1:
+                                            shape.append(dv)
+    return name, tuple(shape), dt
+
+
+def _decode_attr(buf: bytes):
+    r = _Reader(buf)
+    name, val, typ = "", None, None
+    floats, ints = [], []
+    while not r.eof():
+        f, v = r.field()
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            val = v
+        elif f == 3:
+            val = v
+        elif f == 4:
+            val = v.decode()
+        elif f == 6:
+            floats.append(v)
+        elif f == 7:
+            ints.append(v)
+        elif f == 20:
+            typ = v
+    if typ == ATTR_FLOATS:
+        val = floats
+    elif typ == ATTR_INTS:
+        val = ints
+    return name, val
+
+
+def _decode_node(buf: bytes) -> Node:
+    r = _Reader(buf)
+    ins, outs, name, op, attrs = [], [], "", "", {}
+    while not r.eof():
+        f, v = r.field()
+        if f == 1:
+            ins.append(v.decode())
+        elif f == 2:
+            outs.append(v.decode())
+        elif f == 3:
+            name = v.decode()
+        elif f == 4:
+            op = v.decode()
+        elif f == 5:
+            k, av = _decode_attr(v)
+            attrs[k] = av
+    return Node(op, ins, outs, name, attrs)
+
+
+def decode_model(buf: bytes) -> Model:
+    r = _Reader(buf)
+    graph = Graph()
+    opset = 17
+    producer = ""
+    while not r.eof():
+        f, v = r.field()
+        if f == 2:
+            producer = v.decode()
+        elif f == 7:
+            gr = _Reader(v)
+            while not gr.eof():
+                gf, gv = gr.field()
+                if gf == 1:
+                    graph.nodes.append(_decode_node(gv))
+                elif gf == 2:
+                    graph.name = gv.decode()
+                elif gf == 5:
+                    name, arr = _decode_tensor(gv)
+                    graph.initializers[name] = arr
+                elif gf == 11:
+                    graph.inputs.append(_decode_value_info(gv))
+                elif gf == 12:
+                    graph.outputs.append(_decode_value_info(gv))
+        elif f == 8:
+            orr = _Reader(v)
+            while not orr.eof():
+                of, ov = orr.field()
+                if of == 2:
+                    opset = ov
+    m = Model(graph, opset, producer)
+    return m

@@ -495,10 +495,15 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     for small shapes, XLA reference otherwise (identical numerics).
     Differentiable via a custom VJP (exact softmax-attention backward).
     """
-    from ..ndarray.ndarray import NDArray, raw
+    from ..ndarray.ndarray import NDArray, apply_op, raw
 
     was_nd = isinstance(q, NDArray)
-    q, k, v = raw(q), raw(k), raw(v)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    out = _flash(q, k, v, causal, scale, block_q, block_k, force_reference)
-    return NDArray(out) if was_nd else out
+    if was_nd:
+        # eager NDArray path: route through apply_op so autograd.record()
+        # tapes the custom VJP like any other op
+        return apply_op(
+            lambda a, b, c: _flash(a, b, c, causal, scale, block_q, block_k,
+                                   force_reference), q, k, v)
+    return _flash(raw(q), raw(k), raw(v), causal, scale, block_q, block_k,
+                  force_reference)

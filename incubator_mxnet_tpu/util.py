@@ -1,98 +1,10 @@
-"""`mx.np` / `mx.npx` — NumPy-compatible namespaces.
-
-Re-design of the reference's `mx.np`/`mx.npx` (SURVEY.md §2.3
-"NumPy-compat ops" ~60k LoC of np_* C++ ops [UNVERIFIED]): on TPU this
-entire surface is `jax.numpy` wrapped through the autograd-recording
-`apply_op` hook — one dynamic adaptor instead of 60k LoC.
+"""Back-compat shim: `mx.np`/`mx.npx` live in the `numpy` /
+`numpy_extension` packages now (NumPy-semantics `ndarray` subtype with
+autograd, np.random/np.linalg, npx op surface).  This module re-exports
+them so old `from incubator_mxnet_tpu.util import np` imports keep
+working with the SAME implementations — no divergent copies.
 """
-from __future__ import annotations
-
-import types
-from typing import Any
-
-import jax
-import jax.numpy as jnp
-
-from .ndarray.ndarray import NDArray, apply_op, raw, wrap
+from . import numpy as np  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
 
 __all__ = ["np", "npx"]
-
-
-def _wrap_fn(jfn, name):
-    def op(*args, **kwargs):
-        conv = [a._data if isinstance(a, NDArray) else a for a in args]
-        has_nd = any(isinstance(a, NDArray) for a in args)
-        if not has_nd:
-            out = jfn(*conv, **kwargs)
-            if isinstance(out, (tuple, list)):
-                return tuple(NDArray(o) if hasattr(o, "shape") else o for o in out)
-            return NDArray(out) if hasattr(out, "shape") or jnp.isscalar(out) else out
-        nd_args = [a for a in args if isinstance(a, NDArray)]
-        return apply_op(lambda *xs: jfn(*_merge(args, xs), **kwargs), *nd_args)
-
-    def _merge(orig, xs):
-        xs = list(xs)
-        return [xs.pop(0) if isinstance(a, NDArray) else a for a in orig]
-
-    op.__name__ = name
-    return op
-
-
-class _NPNamespace(types.ModuleType):
-    """mx.np: jax.numpy with NDArray in/out + tape recording."""
-
-    ndarray = NDArray
-
-    def __init__(self):
-        super().__init__("incubator_mxnet_tpu.np")
-
-    def __getattr__(self, name):
-        target = getattr(jnp, name, None)
-        if target is None:
-            raise AttributeError(f"mx.np has no attribute {name!r}")
-        if callable(target) and not isinstance(target, type):
-            fn = _wrap_fn(target, name)
-            setattr(self, name, fn)
-            return fn
-        return target
-
-    # a few non-jnp parity helpers
-    def array(self, obj, dtype=None, ctx=None):
-        from .ndarray.ndarray import array as _array
-
-        return _array(obj, ctx=ctx, dtype=dtype)
-
-    def shape_array(self, x):
-        return NDArray(jnp.asarray(wrap(x).shape, jnp.int64))
-
-
-class _NPXNamespace(types.ModuleType):
-    """mx.npx: extensions (softmax/activation/conv wrappers, set_np)."""
-
-    def __init__(self):
-        super().__init__("incubator_mxnet_tpu.npx")
-        self._np_active = False
-
-    def set_np(self, shape=True, array=True, dtype=False):
-        self._np_active = True
-
-    def reset_np(self):
-        self._np_active = False
-
-    def is_np_array(self):
-        return self._np_active
-
-    def is_np_shape(self):
-        return self._np_active
-
-    def __getattr__(self, name):
-        from . import ndarray as nd
-
-        target = getattr(nd, name, None)
-        if target is None:
-            raise AttributeError(f"mx.npx has no attribute {name!r}")
-        return target
-
-
-np = _NPNamespace()
-npx = _NPXNamespace()

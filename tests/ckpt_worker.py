@@ -1,0 +1,62 @@
+"""Worker for the kill-and-resume test: trains a small net with
+deterministic per-step data, checkpointing every step; optionally
+crashes at a given step (first run only) to exercise autoresume."""
+import os
+import sys
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+
+    ckpt_dir = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    crash_at = int(sys.argv[3])  # -1 = never
+    out_file = sys.argv[4]
+    heartbeat = sys.argv[5] if len(sys.argv) > 5 else None
+
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=6)
+    net.initialize()
+    net(NDArray(jnp.ones((2, 6))))
+    trainer = Trainer(net.collect_params(), "adam", {"learning_rate": 0.05})
+    mgr = CheckpointManager(ckpt_dir, keep=2, async_save=False)
+
+    start = 0
+    if mgr.latest_step() is not None:
+        info = mgr.restore(net=net, trainer=trainer)
+        start = info["step"]
+        print(f"resumed from step {start}", flush=True)
+
+    for step in range(start + 1, total_steps + 1):
+        # deterministic per-step batch: resume must replay identically
+        key = jax.random.PRNGKey(1000 + step)
+        x = NDArray(jax.random.normal(key, (2, 6)))
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(1)
+        mgr.save(step, net=net, trainer=trainer)
+        if heartbeat:
+            with open(heartbeat, "w") as f:
+                f.write(str(step))
+        if step == crash_at and not os.path.exists(out_file + ".crashed"):
+            open(out_file + ".crashed", "w").close()
+            print(f"simulated crash at step {step}", flush=True)
+            os._exit(17)
+
+    mgr.wait()
+    import numpy as onp
+
+    onp.save(out_file, net.weight.data().asnumpy())
+    print(f"done at step {total_steps}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

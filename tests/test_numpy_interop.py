@@ -1,0 +1,122 @@
+"""mx.np / mx.npx interoperability (VERDICT r1 #8; ref
+`test_numpy_interoperability.py` / `test_numpy_op.py` patterns)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+np = mx.np
+npx = mx.npx
+
+
+def test_ndarray_type_and_creation():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, np.ndarray)
+    assert isinstance(a, mx.nd.NDArray)  # subtype of the core handle
+    assert a.shape == (2, 2) and str(a.dtype) == "float32"
+    for f, want in [(lambda: np.zeros((2, 3)), onp.zeros((2, 3))),
+                    (lambda: np.ones((2, 3)), onp.ones((2, 3))),
+                    (lambda: np.full((2,), 7.0), onp.full((2,), 7.0)),
+                    (lambda: np.arange(5), onp.arange(5)),
+                    (lambda: np.eye(3), onp.eye(3)),
+                    (lambda: np.linspace(0, 1, 5), onp.linspace(0, 1, 5))]:
+        got = f()
+        assert isinstance(got, np.ndarray)
+        onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-6)
+
+
+def test_type_propagates_through_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([4.0, 5.0, 6.0])
+    for out in (a + b, a * 2, np.tanh(a), np.dot(a, b), a[1:], a.reshape(3, 1),
+                np.concatenate([a, b]), np.where(a > 1, a, b)):
+        assert isinstance(out, np.ndarray), type(out)
+
+
+def test_numpy_broadcasting_and_promotion():
+    a = np.ones((3, 1)) * 2
+    b = np.arange(4).astype("float32")
+    c = a + b  # (3,1)+(4,) -> (3,4) numpy broadcasting
+    assert c.shape == (3, 4)
+    i = np.array([1, 2], dtype="int32")
+    f = np.array([0.5, 0.5], dtype="float32")
+    assert "float" in str((i + f).dtype)
+
+
+def test_boolean_mask_indexing():
+    a = np.arange(6).astype("float32")
+    m = a > 2
+    got = a[m]
+    onp.testing.assert_allclose(got.asnumpy(), [3, 4, 5])
+
+
+def test_reductions_and_linalg():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(np.sum(a).asnumpy()) == 10.0
+    assert float(np.mean(a).asnumpy()) == 2.5
+    onp.testing.assert_allclose(np.linalg.norm(a).asnumpy(),
+                                onp.linalg.norm([[1, 2], [3, 4]]), rtol=1e-6)
+    inv = np.linalg.inv(a)
+    assert isinstance(inv, np.ndarray)
+    onp.testing.assert_allclose((np.dot(a, inv)).asnumpy(), onp.eye(2), atol=1e-5)
+
+
+def test_random_namespace():
+    np.random.seed(0)
+    u = np.random.uniform(0, 1, size=(100,))
+    assert isinstance(u, np.ndarray)
+    assert 0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1
+    np.random.seed(0)
+    u2 = np.random.uniform(0, 1, size=(100,))
+    onp.testing.assert_array_equal(u.asnumpy(), u2.asnumpy())
+    r = np.random.randint(0, 5, size=(50,))
+    assert r.asnumpy().max() < 5
+
+
+def test_autograd_through_np_ops():
+    from incubator_mxnet_tpu import autograd
+
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.sum(np.tanh(x) ** 2)
+    y.backward()
+    g = x.grad.asnumpy()
+    want = 2 * onp.tanh([1, 2, 3]) * (1 - onp.tanh([1, 2, 3]) ** 2)
+    onp.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+def test_nd_np_conversion():
+    a = mx.nd.array([[1.0, 2.0]])
+    b = np.from_nd(a)
+    assert isinstance(b, np.ndarray)
+    onp.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    c = b.as_nd_ndarray()
+    assert type(c) is mx.nd.NDArray
+    onp.testing.assert_array_equal(c.asnumpy(), b.asnumpy())
+
+
+def test_npx_ops():
+    x = np.array([[-1.0, 2.0], [3.0, -4.0]])
+    r = npx.relu(x)
+    assert isinstance(r, np.ndarray)
+    onp.testing.assert_allclose(r.asnumpy(), [[0, 2], [3, 0]])
+    s = npx.softmax(x)
+    onp.testing.assert_allclose(s.asnumpy().sum(-1), [1, 1], rtol=1e-5)
+    oh = npx.one_hot(np.array([0, 1]), 3)
+    assert oh.shape == (2, 3)
+
+
+def test_npx_np_mode_flags():
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_constants_and_tolist():
+    assert np.pi == pytest.approx(onp.pi)
+    assert np.inf == onp.inf
+    a = np.array([[1, 2]])
+    assert a.tolist() == [[1, 2]]

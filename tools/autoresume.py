@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Elastic checkpoint-restart supervisor (SURVEY.md §5.3: "must exceed
+reference" — MXNet's ps-lite generally hangs or dies on worker failure).
+
+Supervises a training command; on non-zero exit OR a stalled heartbeat
+it kills and relaunches the command, which is expected to resume from
+its latest checkpoint (`utils.checkpoint.CheckpointManager.restore`).
+Restart count is bounded; steady progress (heartbeat mtime advancing)
+resets the budget.
+
+Heartbeat contract: the training script touches `--heartbeat-file`
+every step (one os.utime / write).  If the file goes stale for longer
+than `--heartbeat-timeout` seconds the job is declared hung (the
+barrier-timeout failure mode of distributed training) and restarted.
+
+Usage:
+  python tools/autoresume.py --max-restarts 3 \
+      [--heartbeat-file /tmp/hb --heartbeat-timeout 300] \
+      -- python train.py --ckpt-dir /ckpts ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="checkpoint-restart supervisor")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--heartbeat-file", type=str, default=None)
+    p.add_argument("--heartbeat-timeout", type=float, default=300.0)
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    return p
+
+
+def _heartbeat_age(path):
+    try:
+        return time.time() - os.path.getmtime(path)
+    except OSError:
+        return None  # not yet written
+
+
+def supervise(command, max_restarts=3, heartbeat_file=None,
+              heartbeat_timeout=300.0, poll_interval=1.0) -> int:
+    restarts = 0
+    while True:
+        start = time.time()
+        if heartbeat_file is not None:
+            # reset staleness: the relaunched process needs init time
+            # before its first beat — a stale mtime from the previous
+            # incarnation must not kill it instantly
+            try:
+                os.utime(heartbeat_file, None)
+            except OSError:
+                pass
+        proc = subprocess.Popen(command)
+        hung = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            if heartbeat_file is not None:
+                age = _heartbeat_age(heartbeat_file)
+                if age is not None and age > heartbeat_timeout:
+                    print(f"autoresume: heartbeat stale {age:.0f}s > "
+                          f"{heartbeat_timeout:.0f}s — killing job",
+                          file=sys.stderr, flush=True)
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait()
+                    rc, hung = -9, True
+                    break
+            time.sleep(poll_interval)
+        if rc == 0:
+            return 0
+        # sustained progress earns the budget back — BEFORE the
+        # exhaustion check, so a long-healthy job gets a fresh budget
+        if time.time() - start > 10 * heartbeat_timeout:
+            restarts = 0
+        restarts += 1
+        reason = "hang" if hung else f"rc={rc}"
+        if restarts > max_restarts:
+            print(f"autoresume: {reason}; restart budget exhausted "
+                  f"({max_restarts})", file=sys.stderr, flush=True)
+            return rc if rc else 1
+        print(f"autoresume: {reason}; restarting ({restarts}/{max_restarts})",
+              file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("autoresume: no command given", file=sys.stderr)
+        return 2
+    return supervise(command, args.max_restarts, args.heartbeat_file,
+                     args.heartbeat_timeout, args.poll_interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

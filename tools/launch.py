@@ -46,6 +46,9 @@ def build_parser():
                    choices=["local", "env"])
     p.add_argument("--coordinator-port", type=int, default=0,
                    help="port for process 0 (0 = pick a free port)")
+    p.add_argument("--coordinator-host", type=str, default=None,
+                   help="routable host of process 0 (env mode; default: "
+                        "this machine's hostname)")
     p.add_argument("--env-keys", type=str, default="",
                    help="comma-separated extra env vars to forward")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -111,7 +114,10 @@ def main(argv=None):
         return 2
     if args.launcher == "env":
         port = args.coordinator_port or _free_port()
-        coordinator = f"127.0.0.1:{port}"
+        # externally-orchestrated workers live on OTHER machines: the
+        # coordinator address must be routable, not loopback
+        host = args.coordinator_host or socket.getfqdn()
+        coordinator = f"{host}:{port}"
         for rank in range(args.num_workers):
             env = worker_env(rank, args.num_workers, coordinator, base={})
             assigns = " ".join(f"{k}={v}" for k, v in sorted(env.items()))
