@@ -38,6 +38,8 @@ from . import recordio
 from . import image
 from . import profiler
 from . import onnx
+from . import operator
+from . import contrib
 from . import amp
 from . import parallel
 from . import ops

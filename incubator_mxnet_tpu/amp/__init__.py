@@ -17,23 +17,86 @@ import jax.numpy as jnp
 from .lists import FP16_FP32_FUNCS, FP16_FUNCS, FP32_FUNCS
 from .loss_scaler import LossScaler
 
-__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
-           "convert_hybrid_block", "LossScaler", "amp_dtype"]
+__all__ = ["init", "reset", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "LossScaler", "amp_dtype"]
 
-_state = {"initialized": False, "dtype": None, "loss_scaler": None}
+_state = {"initialized": False, "dtype": None, "loss_scaler": None,
+          "originals": {}}
 
 
 def amp_dtype():
     return _state["dtype"]
 
 
+def _cast_floats(args, dt):
+    from ..ndarray.ndarray import NDArray
+
+    out = []
+    for a in args:
+        if isinstance(a, NDArray) and jnp.issubdtype(
+                jnp.result_type(a._data), jnp.floating) and a._data.dtype != dt:
+            out.append(a.astype(dt))
+        else:
+            out.append(a)
+    return out
+
+
+def _rewrite_namespace(dt):
+    """The reference's `amp.init()` monkey-patches the op namespaces per
+    its allow/deny lists (SURVEY.md §2.2) — same here: FP16_FUNCS cast
+    float inputs to the AMP dtype on the way in (MXU ops), FP32_FUNCS
+    force fp32 (range-sensitive ops).  Restored by `reset()`."""
+    from .. import ndarray as nd_mod
+
+    if _state["originals"]:
+        return  # already rewritten
+
+    def wrap_cast(fn, to):
+        def op(*args, **kwargs):
+            return fn(*_cast_floats(args, to), **kwargs)
+
+        op.__name__ = getattr(fn, "__name__", "amp_op")
+        op.__wrapped__ = fn
+        return op
+
+    for name in FP16_FUNCS:
+        fn = getattr(nd_mod, name, None)
+        if callable(fn):
+            _state["originals"][name] = fn
+            setattr(nd_mod, name, wrap_cast(fn, dt))
+    for name in FP32_FUNCS:
+        fn = getattr(nd_mod, name, None)
+        if callable(fn):
+            _state["originals"][name] = fn
+            setattr(nd_mod, name, wrap_cast(fn, jnp.float32))
+
+
+def reset():
+    """Undo `init()`'s namespace rewrite (test/teardown hook)."""
+    from .. import ndarray as nd_mod
+
+    for name, fn in _state["originals"].items():
+        setattr(nd_mod, name, fn)
+    _state["originals"] = {}
+    _state["initialized"] = False
+    _state["dtype"] = None
+    _state["loss_scaler"] = None
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """Enable mixed precision. TPU-native default is bfloat16."""
+    """Enable mixed precision. TPU-native default is bfloat16.
+
+    Rewrites the nd op namespace per the AMP lists (MXU ops cast to
+    bf16, range-sensitive ops to fp32) — reference `amp.init()` parity.
+    """
     dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else jnp.float16
+    if _state["originals"] and _state["dtype"] != dt:
+        reset()  # re-init with a different dtype: drop the old wrappers
     _state["initialized"] = True
     _state["dtype"] = dt
     _state["loss_scaler"] = LossScaler(init_scale=1.0 if dt == jnp.bfloat16 else 2 ** 16)
+    _rewrite_namespace(dt)
 
 
 def init_trainer(trainer):

@@ -1,0 +1,5 @@
+"""`mx.contrib` — quantization and other contrib subsystems
+(ref `python/mxnet/contrib/`, SURVEY.md §2.6)."""
+from . import quantization
+
+__all__ = ["quantization"]

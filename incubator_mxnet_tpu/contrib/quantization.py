@@ -1,0 +1,182 @@
+"""INT8 post-training quantization (VERDICT r1 #7 gap; ref
+`src/operator/quantization/` ~12k LoC + `python/mxnet/contrib/
+quantization.py` [UNVERIFIED], SURVEY.md §2.3).
+
+TPU-native design: symmetric per-channel int8 weights + per-tensor
+activation scales, with the matmul running INT8×INT8→INT32 on the MXU
+(`lax.dot_general(preferred_element_type=int32)`) and a float
+rescale epilogue — the XLA int8 path replacing the reference's
+quantized_conv/quantized_fc CUDA kernels.  Calibration follows the
+reference's two modes: `minmax` and `entropy` (KL-divergence threshold
+search over a histogram).
+
+API parity: `quantize_net(net, calib_data, calib_mode)` returns a net
+whose Dense layers compute through int8; `quantize`/`dequantize`
+element ops live in `nd.contrib`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["quantize_weight", "calibrate", "QuantizedDense", "quantize_net"]
+
+
+def quantize_weight(w, axis: int = 0):
+    """Symmetric per-output-channel int8 quantization: returns (int8
+    weights, float scale per channel)."""
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w), axis=tuple(i for i in range(w.ndim) if i != axis),
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _entropy_threshold(hist, edges, num_quantized_bins=255):
+    """KL-divergence threshold search (ref calib_mode='entropy')."""
+    def kl(p, q):
+        p = p / max(p.sum(), 1e-12)
+        q = q / max(q.sum(), 1e-12)
+        mask = p > 0
+        qq = onp.where(q > 0, q, 1e-12)
+        return float((p[mask] * onp.log(p[mask] / qq[mask])).sum())
+
+    n = len(hist)
+    best_d, best_t = onp.inf, edges[-1]
+    for i in range(num_quantized_bins // 2, n + 1, max(1, n // 32)):
+        ref = hist[:i].astype("float64").copy()
+        ref[i - 1] += hist[i:].sum()  # clip outliers into the edge bin
+        # quantize the i bins down to num_quantized_bins
+        factor = i / num_quantized_bins
+        q = onp.zeros(i)
+        for j in range(num_quantized_bins):
+            lo, hi = int(j * factor), max(int((j + 1) * factor), int(j * factor) + 1)
+            chunk = ref[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = onp.where(chunk > 0, chunk.sum() / nz, 0)
+        d = kl(ref, q)
+        if d < best_d:
+            best_d, best_t = d, edges[i]
+    return best_t
+
+
+def calibrate(activations: List, mode: str = "minmax") -> float:
+    """Activation threshold from calibration batches (ref modes)."""
+    flat = onp.concatenate([onp.abs(onp.asarray(a)).ravel() for a in activations])
+    if mode == "minmax":
+        return float(flat.max())
+    if mode == "entropy":
+        hist, edges = onp.histogram(flat, bins=2048)
+        return float(_entropy_threshold(hist, edges))
+    raise ValueError(f"unknown calib_mode {mode!r} (minmax|entropy)")
+
+
+@jax.jit
+def int8_dense(x, w_q, w_scale, act_scale, bias=None):
+    """INT8×INT8→INT32 matmul with float rescale epilogue."""
+    xq = jnp.clip(jnp.round(x / act_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, w_q, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (act_scale * w_scale.reshape(1, -1))
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class QuantizedDense:
+    """Inference Dense over int8 weights (replaces nn.Dense post-PTQ)."""
+
+    def __init__(self, dense, act_threshold: float):
+        from ..ndarray.ndarray import raw
+
+        w = raw(dense.weight.data())
+        self.w_q, self.w_scale = quantize_weight(w, axis=0)
+        self.bias = raw(dense.bias.data()) if getattr(dense, "bias", None) is not None \
+            and dense.bias._data_nd is not None else None
+        self.act_scale = max(act_threshold, 1e-8) / 127.0
+        # Dense may fuse an activation — it must survive quantization
+        self.activation = getattr(dense, "_activation", None)
+        self._src = dense
+
+    def __call__(self, x):
+        from ..ndarray import nn_ops
+        from ..ndarray.ndarray import NDArray, raw, wrap
+
+        xr = raw(wrap(x))
+        lead = xr.shape[:-1] if xr.ndim > 2 else None
+        if lead is not None:
+            xr = xr.reshape(-1, xr.shape[-1])
+        out = int8_dense(xr.astype(jnp.float32), self.w_q, self.w_scale,
+                         self.act_scale, self.bias)
+        if lead is not None:
+            out = out.reshape(*lead, -1)
+        nd_out = NDArray(out)
+        if self.activation:
+            nd_out = nn_ops.Activation(nd_out, act_type=self.activation)
+        return nd_out
+
+
+def quantize_net(net, calib_data, calib_mode: str = "minmax",
+                 layer_types=("Dense",)):
+    """Post-training-quantize a Gluon net's Dense layers in place.
+
+    calib_data: iterable of input batches (NDArray).  Runs calibration
+    forwards recording each target layer's input range, then swaps the
+    layer for a QuantizedDense.  Returns the net.
+    """
+    from ..gluon import nn
+    from ..ndarray.ndarray import NDArray
+
+    targets = []
+
+    def walk(block):
+        for name, child in list(block._children.items()):
+            if type(child).__name__ in layer_types:
+                targets.append((block, name, child))
+            else:
+                walk(child)
+
+    walk(net)
+    # record per-layer input activations over the calibration set
+    records: Dict[int, List] = {id(c): [] for _, _, c in targets}
+
+    hooks = []
+    for _, _, child in targets:
+        def mk_hook(key):
+            def hook(blk, inputs):
+                records[key].append(inputs[0].asnumpy())
+            return hook
+
+        hooks.append((child, child.register_forward_pre_hook(mk_hook(id(child)))))
+    for batch in calib_data:
+        net(batch if isinstance(batch, NDArray) else NDArray(jnp.asarray(batch)))
+    for child, h in hooks:  # remove OUR hooks only; user hooks survive
+        child._forward_pre_hooks.remove(h)
+    for parent, name, child in targets:
+        thr = calibrate(records[id(child)], calib_mode)
+        wrapper = _QuantizedWrapper(child, thr)
+        parent._children[name] = wrapper
+        object.__setattr__(parent, name, wrapper)
+    return net
+
+
+from ..gluon.block import HybridBlock as _HybridBlock
+
+
+class _QuantizedWrapper(_HybridBlock):
+    """Real Block so the swapped layer stays in the tree: checkpoints
+    (save_parameters walks Block children) keep the original fp32
+    params — quantization is a runtime transform, not a format."""
+
+    def __init__(self, dense, threshold):
+        super().__init__(prefix=dense.name + "_int8_")
+        self.src = dense  # registered child: fp32 params persist
+        self._qd = QuantizedDense(dense, threshold)
+
+    def forward(self, x):
+        return self._qd(x)

@@ -1,44 +1,197 @@
-"""Minimal Estimator (ref gluon/contrib/estimator [UNVERIFIED]):
-fit/evaluate loops over DataLoaders with metrics + event handlers."""
+"""Estimator — high-level fit/evaluate with event handlers.
+
+Re-design of `python/mxnet/gluon/contrib/estimator/` [UNVERIFIED]
+(SURVEY.md §2.6 "Gluon layers/contrib"): epoch/batch event hooks,
+validation integration, checkpointing and early stopping — the r1
+skeleton grown to the reference's handler architecture.
+"""
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 from ... import autograd, metric as metric_mod
 
-__all__ = ["Estimator"]
+__all__ = ["Estimator", "EventHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "StopTraining"]
+
+
+class StopTraining(Exception):
+    pass
+
+
+class EventHandler:
+    """Override any subset of the hooks (reference handler contract)."""
+
+    def train_begin(self, estimator):
+        pass
+
+    def train_end(self, estimator):
+        pass
+
+    def epoch_begin(self, estimator):
+        pass
+
+    def epoch_end(self, estimator):
+        pass
+
+    def batch_begin(self, estimator):
+        pass
+
+    def batch_end(self, estimator):
+        pass
+
+
+class LoggingHandler(EventHandler):
+    def __init__(self, log_interval=50, logger=None):
+        import logging
+
+        self.log_interval = log_interval
+        self.logger = logger or logging.getLogger("estimator")
+        self._tic = 0.0
+        self._samples = 0
+
+    def epoch_begin(self, estimator):
+        self._tic = time.time()
+        self._samples = 0
+
+    def batch_end(self, estimator):
+        self._samples += estimator._last_batch_size
+        if estimator.batch_idx % self.log_interval == 0:
+            dt = max(time.time() - self._tic, 1e-9)
+            metrics = " ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
+                               for m in estimator.train_metrics)
+            self.logger.info("epoch[%d] batch[%d] %.1f samples/s %s",
+                             estimator.epoch, estimator.batch_idx,
+                             self._samples / dt, metrics)
+
+    def epoch_end(self, estimator):
+        metrics = {m.get()[0]: m.get()[1] for m in estimator.train_metrics}
+        self.logger.info("epoch[%d] done: %s val=%s", estimator.epoch,
+                         metrics, estimator.last_val_metrics)
+
+
+class CheckpointHandler(EventHandler):
+    def __init__(self, model_dir, model_prefix="model", save_best=False,
+                 monitor=None, mode="max"):
+        import os
+
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.save_best = save_best
+        self.monitor = monitor
+        self.mode = mode
+        self.best = None
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator):
+        import os
+
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(f"{prefix}-{estimator.epoch:04d}.params")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(f"{prefix}-{estimator.epoch:04d}.states")
+        if self.save_best and self.monitor:
+            val = (estimator.last_val_metrics or {}).get(self.monitor)
+            if val is not None:
+                better = (self.best is None
+                          or (self.mode == "max" and val > self.best)
+                          or (self.mode == "min" and val < self.best))
+                if better:
+                    self.best = val
+                    estimator.net.save_parameters(f"{prefix}-best.params")
+
+
+class EarlyStoppingHandler(EventHandler):
+    def __init__(self, monitor, patience=3, mode="max", min_delta=0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self.best = None
+        self.bad_epochs = 0
+
+    def epoch_end(self, estimator):
+        val = (estimator.last_val_metrics or {}).get(self.monitor)
+        if val is None:
+            return
+        improved = (self.best is None
+                    or (self.mode == "max" and val > self.best + self.min_delta)
+                    or (self.mode == "min" and val < self.best - self.min_delta))
+        if improved:
+            self.best = val
+            self.bad_epochs = 0
+        else:
+            self.bad_epochs += 1
+            if self.bad_epochs >= self.patience:
+                raise StopTraining(f"no {self.monitor} improvement in "
+                                   f"{self.patience} epochs")
 
 
 class Estimator:
-    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None):
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, event_handlers=None):
         self.net = net
         self.loss = loss
         self.train_metrics = train_metrics or [metric_mod.Accuracy()]
+        self.val_metrics = val_metrics or [metric_mod.Accuracy()]
         self.trainer = trainer
+        self.handlers: List[EventHandler] = list(event_handlers or [])
+        self.epoch = 0
+        self.batch_idx = 0
+        self.last_val_metrics = None
+        self._last_batch_size = 0
+
+    def _emit(self, hook):
+        for h in self.handlers:
+            getattr(h, hook)(self)
 
     def evaluate(self, val_data, batch_axis=0):
-        for m in self.train_metrics:
+        for m in self.val_metrics:
             m.reset()
         for batch in val_data:
             data, label = batch[0], batch[1]
             out = self.net(data)
-            for m in self.train_metrics:
+            for m in self.val_metrics:
                 m.update([label], [out])
-        return {m.get()[0]: m.get()[1] for m in self.train_metrics}
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
 
-    def fit(self, train_data, val_data=None, epochs=1, batch_axis=0):
+    def fit(self, train_data, val_data=None, epochs=1, batch_axis=0,
+            event_handlers=None):
+        # per-call handlers are scoped to THIS fit — repeated fits must
+        # not accumulate duplicates
+        saved_handlers = self.handlers
+        if event_handlers:
+            self.handlers = saved_handlers + list(event_handlers)
         history = []
-        for epoch in range(epochs):
-            for m in self.train_metrics:
-                m.reset()
-            for batch in train_data:
-                data, label = batch[0], batch[1]
-                with autograd.record():
-                    out = self.net(data)
-                    l = self.loss(out, label)
-                l.backward()
-                self.trainer.step(data.shape[batch_axis])
+        self._emit("train_begin")
+        try:
+            for epoch in range(epochs):
+                self.epoch = epoch
                 for m in self.train_metrics:
-                    m.update([label], [out])
-            history.append({m.get()[0]: m.get()[1] for m in self.train_metrics})
+                    m.reset()
+                self._emit("epoch_begin")
+                for self.batch_idx, batch in enumerate(train_data):
+                    self._emit("batch_begin")
+                    data, label = batch[0], batch[1]
+                    self._last_batch_size = data.shape[batch_axis]
+                    with autograd.record():
+                        out = self.net(data)
+                        l = self.loss(out, label)
+                    l.backward()
+                    self.trainer.step(self._last_batch_size)
+                    for m in self.train_metrics:
+                        m.update([label], [out])
+                    self._emit("batch_end")
+                self.last_val_metrics = (self.evaluate(val_data, batch_axis)
+                                         if val_data is not None else None)
+                history.append({
+                    **{m.get()[0]: m.get()[1] for m in self.train_metrics},
+                    **{f"val_{k}": v
+                       for k, v in (self.last_val_metrics or {}).items()}})
+                self._emit("epoch_end")
+        except StopTraining:
+            pass
+        self._emit("train_end")
+        self.handlers = saved_handlers
         return history

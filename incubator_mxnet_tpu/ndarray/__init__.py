@@ -48,3 +48,10 @@ def _jnp_fallback(name):
 
 def __getattr__(name):
     return _jnp_fallback(name)
+
+
+def Custom(*data, op_type, **kwargs):
+    """mx.nd.Custom — registered python custom op (see mx.operator)."""
+    from ..operator import Custom as _C
+
+    return _C(*data, op_type=op_type, **kwargs)

@@ -1,0 +1,153 @@
+"""`mx.operator` — Python custom operators (VERDICT r1 #8 gap).
+
+Re-design of `src/operator/custom/custom.cc` + `mx.operator.CustomOp`
+(SURVEY.md §2.3 "Custom op bridges" [UNVERIFIED]): user-defined Python
+ops callable from compiled graphs.  On TPU the GIL-managed engine
+callback becomes `jax.pure_callback` — the op's NumPy `forward` runs
+host-side even inside `jax.jit`, and a custom VJP routes cotangents
+through the op's `backward`.  The reference's `MXLoadLib` native-plugin
+ABI maps to XLA custom_call and is out of scope (documented).
+
+API parity:
+    @mx.operator.register("my_op")
+    class MyProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ["data"]
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]]
+        def create_operator(self, ctx, shapes, dtypes): return MyOp()
+    y = mx.nd.Custom(x, op_type="my_op")
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get", "Custom"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Subclass and implement forward/backward over NumPy arrays."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Reference helper: honor the grad_req when writing outputs."""
+        if req == "add":
+            dst += onp.asarray(src, dtype=dst.dtype)
+        else:
+            dst[...] = onp.asarray(src, dtype=dst.dtype)
+
+
+class CustomOpProp:
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs())
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+def register(name):
+    def deco(prop_cls):
+        _REGISTRY[name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get(name) -> type:
+    return _REGISTRY[name]
+
+
+def _np_call(op, is_train, n_out, out_shapes, out_dtypes, *arrays):
+    ins = [onp.asarray(a) for a in arrays]
+    outs = [onp.zeros(s, d) for s, d in zip(out_shapes, out_dtypes)]
+    op.forward(is_train, ["write"] * n_out, ins, outs, [])
+    return tuple(outs)
+
+
+def _np_grad(op, n_in, in_shapes, in_dtypes, n_out, *arrays):
+    grads_out = [onp.asarray(a) for a in arrays[:n_out]]
+    ins = [onp.asarray(a) for a in arrays[n_out:n_out + n_in]]
+    outs = [onp.asarray(a) for a in arrays[n_out + n_in:]]
+    in_grads = [onp.zeros(s, d) for s, d in zip(in_shapes, in_dtypes)]
+    op.backward(["write"] * n_in, grads_out, ins, outs, in_grads, [])
+    return tuple(in_grads)
+
+
+def Custom(*data, op_type: str, **kwargs):
+    """Run a registered custom op (`mx.nd.Custom` parity).
+
+    Works eagerly AND inside jit/hybridize via jax.pure_callback;
+    differentiable through the op's `backward`."""
+    from .ndarray.ndarray import NDArray, apply_op, raw, wrap
+
+    prop = _REGISTRY[op_type](**kwargs) if kwargs else _REGISTRY[op_type]()
+    nd_in = [wrap(d) for d in data]
+    in_shapes = [list(x.shape) for x in nd_in]
+    in_sh, out_sh = prop.infer_shape(in_shapes)
+    # the NumPy callback world has no bfloat16 — compute host-side in
+    # fp32 and cast cotangents back to the primal dtypes afterwards
+    primal_dtypes = [x._data.dtype for x in nd_in]
+    in_dtypes = [onp.dtype(str(x.dtype)) if str(x.dtype) != "bfloat16"
+                 else onp.dtype("float32") for x in nd_in]
+    _, out_ty = prop.infer_type([d for d in in_dtypes])
+    op = prop.create_operator(None, in_sh, in_dtypes)
+    n_in, n_out = len(in_sh), len(out_sh)
+
+    result_shapes = [jax.ShapeDtypeStruct(tuple(s), d)
+                     for s, d in zip(out_sh, out_ty)]
+    in_structs = [jax.ShapeDtypeStruct(tuple(s), d)
+                  for s, d in zip(in_sh, in_dtypes)]
+
+    @jax.custom_vjp
+    def run(*raws):
+        return jax.pure_callback(
+            functools.partial(_np_call, op, True, n_out,
+                              [tuple(s) for s in out_sh], out_ty),
+            tuple(result_shapes), *raws)
+
+    def run_fwd(*raws):
+        outs = run(*raws)
+        return outs, (raws, outs)
+
+    def run_bwd(res, cots):
+        raws, outs = res
+        cots = cots if isinstance(cots, tuple) else (cots,)
+        cots = tuple(c.astype(jnp.float32) if c.dtype == jnp.bfloat16 else c
+                     for c in cots)
+        raws = tuple(r.astype(jnp.float32) if r.dtype == jnp.bfloat16 else r
+                     for r in raws)
+        grads = jax.pure_callback(
+            functools.partial(_np_grad, op, n_in,
+                              [tuple(s) for s in in_sh], in_dtypes, n_out),
+            tuple(in_structs), *cots, *raws, *outs)
+        # cotangents must match the PRIMAL dtypes (bf16 stays bf16)
+        return tuple(g.astype(dt) for g, dt in zip(grads, primal_dtypes))
+
+    run.defvjp(run_fwd, run_bwd)
+
+    if n_out == 1:
+        return apply_op(lambda *xs: run(*xs)[0], *nd_in)
+    return apply_op(lambda *xs: run(*xs), *nd_in, n_out=n_out)

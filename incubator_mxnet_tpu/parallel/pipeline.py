@@ -22,7 +22,7 @@ __all__ = ["pipeline_forward", "pipeline_apply"]
 
 
 def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
-                     axis_name: str = "pipe"):
+                     axis_name: str = "pipe", skip_inactive: bool = False):
     """Inside-shard_map GPipe forward.
 
     stage_fn(params, x) -> y : one stage's compute (same signature all
@@ -31,6 +31,12 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
     x_microbatches: (M, mb, ...) — the M microbatches, REPLICATED input;
     stage 0 consumes them, later stages ignore and take the ring input.
     Returns (M, mb, ...) outputs valid on the LAST stage.
+
+    skip_inactive: wrap the stage compute in `lax.cond(active, ...)` so
+    bubble ticks skip the FLOPs instead of computing-and-masking (the
+    r1 review's PP-efficiency gap).  ONLY safe when stage_fn contains
+    no collectives — with e.g. TP psum inside the stage, divergent
+    per-device branches would deadlock, so it defaults off.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -48,8 +54,13 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
         inject = x_microbatches[jnp.minimum(t, M - 1)]
         x_in = jnp.where(idx == 0, inject, state)
         active = jnp.logical_and(t - idx >= 0, t - idx < M)
-        y = stage_fn(stage_params, x_in)
-        y = jnp.where(active, y, state)
+        if skip_inactive:
+            y = lax.cond(active,
+                         lambda xi: stage_fn(stage_params, xi),
+                         lambda xi: state, x_in)
+        else:
+            y = stage_fn(stage_params, x_in)
+            y = jnp.where(active, y, state)
         # last stage writes its finished microbatch t-(n-1)
         out_slot = t - (n - 1)
         is_last = idx == n - 1
@@ -70,7 +81,8 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
 
 
 def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
-                   num_microbatches: int, axis_name: str = "pipe"):
+                   num_microbatches: int, axis_name: str = "pipe",
+                   skip_inactive: bool = False):
     """Top-level: split batch into microbatches, shard stage params over
     `axis_name` (leading axis = stage), run the GPipe schedule.
 
@@ -85,7 +97,8 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
 
     def inner(params, xmb):
         local = jax.tree_util.tree_map(lambda p: p[0], params)  # this stage's slice
-        return pipeline_forward(stage_fn, local, xmb, axis_name)
+        return pipeline_forward(stage_fn, local, xmb, axis_name,
+                                skip_inactive=skip_inactive)
 
     param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), all_stage_params)
     fn = shard_map(inner, mesh=mesh,

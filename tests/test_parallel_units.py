@@ -145,3 +145,27 @@ def test_collectives_psum_across_mesh():
 
     out = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"))(x)
     onp.testing.assert_allclose(onp.asarray(out), onp.full(8, 28.0))
+
+
+def test_pipeline_skip_inactive_matches_masked():
+    """GPipe with bubble-skipping (lax.cond) == compute-and-mask == oracle."""
+    from incubator_mxnet_tpu.parallel import pipeline
+
+    mesh = par.create_mesh(pipe=4)
+    ks = jax.random.split(jax.random.PRNGKey(7), 5)
+    W = jnp.stack([jax.random.normal(k, (8, 8)) * 0.3 for k in ks[:4]])
+    x = jax.random.normal(ks[4], (8, 8))
+
+    def stage_fn(w, h):
+        return jax.nn.tanh(h @ w)
+
+    masked = pipeline.pipeline_apply(stage_fn, W, x, mesh, num_microbatches=2)
+    skipped = pipeline.pipeline_apply(stage_fn, W, x, mesh, num_microbatches=2,
+                                      skip_inactive=True)
+    want = x
+    for i in range(4):
+        want = stage_fn(W[i], want)
+    onp.testing.assert_allclose(onp.asarray(masked), onp.asarray(want),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(skipped), onp.asarray(want),
+                                rtol=1e-5, atol=1e-5)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""im2rec — image dataset → RecordIO packer (ref `tools/im2rec.py`,
+SURVEY.md §2.8).
+
+Two modes, reference parity (args: PREFIX-or-LST first, ROOT second):
+  list mode:  --list --recursive prefix root → prefix.lst (idx\tlabel\tpath)
+  pack mode:  prefix.lst root → prefix.rec (+ prefix.idx)
+
+Run: python tools/im2rec.py --list --recursive train imgs/
+     python tools/im2rec.py train.lst imgs/ --quality 95 --resize 256
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(root, prefix, recursive=True):
+    classes = {}
+    entries = []
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        if not recursive and dirpath != root:
+            continue
+        label_name = os.path.relpath(dirpath, root)
+        for fn in sorted(filenames):
+            if os.path.splitext(fn)[1].lower() in _EXTS:
+                if label_name not in classes:
+                    classes[label_name] = len(classes)
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                entries.append((len(entries), classes[label_name], rel))
+    with open(prefix + ".lst", "w") as f:
+        for idx, label, rel in entries:
+            f.write(f"{idx}\t{label}\t{rel}\n")
+    print(f"wrote {prefix}.lst ({len(entries)} items, {len(classes)} classes)")
+    return entries
+
+
+def pack(lst_path, root, quality=95, resize=0, color=1):
+    from PIL import Image
+    import numpy as onp
+
+    from incubator_mxnet_tpu import recordio
+
+    prefix = lst_path[:-4] if lst_path.endswith(".lst") else lst_path
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            img = Image.open(os.path.join(root, rel))
+            img = img.convert("RGB" if color else "L")
+            if resize:
+                w, h = img.size
+                scale = resize / min(w, h)
+                img = img.resize((max(1, int(w * scale)),
+                                  max(1, int(h * scale))))
+            hdr = recordio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, recordio.pack_img(hdr, onp.asarray(img),
+                                                 quality=quality))
+            n += 1
+    rec.close()
+    print(f"packed {n} images → {prefix}.rec / {prefix}.idx")
+    return n
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="image → RecordIO converter")
+    p.add_argument("prefix_or_lst")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true", dest="make_list")
+    p.add_argument("--recursive", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--color", type=int, default=1)
+    args = p.parse_args(argv)
+    if args.make_list:
+        # reference arg order: im2rec.py --list prefix root
+        make_list(args.root, args.prefix_or_lst, args.recursive)
+        return 0
+    pack(args.prefix_or_lst, args.root, args.quality, args.resize, args.color)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

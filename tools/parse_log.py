@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""parse_log — scrape speed/accuracy from training logs (ref
+`tools/parse_log.py`, SURVEY.md §2.8).  Understands the Speedometer
+line format this framework's `callback.Speedometer` prints:
+
+  Epoch[3] Batch [200]\tSpeed: 1234.56 samples/sec\taccuracy=0.987
+
+Run: python tools/parse_log.py train.log [--format json|md]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_LINE = re.compile(
+    r"Epoch\[(\d+)\]\s+Batch\s*\[(\d+)\].*?Speed:\s*([\d.]+)\s*samples/sec"
+    r"(.*)$")
+_METRIC = re.compile(r"([\w-]+)=([\d.eE+-]+)")
+_VAL = re.compile(r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([\d.eE+-]+)")
+
+
+def parse(lines):
+    rows = []
+    epochs = {}
+    for line in lines:
+        m = _LINE.search(line)
+        if m:
+            metrics = {k: float(v) for k, v in _METRIC.findall(m.group(4))}
+            rows.append({"epoch": int(m.group(1)), "batch": int(m.group(2)),
+                         "speed": float(m.group(3)), **metrics})
+            continue
+        v = _VAL.search(line)
+        if v:
+            ep = int(v.group(1))
+            key = f"{v.group(2).lower()}-{v.group(3)}"
+            epochs.setdefault(ep, {"epoch": ep})[key] = float(v.group(4))
+    summary = []
+    for ep in sorted({r["epoch"] for r in rows} | set(epochs)):
+        ep_rows = [r for r in rows if r["epoch"] == ep]
+        entry = dict(epochs.get(ep, {"epoch": ep}))
+        if ep_rows:
+            entry["mean_speed"] = sum(r["speed"] for r in ep_rows) / len(ep_rows)
+        summary.append(entry)
+    return {"batches": rows, "epochs": summary}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="training log parser")
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=["json", "md"], default="json")
+    args = p.parse_args(argv)
+    with open(args.logfile) as f:
+        res = parse(f)
+    if args.format == "json":
+        print(json.dumps(res["epochs"], indent=2))
+    else:
+        keys = sorted({k for e in res["epochs"] for k in e})
+        print("| " + " | ".join(keys) + " |")
+        print("|" + "---|" * len(keys))
+        for e in res["epochs"]:
+            print("| " + " | ".join(str(e.get(k, "")) for k in keys) + " |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
