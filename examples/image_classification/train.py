@@ -45,6 +45,9 @@ def build_parser():
     p.add_argument("--model-prefix", type=str, default=None)
     p.add_argument("--dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--remat", action="store_true",
+                   help="rematerializing backward (trade FLOPs for HBM; "
+                        "hybridize(remat_backward=True))")
     return p
 
 
@@ -96,7 +99,7 @@ def train(args):
     net(NDArray(mx.nd.zeros((args.batch_size,) + shape)._data))
     if args.dtype == "bfloat16":
         net.cast("bfloat16")
-    net.hybridize()
+    net.hybridize(remat_backward=args.remat)
     loss_fn = loss_mod.SoftmaxCrossEntropyLoss()
     trainer = Trainer(net.collect_params(), "sgd",
                       {"learning_rate": args.lr, "momentum": args.momentum,

@@ -3,8 +3,10 @@
 Re-design of `python/mxnet/amp/` + `src/nnvm/low_precision_pass.cc`
 [UNVERIFIED] (SURVEY.md §2.2 "AMP graph pass"): instead of an NNVM
 graph rewrite with fp16 allow/deny op lists, the TPU policy is a dtype
-policy on parameters + inputs (bf16 matmuls/convs accumulate fp32 via
-`preferred_element_type` — set in nn_ops).  bf16 needs no loss scaling
+policy on parameters + inputs (bf16 MATMULS accumulate fp32 via
+`preferred_element_type` in nn_ops; convs rely on the TPU MXU's
+hardware fp32 accumulation — no HLO-level guarantee on other
+backends).  bf16 needs no loss scaling
 (same exponent range as fp32); a dynamic `LossScaler` is still provided
 for fp16 parity and for users porting reference scripts.
 """

@@ -124,3 +124,24 @@ def current_context() -> Context:
     if not hasattr(Context._default_ctx, "value"):
         Context._default_ctx.value = Context("cpu", 0)
     return Context._default_ctx.value
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free_bytes, total_bytes) for the accelerator — reference
+    `mx.context.gpu_memory_info` parity over the XLA allocator's stats
+    (SURVEY.md §2.1 "Storage manager: expose stats API")."""
+    import jax
+
+    stats = jax.devices()[device_id].memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (max(total - used, 0), total)
+
+
+def storage_stats(device_id: int = 0) -> dict:
+    """Full allocator statistics dict (pool sizes, peaks) — the
+    reference's storage-manager introspection, XLA-BFC-backed."""
+    import jax
+
+    devs = jax.devices()
+    return dict(devs[device_id].memory_stats() or {})

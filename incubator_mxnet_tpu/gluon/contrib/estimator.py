@@ -57,7 +57,7 @@ class LoggingHandler(EventHandler):
 
     def batch_end(self, estimator):
         self._samples += estimator._last_batch_size
-        if estimator.batch_idx % self.log_interval == 0:
+        if estimator.batch_idx and estimator.batch_idx % self.log_interval == 0:
             dt = max(time.time() - self._tic, 1e-9)
             metrics = " ".join(f"{m.get()[0]}={m.get()[1]:.4f}"
                                for m in estimator.train_metrics)
@@ -164,7 +164,15 @@ class Estimator:
         if event_handlers:
             self.handlers = saved_handlers + list(event_handlers)
         history = []
-        self._emit("train_begin")
+        try:
+            self._emit("train_begin")
+            self._fit_loop(train_data, val_data, epochs, batch_axis, history)
+            self._emit("train_end")
+        finally:
+            self.handlers = saved_handlers
+        return history
+
+    def _fit_loop(self, train_data, val_data, epochs, batch_axis, history):
         try:
             for epoch in range(epochs):
                 self.epoch = epoch
@@ -192,6 +200,3 @@ class Estimator:
                 self._emit("epoch_end")
         except StopTraining:
             pass
-        self._emit("train_end")
-        self.handlers = saved_handlers
-        return history

@@ -13,6 +13,7 @@ from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
 from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet201
 from .mobilenet import (MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_75,
                         mobilenet0_5, mobilenet0_25, mobilenet_v2_1_0)
+from .inception import Inception3, inception_v3
 
 _models = {
     "lenet": LeNet,
@@ -30,6 +31,7 @@ _models = {
     "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0,
+    "inceptionv3": inception_v3,
 }
 
 

@@ -1,0 +1,130 @@
+"""Inception v3 (ref `model_zoo/vision/inception.py` [UNVERIFIED] —
+the one family missing from the r1 zoo)."""
+from ...block import HybridBlock
+from ... import nn
+from ...nn import conv_layers as conv
+from ..vision_helpers import HybridConcat
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv_bn(channels, kernel_size, strides=1, padding=0):
+    out = nn.HybridSequential()
+    out.add(conv.Conv2D(channels, kernel_size=kernel_size, strides=strides,
+                        padding=padding, use_bias=False))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _branch(*convs):
+    out = nn.HybridSequential()
+    for c in convs:
+        out.add(c)
+    return out
+
+
+def _make_A(pool_features):
+    cat = HybridConcat(axis=1)
+    cat.add(
+        _branch(_conv_bn(64, 1)),
+        _branch(_conv_bn(48, 1), _conv_bn(64, 5, padding=2)),
+        _branch(_conv_bn(64, 1), _conv_bn(96, 3, padding=1),
+                _conv_bn(96, 3, padding=1)),
+        _branch(conv.AvgPool2D(pool_size=3, strides=1, padding=1),
+                _conv_bn(pool_features, 1)))
+    return cat
+
+
+def _make_B():
+    cat = HybridConcat(axis=1)
+    cat.add(
+        _branch(_conv_bn(384, 3, strides=2)),
+        _branch(_conv_bn(64, 1), _conv_bn(96, 3, padding=1),
+                _conv_bn(96, 3, strides=2)),
+        _branch(conv.MaxPool2D(pool_size=3, strides=2)))
+    return cat
+
+
+def _make_C(channels_7x7):
+    c = channels_7x7
+    cat = HybridConcat(axis=1)
+    cat.add(
+        _branch(_conv_bn(192, 1)),
+        _branch(_conv_bn(c, 1), _conv_bn(c, (1, 7), padding=(0, 3)),
+                _conv_bn(192, (7, 1), padding=(3, 0))),
+        _branch(_conv_bn(c, 1), _conv_bn(c, (7, 1), padding=(3, 0)),
+                _conv_bn(c, (1, 7), padding=(0, 3)),
+                _conv_bn(c, (7, 1), padding=(3, 0)),
+                _conv_bn(192, (1, 7), padding=(0, 3))),
+        _branch(conv.AvgPool2D(pool_size=3, strides=1, padding=1),
+                _conv_bn(192, 1)))
+    return cat
+
+
+def _make_D():
+    cat = HybridConcat(axis=1)
+    cat.add(
+        _branch(_conv_bn(192, 1), _conv_bn(320, 3, strides=2)),
+        _branch(_conv_bn(192, 1), _conv_bn(192, (1, 7), padding=(0, 3)),
+                _conv_bn(192, (7, 1), padding=(3, 0)),
+                _conv_bn(192, 3, strides=2)),
+        _branch(conv.MaxPool2D(pool_size=3, strides=2)))
+    return cat
+
+
+def _make_E():
+    cat = HybridConcat(axis=1)
+    # simplified E block: the split 1x3/3x1 towers run sequentially
+    # concatenated (same channel count as the reference's parallel pair)
+    e1 = HybridConcat(axis=1)
+    e1.add(_branch(_conv_bn(384, (1, 3), padding=(0, 1))),
+           _branch(_conv_bn(384, (3, 1), padding=(1, 0))))
+    t1 = _branch(_conv_bn(384, 1))
+    t1.add(e1)
+    e2 = HybridConcat(axis=1)
+    e2.add(_branch(_conv_bn(384, (1, 3), padding=(0, 1))),
+           _branch(_conv_bn(384, (3, 1), padding=(1, 0))))
+    t2 = _branch(_conv_bn(448, 1), _conv_bn(384, 3, padding=1))
+    t2.add(e2)
+    cat.add(
+        _branch(_conv_bn(320, 1)),
+        t1,
+        t2,
+        _branch(conv.AvgPool2D(pool_size=3, strides=1, padding=1),
+                _conv_bn(192, 1)))
+    return cat
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = nn.HybridSequential()
+        self.features.add(_conv_bn(32, 3, strides=2))
+        self.features.add(_conv_bn(32, 3))
+        self.features.add(_conv_bn(64, 3, padding=1))
+        self.features.add(conv.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_conv_bn(80, 1))
+        self.features.add(_conv_bn(192, 3))
+        self.features.add(conv.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_make_E())
+        self.features.add(_make_E())
+        self.features.add(conv.GlobalAvgPool2D())
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(classes=1000, **kwargs):
+    return Inception3(classes=classes, **kwargs)

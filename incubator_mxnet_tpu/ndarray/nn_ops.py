@@ -69,10 +69,12 @@ def FullyConnected(data, weight, bias=None, num_hidden: int = 0, flatten: bool =
 
     def f(x, w, *rest):
         xx = x.reshape(x.shape[0], -1) if flatten else x
+        if xx.dtype != w.dtype:  # mixed precision: follow the weight dtype
+            xx = xx.astype(w.dtype)
         y = jnp.dot(xx, w.T, preferred_element_type=_acc_type(xx.dtype))
-        y = y.astype(x.dtype)
+        y = y.astype(xx.dtype)
         if rest:
-            y = y + rest[0]
+            y = y + rest[0].astype(y.dtype)
         return y
 
     args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
@@ -104,6 +106,12 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         lhs_spec = "NC" + spatial
         rhs_spec = "OI" + spatial
         out_spec = lhs_spec
+        if x.dtype != w.dtype:  # mixed precision: follow the weight dtype
+            x = x.astype(w.dtype)
+        # NOTE: no preferred_element_type here — this JAX version's conv
+        # TRANSPOSE rule feeds the fp32 accumulator cotangent back into a
+        # bf16 conv and type-errors; the TPU MXU accumulates conv in fp32
+        # in hardware regardless of the HLO output dtype
         y = lax.conv_general_dilated(
             x, w,
             window_strides=stride,
@@ -111,11 +119,10 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
             rhs_dilation=dilate,
             dimension_numbers=(lhs_spec, rhs_spec, out_spec),
             feature_group_count=num_group,
-            preferred_element_type=_acc_type(x.dtype),
-        ).astype(x.dtype)
+        )
         if rest:
             b = rest[0].reshape((1, -1) + (1,) * nd)
-            y = y + b
+            y = y + b.astype(y.dtype)
         return y
 
     args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)
@@ -134,7 +141,11 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
 
     def f(x, w, *rest):
         spatial = "DHW"[-nd:]
+        if x.dtype != w.dtype:  # mixed precision: follow the weight dtype
+            x = x.astype(w.dtype)
         # conv_transpose with IO kernel spec: weight stored (Cin, Cout/g, *k)
+        # output follows the (possibly downcast-target) weight dtype,
+        # same policy as Convolution
         y = lax.conv_transpose(
             x, w,
             strides=stride,
@@ -142,9 +153,9 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
             rhs_dilation=dilate,
             dimension_numbers=("NC" + spatial, "IO" + spatial, "NC" + spatial),
             transpose_kernel=True,
-        ).astype(x.dtype)
+        )
         if rest:
-            y = y + rest[0].reshape((1, -1) + (1,) * nd)
+            y = y + rest[0].reshape((1, -1) + (1,) * nd).astype(y.dtype)
         return y
 
     args = (data, weight) if (no_bias or bias is None) else (data, weight, bias)

@@ -151,3 +151,32 @@ def test_next_key_unique():
     mx.random.seed(3)
     keys = [tuple(onp.asarray(rnd.next_key()).tolist()) for _ in range(100)]
     assert len(set(keys)) == 100  # block cache must not repeat keys
+
+
+def test_gpu_memory_info_and_storage_stats():
+    free, total = mx.context.gpu_memory_info()
+    assert free >= 0 and total >= 0
+    stats = mx.context.storage_stats()
+    assert isinstance(stats, dict)
+
+
+def test_naive_engine_nan_guard():
+    import jax.numpy as jnp2
+
+    from incubator_mxnet_tpu import runtime
+
+    with runtime.naive_engine(debug_nans=True):
+        with pytest.raises(FloatingPointError):
+            bad = jnp2.asarray([1.0, float("nan")])
+            float(jnp2.sum(bad))
+
+
+def test_inception_v3_in_zoo():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    mx.random.seed(0)
+    net = vision.get_model("inceptionv3", classes=4)
+    net.initialize()
+    out = net(NDArray(jnp.ones((1, 3, 96, 96))))
+    assert out.shape == (1, 4)
