@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# ASAN build + test of the C++ host components (SURVEY.md §5.2: the
+# reference runs sanitizer builds in CI, not in product code — same
+# here: RecordIO codec + image pipeline compile under
+# -fsanitize=address,undefined and the native IO test suite runs
+# against the instrumented libraries).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BUILD=incubator_mxnet_tpu/native/_build_asan
+mkdir -p "$BUILD"
+
+CXXFLAGS="-O1 -g -std=c++17 -shared -fPIC -pthread -fsanitize=address,undefined -fno-omit-frame-pointer"
+echo "ASAN-compiling native/recordio.cc"
+g++ $CXXFLAGS -o "$BUILD/librecordio.so" incubator_mxnet_tpu/native/recordio.cc
+echo "ASAN-compiling native/image_pipeline.cc"
+g++ $CXXFLAGS -o "$BUILD/libimage_pipeline.so" \
+    incubator_mxnet_tpu/native/image_pipeline.cc -ljpeg
+
+# point the loader at the instrumented libs and run the native IO tests.
+# leak detection off: the long-lived python process holds allocator pools.
+export MXTPU_NATIVE_BUILD_DIR="$PWD/$BUILD"
+export MXTPU_NATIVE_NO_REBUILD=1
+export ASAN_OPTIONS=detect_leaks=0
+export LD_PRELOAD="$(g++ -print-file-name=libasan.so)"
+JAX_PLATFORMS=cpu python -m pytest tests/test_native_io.py -q
+echo "ASAN native suite: OK"

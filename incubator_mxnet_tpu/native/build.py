@@ -13,7 +13,10 @@ import subprocess
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_BUILD_DIR = os.path.join(_DIR, "_build")
+# MXTPU_NATIVE_BUILD_DIR override: ci/sanitize.sh points the loader at
+# ASAN-instrumented builds without touching the normal cache
+_BUILD_DIR = os.environ.get("MXTPU_NATIVE_BUILD_DIR",
+                            os.path.join(_DIR, "_build"))
 
 
 def _source_hash(src: str, cmd_tag: str) -> str:
@@ -34,6 +37,15 @@ def load_or_build(name: str, ldflags=()) -> Optional[ctypes.CDLL]:
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
     so = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    if os.environ.get("MXTPU_NATIVE_NO_REBUILD"):
+        # sanitizer CI: load the pre-instrumented lib as-is — a missing
+        # or unloadable lib must FAIL loudly, not fall back to an
+        # uninstrumented build (which would report a clean ASAN run
+        # that sanitized nothing)
+        if not os.path.exists(so):
+            raise OSError(
+                f"MXTPU_NATIVE_NO_REBUILD set but {so} does not exist")
+        return ctypes.CDLL(so)  # OSError propagates
     hashfile = so + ".srchash"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
            "-o", so, src, *ldflags]

@@ -50,3 +50,27 @@ def test_launcher_env_mode():
     assert "MXTPU_NUM_PROCESSES=2" in proc.stdout
     assert "MXTPU_PROCESS_ID=1" in proc.stdout
     assert "DMLC_ROLE=worker" in proc.stdout
+
+
+def test_distributed_training_example():
+    """examples/distributed/train_dist.py under the launcher: 3 workers,
+    replicas must converge identically (ref cifar10_dist.py pattern)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+             "-n", "3", "--launcher", "local",
+             sys.executable,
+             os.path.join(_ROOT, "examples", "distributed", "train_dist.py"),
+             "--epochs", "1", "--samples-per-worker", "96"],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=600)
+        if proc.returncode == 0 and proc.stdout.count("replicas consistent OK") == 3:
+            return
+        # retry covers launcher/rendezvous flakes ONLY — an actual
+        # replica-divergence failure is the bug this test exists to catch
+        assert "replica divergence" not in proc.stderr, proc.stderr[-2000:]
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.count("replicas consistent OK") == 3, proc.stdout[-2000:]
