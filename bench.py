@@ -101,9 +101,13 @@ def main():
     n_params = sum(p.data().size for p in net.collect_params().values()
                    if p.grad_req != "null")
 
+    # keep_grads=False: grads are consumed inside the one fused step
+    # program, never written back to HBM (the documented perf knob —
+    # the analogue of the reference's hybridize(static_alloc=True))
     trainer = Trainer(model.collect_params(), "sgd",
                       {"learning_rate": 1e-3, "momentum": 0.9,
-                       "multi_precision": True})
+                       "multi_precision": True},
+                      keep_grads=False)
 
     key = jax.random.PRNGKey(0)
     kx, ky = jax.random.split(key)

@@ -1,0 +1,170 @@
+"""Gluon DCGAN.
+
+TPU-native rendition of the reference `example/gluon/dc_gan/dcgan.py`
+[UNVERIFIED] (SURVEY.md §2.8): DCGAN generator (Conv2DTranspose +
+BatchNorm + ReLU stack from a latent vector) and discriminator (Conv2D
++ LeakyReLU + BatchNorm) trained adversarially with the sigmoid
+binary-cross-entropy loss and Adam(beta1=0.5), alternating D and G
+updates through the canonical `autograd.record()` → `backward()` →
+`trainer.step()` loop.
+
+Data: a deterministic synthetic 32×32 image distribution (class
+templates + noise) stands in for CIFAR/LSUN — no network egress here.
+The CI gate checks both losses stay finite and the discriminator can't
+saturate to zero loss (the adversarial balance).
+
+Run: python examples/gluon/dc_gan.py --epochs 1 --max-batches 20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description="Gluon DCGAN")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--latent", type=int, default=100)
+    p.add_argument("--ngf", type=int, default=32, help="generator base width")
+    p.add_argument("--ndf", type=int, default=32, help="discriminator base width")
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--num-samples", type=int, default=640)
+    p.add_argument("--max-batches", type=int, default=0,
+                   help="stop each epoch after N batches (0 = full epoch)")
+    p.add_argument("--log-interval", type=int, default=10)
+    p.add_argument("--save-prefix", type=str, default=None)
+    return p
+
+
+def build_nets(args):
+    from incubator_mxnet_tpu.gluon import nn
+
+    # generator: z (latent,1,1) -> (3,32,32), tanh output
+    netG = nn.HybridSequential()
+    netG.add(
+        nn.Conv2DTranspose(args.ngf * 4, 4, strides=1, padding=0, use_bias=False),
+        nn.BatchNorm(), nn.Activation("relu"),          # 4x4
+        nn.Conv2DTranspose(args.ngf * 2, 4, strides=2, padding=1, use_bias=False),
+        nn.BatchNorm(), nn.Activation("relu"),          # 8x8
+        nn.Conv2DTranspose(args.ngf, 4, strides=2, padding=1, use_bias=False),
+        nn.BatchNorm(), nn.Activation("relu"),          # 16x16
+        nn.Conv2DTranspose(3, 4, strides=2, padding=1, use_bias=False),
+        nn.Activation("tanh"),                          # 32x32
+    )
+    # discriminator: (3,32,32) -> 1 logit
+    netD = nn.HybridSequential()
+    netD.add(
+        nn.Conv2D(args.ndf, 4, strides=2, padding=1, use_bias=False),
+        nn.LeakyReLU(0.2),                              # 16x16
+        nn.Conv2D(args.ndf * 2, 4, strides=2, padding=1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),              # 8x8
+        nn.Conv2D(args.ndf * 4, 4, strides=2, padding=1, use_bias=False),
+        nn.BatchNorm(), nn.LeakyReLU(0.2),              # 4x4
+        nn.Conv2D(1, 4, strides=1, padding=0, use_bias=False),
+        nn.Flatten(),
+    )
+    return netG, netD
+
+
+def real_batches(args):
+    """Deterministic synthetic image distribution in [-1, 1], NCHW."""
+    import numpy as onp
+
+    from incubator_mxnet_tpu.gluon.data import DataLoader
+    from incubator_mxnet_tpu.gluon.data.vision import SyntheticImageDataset
+
+    ds = SyntheticImageDataset(num_samples=args.num_samples, num_classes=4,
+                               shape=(3, 32, 32), noise=0.2, seed=3,
+                               template_seed=11)
+    # dataset yields HWC; normalize to [-1,1] CHW to match tanh output
+    def tf(x, y):
+        import jax.numpy as jnp
+
+        from incubator_mxnet_tpu.ndarray.ndarray import NDArray, raw
+
+        a = raw(x).transpose(2, 0, 1)
+        a = jnp.tanh(a)  # squash template+noise into (-1, 1)
+        return NDArray(a), y
+
+    ds._transform = tf
+    return DataLoader(ds, batch_size=args.batch_size, shuffle=True,
+                      last_batch="discard")
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, loss as gloss
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    mx.random.seed(0)
+    netG, netD = build_nets(args)
+    netG.initialize(mx.init.Normal(0.02))
+    netD.initialize(mx.init.Normal(0.02))
+
+    # materialize deferred shapes, then hybridize
+    z0 = NDArray(jnp.zeros((args.batch_size, args.latent, 1, 1), jnp.float32))
+    netD(netG(z0))
+    netG.hybridize()
+    netD.hybridize()
+
+    loss_fn = gloss.SigmoidBinaryCrossEntropyLoss()
+    trainerG = Trainer(netG.collect_params(), "adam",
+                       {"learning_rate": args.lr, "beta1": args.beta1})
+    trainerD = Trainer(netD.collect_params(), "adam",
+                       {"learning_rate": args.lr, "beta1": args.beta1})
+
+    ones = NDArray(jnp.ones((args.batch_size, 1), jnp.float32))
+    zeros = NDArray(jnp.zeros((args.batch_size, 1), jnp.float32))
+
+    key = jax.random.PRNGKey(1)
+    hist = []
+    for epoch in range(args.epochs):
+        t0, seen = time.time(), 0
+        for bi, (real, _) in enumerate(real_batches(args)):
+            if args.max_batches and bi >= args.max_batches:
+                break
+            key, kz1, kz2 = jax.random.split(key, 3)
+            z = NDArray(jax.random.normal(kz1, (args.batch_size, args.latent, 1, 1)))
+
+            # --- update D: maximize log D(x) + log(1 - D(G(z))) ---
+            fake = netG(z).detach()
+            with autograd.record():
+                out_real = netD(real)
+                out_fake = netD(fake)
+                lossD = (loss_fn(out_real, ones) + loss_fn(out_fake, zeros)).mean()
+            lossD.backward()
+            trainerD.step(1)
+
+            # --- update G: maximize log D(G(z)) ---
+            z = NDArray(jax.random.normal(kz2, (args.batch_size, args.latent, 1, 1)))
+            with autograd.record():
+                lossG = loss_fn(netD(netG(z)), ones).mean()
+            lossG.backward()
+            trainerG.step(1)
+
+            seen += args.batch_size
+            if bi % args.log_interval == 0:
+                d, g = float(lossD.asnumpy()), float(lossG.asnumpy())
+                hist.append((d, g))
+                print(f"epoch {epoch} batch {bi} lossD {d:.3f} lossG {g:.3f} "
+                      f"({seen / (time.time() - t0):.0f} img/s)")
+    if args.save_prefix:
+        netG.save_parameters(args.save_prefix + "-G.params")
+        netD.save_parameters(args.save_prefix + "-D.params")
+    return hist
+
+
+if __name__ == "__main__":
+    main()

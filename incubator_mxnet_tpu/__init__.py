@@ -56,12 +56,17 @@ from . import numpy_extension as npx  # DL extensions (mx.npx)
 mod = None  # legacy Module API lives in .module
 from . import module  # noqa: E402
 mod = module
+from . import visualization  # noqa: E402
+viz = visualization
+from . import monitor as _monitor_mod  # noqa: E402
+mon = _monitor_mod
 
 __all__ = [
     "nd", "np", "npx", "sym", "symbol", "gluon", "autograd", "optimizer",
     "lr_scheduler", "initializer", "init", "metric", "kvstore", "kv", "io",
     "recordio", "image", "profiler", "amp", "parallel", "ops", "models",
     "runtime", "module", "mod", "random", "callback", "test_utils",
+    "visualization", "viz", "mon",
     "Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
     "num_gpus", "num_tpus", "NDArray", "MXNetError",
 ]

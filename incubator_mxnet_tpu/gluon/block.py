@@ -83,6 +83,7 @@ class Block:
         self._child_counters: Dict[str, int] = {}
         self._forward_hooks: List = []
         self._forward_pre_hooks: List = []
+        self._monitors: List = []  # mx.mon.Monitor instances (install())
 
     # -- attribute magic ------------------------------------------------ #
     def __setattr__(self, name, value):
@@ -248,6 +249,15 @@ class Block:
         return s + ")"
 
 
+def _grads_not_kept():
+    from ..base import MXNetError
+
+    raise MXNetError(
+        "This gradient was consumed inside a fused Trainer step and never "
+        "materialized (Trainer(..., keep_grads=False)). Construct the "
+        "Trainer with keep_grads=True to read p.grad() after step().")
+
+
 class _PendingStep:
     """A deferred hybridized step (engine.py lazy composition).
 
@@ -256,16 +266,16 @@ class _PendingStep:
     program.  Values materialize through LazyRef cells on demand.
     """
 
-    __slots__ = ("block", "training", "none_mask", "train_raws", "aux_raws",
+    __slots__ = ("block", "training", "arg_tree", "train_raws", "aux_raws",
                  "rng", "rng_ctr", "input_raws", "out_treedef", "out_avals",
                  "out_cells", "aux_params", "aux_cells", "fwd_done", "pullback",
                  "bwd_requested", "bwd_done", "grad_cells", "n_train")
 
-    def __init__(self, block, training, none_mask, train_raws, aux_raws, rng,
+    def __init__(self, block, training, arg_tree, train_raws, aux_raws, rng,
                  rng_ctr, input_raws, out_treedef, out_avals, aux_params):
         self.block = block
         self.training = training
-        self.none_mask = none_mask
+        self.arg_tree = arg_tree
         self.train_raws = train_raws
         self.aux_raws = aux_raws
         self.rng = rng
@@ -294,7 +304,7 @@ class _PendingStep:
             if p._data_nd._lazy is cell:
                 p._data_nd._data = a
         out_raws, new_aux, pullback = blk._cached_fwd_record(
-            self.training, self.none_mask, self.train_raws, self.aux_raws,
+            self.training, self.arg_tree, self.train_raws, self.aux_raws,
             self.rng, self.rng_ctr, self.input_raws)
         leaves = jax.tree_util.tree_leaves(out_raws)
         for cell, v in zip(self.out_cells, leaves):
@@ -333,7 +343,11 @@ class _PendingStep:
         self.bwd_done = True
 
     def fill_from_full_step(self, out_leaves, new_aux, grads):
-        """Called by Trainer after the fused single-program step ran."""
+        """Called by Trainer after the fused single-program step ran.
+
+        ``grads=None`` means the Trainer ran with ``keep_grads=False``
+        (gradients were consumed inside the fused program, never
+        materialized): reading ``p.grad()`` afterwards raises."""
         for cell, v in zip(self.out_cells, out_leaves):
             cell.value = v
         for p, cell, v in zip(self.aux_params, self.aux_cells, new_aux):
@@ -342,7 +356,10 @@ class _PendingStep:
                 p._data_nd._data = v
         for pos, cell in self.grad_cells.items():
             if pos < self.n_train:
-                cell.value = grads[pos]
+                if grads is None:
+                    cell.force_fn = _grads_not_kept
+                else:
+                    cell.value = grads[pos]
         self.fwd_done = True
         self.bwd_done = True
         self.pullback = None
@@ -427,25 +444,25 @@ class HybridBlock(Block):
         self._cached_param_order = (trainable, aux)
         apply_fn = _make_apply_fn(self, trainable, aux, call_forward=True)
 
-        def raw_fn(training: bool, none_mask: Tuple, train_raws: Tuple,
+        def raw_fn(training: bool, arg_tree, train_raws: Tuple,
                    aux_raws: Tuple, rng_key, rng_ctr, *input_raws):
-            # none_mask marks positional args that were None at call time
-            # (e.g. optional token_types/valid_length) — static, part of
-            # the jit cache key like any shape/dtype signature change.
+            # arg_tree is the treedef of the positional args — forward
+            # may take nested lists/tuples/dicts of arrays (RNN state
+            # lists, optional None args like token_types).  Static, part
+            # of the jit cache key like any shape/dtype change.
             # rng_ctr is folded in HERE so callers pass a stable base key
             # + a python counter: zero eager RNG dispatches per step.
-            it = iter(input_raws)
-            full = [None if m else next(it) for m in none_mask]
+            full = jax.tree_util.tree_unflatten(arg_tree, list(input_raws))
             key = jax.random.fold_in(rng_key, rng_ctr)
             return apply_fn(train_raws, aux_raws, key, *full,
                             training=training)
 
         self._cached_fn = jax.jit(raw_fn, static_argnums=(0, 1))
 
-        def grad_fn(training, none_mask, train_raws, aux_raws, rng, rng_ctr,
+        def grad_fn(training, arg_tree, train_raws, aux_raws, rng, rng_ctr,
                     input_raws, cots):
             def f(tr, ins):
-                out, _new_aux = raw_fn(training, none_mask, tr, aux_raws,
+                out, _new_aux = raw_fn(training, arg_tree, tr, aux_raws,
                                        rng, rng_ctr, *ins)
                 return out
 
@@ -458,10 +475,10 @@ class HybridBlock(Block):
         # FLOPs-for-HBM trade, opt-in via hybridize(remat_backward=True))
         self._cached_grad = jax.jit(grad_fn, static_argnums=(0, 1))
 
-        def fwd_record_fn(training, none_mask, train_raws, aux_raws, rng,
+        def fwd_record_fn(training, arg_tree, train_raws, aux_raws, rng,
                           rng_ctr, input_raws):
             def f(tr, ins):
-                return raw_fn(training, none_mask, tr, aux_raws,
+                return raw_fn(training, arg_tree, tr, aux_raws,
                               rng, rng_ctr, *ins)  # (out, new_aux)
 
             out, pullback, new_aux = jax.vjp(
@@ -483,8 +500,8 @@ class HybridBlock(Block):
         trainable, aux = self._cached_param_order
         train_raws = tuple(p._data_nd._data for p in trainable)
         aux_raws = tuple(p._data_nd._data for p in aux)
-        none_mask = tuple(a is None for a in args)
-        input_nds = [wrap(a) for a in args if a is not None]
+        args_leaves, arg_tree = jax.tree_util.tree_flatten(args)
+        input_nds = [wrap(a) for a in args_leaves]
         input_raws = [a._data for a in input_nds]
         rng, rng_ctr = _random.step_key()
         training = _tape.is_training()
@@ -492,14 +509,14 @@ class HybridBlock(Block):
 
         recording = _tape.is_recording()
         if not recording:
-            out_raws, new_aux = fn(training, none_mask, train_raws, aux_raws,
+            out_raws, new_aux = fn(training, arg_tree, train_raws, aux_raws,
                                    rng, rng_ctr, *input_raws)
             for p, r in zip(aux, new_aux):
                 p._data_nd._data = r
             return jax.tree_util.tree_map(NDArray, out_raws)
 
         if self._remat_backward:
-            return self._record_remat(training, none_mask, trainable, aux,
+            return self._record_remat(training, arg_tree, trainable, aux,
                                       train_raws, aux_raws, rng, rng_ctr,
                                       input_nds, input_raws)
 
@@ -508,21 +525,21 @@ class HybridBlock(Block):
         # pending step.  Trainer.step() may compile the whole
         # fwd+backward+update as one donated program; any eager value
         # access instead forces the staged fwd/bwd jits.
-        sig = (training, none_mask,
+        sig = (training, arg_tree,
                tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
         spec = self._aval_cache.get(sig)
         if spec is None:
             import functools
 
             out_shape, aux_shape = jax.eval_shape(
-                functools.partial(fn, training, none_mask),
+                functools.partial(fn, training, arg_tree),
                 train_raws, aux_raws, rng, rng_ctr, *input_raws)
             leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
             spec = (treedef, leaves_avals)
             self._aval_cache[sig] = spec
         treedef, out_avals = spec
 
-        pending = _PendingStep(self, training, none_mask, train_raws, aux_raws,
+        pending = _PendingStep(self, training, arg_tree, train_raws, aux_raws,
                                rng, rng_ctr, input_raws, treedef, out_avals, aux)
         # aux params go lazy too: they are rebound to cells the pending
         # fills (a read before the step forces the staged forward)
@@ -558,10 +575,10 @@ class HybridBlock(Block):
         _tape.append_node(node)
         return jax.tree_util.tree_unflatten(treedef, out_nds)
 
-    def _record_remat(self, training, none_mask, trainable, aux, train_raws,
+    def _record_remat(self, training, arg_tree, trainable, aux, train_raws,
                       aux_raws, rng, rng_ctr, input_nds, input_raws):
         """Eager recording with rematerializing backward (long-context mode)."""
-        out_raws, new_aux = self._cached_fn(training, none_mask, train_raws,
+        out_raws, new_aux = self._cached_fn(training, arg_tree, train_raws,
                                             aux_raws, rng, rng_ctr, *input_raws)
         for p, r in zip(aux, new_aux):
             p._data_nd._data = r
@@ -581,7 +598,7 @@ class HybridBlock(Block):
             cts = tuple(c.astype(dt) if c.dtype != dt else c
                         for c, dt in zip(cts, out_dtypes))
             cot_tree = jax.tree_util.tree_unflatten(treedef, list(cts))
-            d_train, d_ins = cached_grad(training, none_mask, train_raws,
+            d_train, d_ins = cached_grad(training, arg_tree, train_raws,
                                          aux_raws, rng, rng_ctr,
                                          tuple(input_raws), cot_tree)
             return tuple(d_train) + tuple(d_ins)
@@ -593,7 +610,10 @@ class HybridBlock(Block):
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        if self._active and not kwargs:
+        # an activated Monitor forces the eager path so per-layer hooks
+        # fire (the compiled cached-op never re-enters child Python)
+        monitored = any(m.activated for m in self._monitors)
+        if self._active and not kwargs and not monitored:
             out = self._call_cached_op(*args)
         else:
             out = self.forward(*args, **kwargs)
@@ -704,7 +724,9 @@ def _make_apply_fn(block: Block, trainable: List[Parameter], aux: List[Parameter
                 p._data_nd._data = r
             with _random.TraceKeyProvider(rng_key):
                 fn = block.forward if call_forward else block
-                outs = fn(*[wrap(i) if i is not None else None
+                # args may be nested pytrees of raws (RNN state lists);
+                # wrap every array leaf, preserve the structure
+                outs = fn(*[jax.tree_util.tree_map(wrap, i)
                             for i in input_raws])
             out_raws = jax.tree_util.tree_map(
                 raw, outs, is_leaf=lambda v: isinstance(v, NDArray))

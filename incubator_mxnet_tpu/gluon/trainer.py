@@ -38,7 +38,8 @@ class Trainer:
     def __init__(self, params: Union[ParameterDict, List[Parameter], Dict],
                  optimizer, optimizer_params: Optional[dict] = None,
                  kvstore="device", compression_params=None, update_on_kvstore=None,
-                 fuse_step: bool = True, donate: bool = True):
+                 fuse_step: bool = True, donate: bool = True,
+                 keep_grads: bool = True, max_inflight_steps: int = 8):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -72,6 +73,18 @@ class Trainer:
         self._fused_key = None
         self._fullstep_ctx = None
         self._states_stale = False
+        # keep_grads=False: the single-program step does NOT materialize
+        # gradients as program outputs (saves one full-model HBM write
+        # per step); reading p.grad() after step() then raises.
+        self._keep_grads = keep_grads
+        # Async dispatch run-ahead cap: every queued step holds its
+        # output buffers (grads/new states) until it retires, so an
+        # unbounded enqueue loop exhausts HBM.  The dependency-engine
+        # equivalence of the reference's bounded engine queue.
+        self._max_inflight = max(1, int(max_inflight_steps))
+        from collections import deque
+
+        self._inflight = deque()
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -135,6 +148,9 @@ class Trainer:
         needs_rng = opt.needs_rng
 
         def stacked(weights, grads, states, ts, lr, wd, rescale, keys):
+            # ts is a single stacked (N,) array and keys a stacked (N,2)
+            # array — ONE host transfer each per step, not N tiny ones
+            # (which dominate step latency over a remote device link)
             new_w, new_s = [], []
             for j in range(len(weights)):
                 k = keys[j] if needs_rng else None
@@ -148,18 +164,37 @@ class Trainer:
         return stacked
 
     def _step_scalars(self, idxs):
-        """Advance update counts; return traced (per-index ts, lr, keys)."""
+        """Advance update counts; return traced (per-index ts, lr, keys).
+
+        ts/keys are stacked into single device arrays so each step pays
+        one host→device transfer, not one per parameter (~400 for BERT)."""
+        import jax.numpy as jnp
+
         opt = self._optimizer
         for i in idxs:
             opt._update_count(i)
-        ts = tuple(float(opt._index_update_count[i]) for i in idxs)
+        ts = jnp.asarray([float(opt._index_update_count[i]) for i in idxs],
+                         jnp.float32)
         lr = opt.lr_scheduler(opt.num_update) if opt.lr_scheduler is not None else opt.lr
         keys = None
         if opt.needs_rng:
             from .. import random as _random
 
-            keys = tuple(_random.next_key() for _ in idxs)
+            keys = jnp.stack([_random.next_key() for _ in idxs])
         return ts, lr, keys
+
+    def _throttle(self, leaf):
+        """Bound async run-ahead: each queued step holds its output
+        buffers until it retires, so an unthrottled enqueue loop OOMs.
+        Blocks on the (max_inflight)-steps-old leaf; a leaf that was
+        donated into a later step is already consumed — skip it."""
+        self._inflight.append(leaf)
+        while len(self._inflight) > self._max_inflight:
+            old = self._inflight.popleft()
+            try:
+                jax.block_until_ready(old)
+            except Exception:
+                pass  # donated/deleted buffer: the pipeline moved past it
 
     def _fused_step(self):
         opt = self._optimizer
@@ -178,18 +213,32 @@ class Trainer:
                     self._states[i] = opt.create_state_multi_precision(
                         i, self._params[i].data())
             donate = (0, 2) if self._donate else ()
-            self._fused_fn = jax.jit(
-                self._make_stacked_update(lr_mults, wd_mults, clip),
-                donate_argnums=donate)
+            stacked = self._make_stacked_update(lr_mults, wd_mults, clip)
+
+            def stacked_with_sync(*a):
+                import jax.numpy as jnp
+
+                nw, ns = stacked(*a)
+                # tiny NON-donated output depending on the update: the
+                # throttle's sync leaf (every other output is a donated
+                # alias, which block_until_ready can't wait on)
+                sync = nw[0].ravel()[0].astype(jnp.float32) if nw \
+                    else jnp.float32(0)
+                return nw, ns, sync
+
+            self._fused_fn = jax.jit(stacked_with_sync, donate_argnums=donate)
         ts, lr, keys = self._step_scalars(idxs)
         weights = tuple(self._params[i]._data_nd._data for i in idxs)
         grads = tuple(raw(self._params[i].grad()) for i in idxs)
         states = tuple(self._states[i] for i in idxs)
-        new_w, new_s = self._fused_fn(weights, grads, states, ts, lr, opt.wd,
-                                      opt.rescale_grad, keys)
+        new_w, new_s, sync = self._fused_fn(weights, grads, states, ts, lr,
+                                            opt.wd, opt.rescale_grad, keys)
         for i, nw, ns in zip(idxs, new_w, new_s):
             self._params[i]._data_nd._data = nw
             self._states[i] = ns
+        # this path always materializes grads (backward wrote them), so
+        # run-ahead always holds model-sized buffers: always throttle
+        self._throttle(sync)
 
     # ------------------------------------------------------------------ #
     # public step API
@@ -243,7 +292,7 @@ class Trainer:
         idx_of = ctx["idx_of"] if ctx is not None else None
         mults = self._mults_key(idx_of) if idx_of is not None else None
         sig = (id(block), block._cache_version, pending.training,
-               pending.none_mask,
+               pending.arg_tree,
                tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
         if ctx is None or ctx["sig"] != sig or ctx["mults"] != mults:
             ctx = self._prepare_full_step(pending, sig)
@@ -257,7 +306,15 @@ class Trainer:
             pending.train_raws, pending.aux_raws, states, pending.rng,
             pending.rng_ctr, pending.input_raws, ts, lr, opt.wd,
             opt.rescale_grad, keys)
-        pending.fill_from_full_step(out_leaves, new_aux, grads)
+        pending.fill_from_full_step(out_leaves, new_aux,
+                                    grads if self._keep_grads else None)
+        if self._keep_grads:
+            # bound the dispatch queue (see __init__): every queued step
+            # holds its grads outputs (~model size) until it retires.
+            # With keep_grads=False all outputs are donated aliases or
+            # scalars, so unbounded run-ahead is harmless — skip the
+            # sync, which costs a round-trip on relayed devices.
+            self._throttle(out_leaves[0] if out_leaves else new_w[0])
         for nd, nw in zip(ctx["nds"], new_w):
             nd._data = nw
         ctx["states"] = new_s
@@ -308,13 +365,14 @@ class Trainer:
 
         block = pending.block
         raw_fn_jit = block._cached_fn  # jitted; inlines when traced inside jit
-        training, none_mask = pending.training, pending.none_mask
+        training, arg_tree = pending.training, pending.arg_tree
         stacked = self._make_stacked_update(*mults)
+        keep_grads = self._keep_grads
 
         def full(train_raws, aux_raws, states, rng, rng_ctr, input_raws, ts,
                  lr, wd, rescale, keys):
             def f(tr):
-                out, new_aux = raw_fn_jit(training, none_mask, tr, aux_raws,
+                out, new_aux = raw_fn_jit(training, arg_tree, tr, aux_raws,
                                           rng, rng_ctr, *input_raws)
                 return out, new_aux
 
@@ -324,7 +382,8 @@ class Trainer:
             new_w, new_s = stacked(train_raws, grads, states, ts, lr, wd,
                                    rescale, keys)
             out_leaves = jax.tree_util.tree_leaves(out)
-            return (tuple(out_leaves), new_aux, tuple(grads), new_w, new_s)
+            out_grads = tuple(grads) if keep_grads else ()
+            return (tuple(out_leaves), new_aux, out_grads, new_w, new_s)
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(full, donate_argnums=donate)

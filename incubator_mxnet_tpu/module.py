@@ -118,6 +118,11 @@ class Module(BaseModule):
         self._outputs = None
         self._label_key = self._label_names[0] if self._label_names else None
         self._loss_fn = None
+        self._monitor = None
+
+    def install_monitor(self, mon):
+        """Attach an `mx.mon.Monitor`: per-op output stats each forward."""
+        self._monitor = mon
 
     # -- binding --------------------------------------------------------- #
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -185,7 +190,8 @@ class Module(BaseModule):
                 bindings[name] = wrap(arr)
         from . import symbol as sym_mod
 
-        out = sym_mod.evaluate(self._symbol, bindings)
+        observer = self._monitor.as_observer() if self._monitor else None
+        out = sym_mod.evaluate(self._symbol, bindings, observer=observer)
         self._outputs = out if isinstance(out, list) else [out]
         self._last_bindings = bindings
 

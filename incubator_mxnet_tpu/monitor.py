@@ -1,0 +1,127 @@
+"""Training monitor — `mx.mon.Monitor`.
+
+Re-design of the reference `python/mxnet/monitor.py` [UNVERIFIED]
+(SURVEY.md §2.6): periodically capture statistics of layer
+outputs/inputs during forward passes for debugging (exploding
+activations, dead relus, NaN hunting).
+
+The reference installs a C-API callback on every executor op output;
+the TPU-native equivalent hooks Gluon Blocks' forward hooks (eager or
+hybridized — hooks fire at Python call level) and the Symbol
+`Executor` via `install_monitor`.  Same public surface: ``Monitor(
+interval, stat_func, pattern, sort)``, ``install``, ``tic``, ``toc``,
+``toc_print``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr):
+    """|x|_1 / size — the reference's default norm statistic."""
+    import numpy as onp
+
+    a = onp.asarray(arr)
+    return float(onp.abs(a).sum() / max(a.size, 1))
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False,
+                 monitor_all: bool = False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, object]] = []
+        self._installed = []
+
+    # -- installation ---------------------------------------------------- #
+    def install(self, target, name: Optional[str] = None):
+        """Install on a Gluon Block (recursively) or a symbol Executor."""
+        from .gluon.block import Block
+        from .symbol.symbol import Executor
+
+        if isinstance(target, Block):
+            self._install_block(target, name or type(target).__name__)
+        elif isinstance(target, Executor):
+            target._monitor = self
+        else:
+            raise TypeError(f"Monitor.install: unsupported target {type(target)}")
+        return self
+
+    def _install_block(self, block, prefix: str):
+        mon = self
+
+        def make_hook(bname):
+            def hook(blk, args, out=None):
+                if not mon.activated:
+                    return
+                mon._capture_tree(bname + "_output", out)
+                if mon.monitor_all:
+                    mon._capture_tree(bname + "_input", args)
+
+            return hook
+
+        block.register_forward_hook(make_hook(prefix))
+        # registering the monitor forces the eager path while activated,
+        # so child hooks fire even on hybridized nets (Block.__call__)
+        block._monitors.append(self)
+        for cname, child in getattr(block, "_children", {}).items():
+            self._install_block(child, f"{prefix}.{cname}")
+
+    def as_observer(self):
+        """Per-op-output callback for graph evaluators (Executor/Module),
+        or None while inactive."""
+        if not self.activated:
+            return None
+        return lambda name, val: self._capture_tree(name + "_output", val)
+
+    # -- capture ---------------------------------------------------------- #
+    def _capture_tree(self, name: str, val):
+        import jax
+
+        from .ndarray.ndarray import NDArray
+
+        leaves = jax.tree_util.tree_leaves(
+            val, is_leaf=lambda v: isinstance(v, NDArray))
+        for i, leaf in enumerate(leaves):
+            nm = name if len(leaves) == 1 else f"{name}{i}"
+            if not self.re_pattern.match(nm):
+                continue
+            try:
+                arr = leaf.asnumpy() if isinstance(leaf, NDArray) else leaf
+                self.queue.append((self.step, nm, self.stat_func(arr)))
+            except Exception:
+                pass  # lazy/aborted values never block training
+
+    # -- control ----------------------------------------------------------- #
+    def tic(self):
+        """Start collecting for this step if the interval hits."""
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        return self
+
+    def toc(self) -> List[Tuple[int, str, object]]:
+        """Stop collecting; returns [(step, name, stat), ...]."""
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        res = list(self.queue)
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.step += 1
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print(f"Batch: {step:7d} {name:40s} {stat}")

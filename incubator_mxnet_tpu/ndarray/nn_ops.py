@@ -143,16 +143,29 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
         spatial = "DHW"[-nd:]
         if x.dtype != w.dtype:  # mixed precision: follow the weight dtype
             x = x.astype(w.dtype)
-        # conv_transpose with IO kernel spec: weight stored (Cin, Cout/g, *k)
-        # output follows the (possibly downcast-target) weight dtype,
-        # same policy as Convolution
-        y = lax.conv_transpose(
-            x, w,
-            strides=stride,
-            padding=[(p, p - a) for p, a in zip(pad, adj)],
+        # Weight stored (Cin, Cout/g, *k) — the reference layout.  The
+        # transposed conv is computed directly as a dilated conv: dilate
+        # the input by `stride`, flip the kernel spatially and swap its
+        # in/out channel roles (per group), then convolve stride-1.
+        # out = stride*(i-1) + dilate*(k-1) + 1 - 2*pad + adj, matching
+        # deconvolution-inl.h.
+        cin, coutg = w.shape[0], w.shape[1]
+        ksz = w.shape[2:]
+        g = num_group
+        wt = w.reshape((g, cin // g, coutg) + ksz)
+        wt = jnp.swapaxes(wt, 1, 2).reshape((g * coutg, cin // g) + ksz)
+        wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
+        padding = [(dilate[i] * (ksz[i] - 1) - pad[i],
+                    dilate[i] * (ksz[i] - 1) - pad[i] + adj[i])
+                   for i in range(nd)]
+        y = lax.conv_general_dilated(
+            x, wt,
+            window_strides=(1,) * nd,
+            padding=padding,
+            lhs_dilation=stride,
             rhs_dilation=dilate,
-            dimension_numbers=("NC" + spatial, "IO" + spatial, "NC" + spatial),
-            transpose_kernel=True,
+            dimension_numbers=("NC" + spatial, "OI" + spatial, "NC" + spatial),
+            feature_group_count=g,
         )
         if rest:
             y = y + rest[0].reshape((1, -1) + (1,) * nd).astype(y.dtype)

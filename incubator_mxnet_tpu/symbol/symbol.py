@@ -137,7 +137,12 @@ class Symbol:
     def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs) -> "Executor":
         import jax.numpy as jnp
 
-        args = {name: NDArray(jnp.zeros(shape_kwargs.get(name, (1,)), jnp.float32))
+        known = {k: tuple(v) for k, v in shape_kwargs.items()}
+        try:  # infer implicit layer-param shapes from the data shapes
+            shapes = infer_param_shapes(self, known)
+        except Exception:  # inference is best-effort; fall back to (1,)
+            shapes = known
+        args = {name: NDArray(jnp.zeros(shapes.get(name, (1,)), jnp.float32))
                 for name in self.list_arguments()}
         return Executor(self, args, grad_req=grad_req)
 
@@ -244,15 +249,26 @@ def _node_call(s: Symbol, ins):
     return fn(*ins, *pos, **kwargs)
 
 
-def evaluate(sym: Symbol, bindings: Dict[str, Any]):
-    """Interpret the DAG through the nd namespace."""
+def evaluate(sym: Symbol, bindings: Dict[str, Any], observer=None):
+    """Interpret the DAG through the nd namespace.
+
+    `observer(name, value)` is called on every op node's output — the
+    executor-monitor hook (ref MXExecutorSetMonitorCallback)."""
 
     def leaf(s):
         if s._name not in bindings:
             raise MXNetError(f"unbound symbol variable {s._name!r}")
         return wrap(bindings[s._name])
 
-    return _interpret(sym, leaf, _node_call)
+    if observer is None:
+        return _interpret(sym, leaf, _node_call)
+
+    def call_and_observe(s, ins):
+        out = _node_call(s, ins)
+        observer(s._name, out)
+        return out
+
+    return _interpret(sym, leaf, call_and_observe)
 
 
 def infer_param_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
@@ -331,11 +347,17 @@ class Executor:
         self.grad_dict = {k: None for k in self.arg_dict}
         self.outputs: List[NDArray] = []
         self._grad_fn = None
+        self._monitor = None  # mx.mon.Monitor, via monitor.install(exe)
+
+    def set_monitor_callback(self, monitor):
+        """Reference MXExecutorSetMonitorCallback parity."""
+        self._monitor = monitor
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             self.arg_dict[k] = wrap(v)
-        out = evaluate(self.sym, self.arg_dict)
+        observer = self._monitor.as_observer() if self._monitor else None
+        out = evaluate(self.sym, self.arg_dict, observer=observer)
         self.outputs = out if isinstance(out, list) else [out]
         return self.outputs
 

@@ -1,0 +1,193 @@
+"""Network visualization — `mx.viz`.
+
+Re-design of the reference `python/mxnet/visualization.py` [UNVERIFIED]
+(SURVEY.md §2.6 frontend surface): `print_summary` walks the Symbol DAG
+and prints a Keras-style layer table with output shapes and parameter
+counts (shape inference via the abstract `infer_param_shapes` pass);
+`plot_network` emits a Graphviz DOT description (returned as a string
+object with `.source` / `.render()`, so code written against the
+reference's graphviz return type keeps working without the graphviz
+package installed).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+__all__ = ["print_summary", "plot_network"]
+
+
+_OP_STYLE = {
+    "FullyConnected": ("#fb8072", "box"),
+    "Convolution": ("#fb8072", "box"),
+    "Deconvolution": ("#fb8072", "box"),
+    "Activation": ("#ffffb3", "box"),
+    "relu": ("#ffffb3", "box"),
+    "sigmoid": ("#ffffb3", "box"),
+    "tanh": ("#ffffb3", "box"),
+    "BatchNorm": ("#bebada", "box"),
+    "LayerNorm": ("#bebada", "box"),
+    "Pooling": ("#80b1d3", "box"),
+    "softmax": ("#fccde5", "box"),
+    "SoftmaxOutput": ("#fccde5", "box"),
+    "Embedding": ("#8dd3c7", "box"),
+    "Dropout": ("#fdb462", "box"),
+    "Concat": ("#b3de69", "box"),
+    "null": ("#8dd3c7", "oval"),
+}
+
+
+def _topo_nodes(symbol):
+    """All nodes of the DAG, inputs-before-users."""
+    return list(symbol.get_internals())
+
+
+def _node_output_shapes(symbol, shape: Optional[Dict[str, tuple]]):
+    """Per-node output shape via abstract interpretation; {} on failure."""
+    if not shape:
+        return {}
+    import jax
+
+    from .symbol.symbol import evaluate, infer_param_shapes
+
+    try:
+        var_shapes = infer_param_shapes(symbol, shape)
+        import jax.numpy as jnp
+
+        shapes = {n: s for n, s in var_shapes.items()}
+
+        def observe(name, val):
+            o = val[0] if isinstance(val, list) else val
+            shapes[name] = tuple(o.shape)
+
+        def run():  # ONE abstract pass over the DAG, observer per node
+            bindings = {n: jnp.zeros(s, jnp.float32)
+                        for n, s in var_shapes.items()}
+            evaluate(symbol, bindings, observer=observe)
+            return jnp.zeros(())
+
+        jax.eval_shape(run)
+        return shapes
+    except Exception:
+        return {}
+
+
+def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
+                  line_length: int = 98, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a Keras-style summary table of the symbolic graph.
+
+    `shape`: dict of input-variable name → shape (e.g. ``{"data":
+    (1, 3, 224, 224)}``) enabling output-shape and parameter counting.
+    Returns total parameter count."""
+    from .symbol.symbol import infer_param_shapes
+
+    out_shapes = _node_output_shapes(symbol, shape)
+    var_shapes: Dict[str, tuple] = {}
+    if shape:
+        try:
+            var_shapes = infer_param_shapes(symbol, shape)
+        except Exception:
+            var_shapes = dict(shape)
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(vals):
+        line = ""
+        for i, v in enumerate(vals):
+            line += str(v)
+            line = line[: positions[i] - 1].ljust(positions[i])
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+
+    total = 0
+    known_inputs = set(shape or ())
+    for node in _topo_nodes(symbol):
+        if node.op is None and node._name not in known_inputs:
+            continue  # parameter variables are counted with their layer
+        n_params = 0
+        if node.op is not None:
+            for inp in node.inputs:
+                if inp.op is None and inp._name not in known_inputs:
+                    s = var_shapes.get(inp._name)
+                    if s:
+                        n = 1
+                        for d in s:
+                            n *= int(d)
+                        n_params += n
+        total += n_params
+        oshape = out_shapes.get(node._name, "")
+        prev = ",".join(i._name for i in node.inputs
+                        if not (i.op is None and i._name not in known_inputs))
+        print_row([f"{node._name} ({node.op or 'Variable'})",
+                   oshape, n_params, prev])
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("_" * line_length)
+    return total
+
+
+class _Dot:
+    """Minimal graphviz-Digraph stand-in: holds DOT source, can render."""
+
+    def __init__(self, source: str, title: str):
+        self.source = source
+        self._title = title
+
+    def render(self, filename: Optional[str] = None, format: str = "dot"):
+        fname = (filename or self._title) + "." + format
+        if format not in ("dot", "gv"):
+            fname = (filename or self._title) + ".dot"
+        with open(fname, "w") as f:
+            f.write(self.source)
+        return fname
+
+    def _repr_mimebundle_(self, **kwargs):  # notebook display parity
+        return {"text/plain": self.source}
+
+    def __str__(self):
+        return self.source
+
+
+def plot_network(symbol, title: str = "plot",
+                 shape: Optional[Dict[str, tuple]] = None,
+                 node_attrs: Optional[dict] = None, hide_weights: bool = True):
+    """Build a Graphviz DOT rendering of the symbol DAG.
+
+    Returns an object with `.source` (DOT text) and `.render(path)` —
+    API-compatible with the reference's graphviz return value."""
+    out_shapes = _node_output_shapes(symbol, shape)
+    lines: List[str] = [f'digraph "{title}" {{',
+                        "  rankdir=BT;",
+                        '  node [fontsize=10];']
+    nodes = _topo_nodes(symbol)
+    known_inputs = set(shape or ())
+
+    def keep(n):
+        if n.op is not None or n._name in known_inputs or not hide_weights:
+            return True
+        return False
+
+    idx = {}
+    for i, node in enumerate(nodes):
+        if not keep(node):
+            continue
+        idx[id(node)] = i
+        color, shp = _OP_STYLE.get(node.op or "null", ("#d9d9d9", "box"))
+        label = node._name if node.op is None else f"{node.op}\\n{node._name}"
+        os = out_shapes.get(node._name)
+        if os:
+            label += f"\\n{tuple(os)}"
+        lines.append(f'  n{i} [label="{label}", style=filled, '
+                     f'fillcolor="{color}", shape={shp}];')
+    for i, node in enumerate(nodes):
+        if id(node) not in idx:
+            continue
+        for inp in node.inputs:
+            if id(inp) in idx:
+                lines.append(f"  n{idx[id(inp)]} -> n{i};")
+    lines.append("}")
+    return _Dot("\n".join(lines), title)

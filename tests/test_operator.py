@@ -487,3 +487,54 @@ def test_quantize_dequantize_roundtrip():
 def test_bf16_consistency(fn):
     x = _rand((4, 8), seed=12)
     test_utils.check_consistency(fn, [x])
+
+
+@pytest.mark.parametrize("cin,cout,g,k,s,p,d,a", [
+    (5, 3, 1, 4, 2, 1, 1, 0),   # DCGAN upsample shape (Cin != Cout)
+    (4, 6, 2, 3, 2, 1, 1, 1),   # grouped + output_padding
+    (6, 4, 2, 3, 1, 0, 2, 0),   # dilated
+])
+def test_deconvolution_vs_conv_vjp(cin, cout, g, k, s, p, d, a):
+    """Deconvolution == gradient of the forward conv w.r.t. its input
+    (the defining property, ref deconvolution-inl.h), incl. the MXNet
+    output-size rule out = s*(i-1) + d*(k-1) + 1 - 2p + a."""
+    import jax
+    from jax import lax
+
+    rs = onp.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, cin, 8, 8), jnp.float32)
+    w = jnp.asarray(rs.randn(cin, cout // g, k, k), jnp.float32)
+    y = nn_ops.Deconvolution(_nd(onp.asarray(x)), _nd(onp.asarray(w)),
+                             kernel=(k, k), stride=(s, s), dilate=(d, d),
+                             pad=(p, p), adj=(a, a), num_filter=cout,
+                             num_group=g, no_bias=True)
+    expect = s * (8 - 1) + d * (k - 1) + 1 - 2 * p + a
+    assert y.shape == (2, cout, expect, expect)
+
+    def fwd(z):
+        # adj extends the deconv output at the high edge, which in the
+        # forward-conv view is asymmetric padding (p, p - a)
+        return lax.conv_general_dilated(
+            z, w, window_strides=(s, s), padding=[(p, p - a)] * 2,
+            rhs_dilation=(d, d), dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=g)
+
+    z0 = jnp.zeros((2, cout, expect, expect), jnp.float32)
+    out, vjp = jax.vjp(fwd, z0)
+    assert out.shape == x.shape, (out.shape, x.shape)
+    (gz,) = vjp(x)
+    assert onp.allclose(onp.asarray(gz), y.asnumpy(), atol=1e-4)
+
+
+def test_deconvolution_bias_and_grad():
+    x = _rand((2, 3, 5, 5), seed=11)
+    w = _rand((3, 4, 3, 3), seed=12)
+    b = _rand((4,), seed=13)
+    got = nn_ops.Deconvolution(_nd(x), _nd(w), _nd(b), kernel=(3, 3),
+                               stride=(2, 2), pad=(1, 1), num_filter=4,
+                               no_bias=False)
+    assert got.shape == (2, 4, 9, 9)
+    nobias = nn_ops.Deconvolution(_nd(x), _nd(w), kernel=(3, 3),
+                                  stride=(2, 2), pad=(1, 1), num_filter=4,
+                                  no_bias=True).asnumpy()
+    assert onp.allclose(got.asnumpy(), nobias + b.reshape(1, 4, 1, 1), atol=1e-5)

@@ -1,0 +1,152 @@
+"""mx.viz (print_summary / plot_network) and mx.mon.Monitor."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(out, name="softmax")
+
+
+def test_print_summary_counts_params(capsys):
+    sym = _mlp_symbol()
+    total = mx.viz.print_summary(sym, shape={"data": (2, 6)})
+    text = capsys.readouterr().out
+    # fc1: 6*8+8, fc2: 8*4+4
+    assert total == 6 * 8 + 8 + 8 * 4 + 4
+    assert "fc1" in text and "fc2" in text and "Total params" in text
+
+
+def test_plot_network_dot(tmp_path):
+    sym = _mlp_symbol()
+    dot = mx.viz.plot_network(sym, title="mlp", shape={"data": (2, 6)})
+    assert "digraph" in dot.source
+    assert "FullyConnected" in dot.source
+    assert "->" in dot.source
+    out = dot.render(str(tmp_path / "net"))
+    assert out.endswith(".dot")
+    with open(out) as f:
+        assert "digraph" in f.read()
+    # weight variables hidden by default
+    assert "fc1_weight" not in dot.source
+    shown = mx.viz.plot_network(sym, shape={"data": (2, 6)}, hide_weights=False)
+    assert "fc1_weight" in shown.source
+
+
+def test_monitor_on_gluon_block():
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    mon = mx.mon.Monitor(interval=2, sort=True)
+    mon.install(net)
+    x = NDArray(onp.ones((3, 4), "float32"))
+
+    mon.tic()
+    net(x)
+    res0 = mon.toc()  # step 0: active
+    assert res0, "interval hit should capture stats"
+    names = [n for _, n, _ in res0]
+    assert any("HybridSequential_output" in n for n in names)
+    assert any(".0_output" in n for n in names)  # child layer captured
+    for _, _, stat in res0:
+        assert onp.isfinite(stat)
+
+    mon.tic()
+    net(x)
+    assert mon.toc() == []  # step 1: interval miss
+
+    mon.tic()
+    net(x)
+    assert mon.toc()  # step 2: active again
+
+
+def test_monitor_stats_values():
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.Dense(3, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    mon = mx.mon.Monitor(interval=1,
+                         stat_func=lambda a: float(onp.max(onp.abs(a))))
+    mon.install(net)
+    x = NDArray(onp.full((1, 4), 2.0, "float32"))
+    mon.tic()
+    out = net(x)
+    res = mon.toc()
+    assert res
+    # Dense output = 0.5*2*4 = 4.0 per unit
+    out_stat = [s for _, n, s in res if n.endswith("_output")][0]
+    assert abs(out_stat - 4.0) < 1e-5
+
+
+def test_monitor_on_executor():
+    sym = _mlp_symbol()
+    exe = sym.simple_bind(data=(2, 6))
+    mon = mx.mon.Monitor(interval=1, pattern=".*fc.*")
+    mon.install(exe)
+    mon.tic()
+    exe.forward(data=NDArray(onp.ones((2, 6), "float32")))
+    res = mon.toc()
+    assert res
+    names = [n for _, n, _ in res]
+    assert all("fc" in n for n in names)  # pattern filter works
+    assert any("fc1_output" in n for n in names)
+
+
+def test_monitor_module_install():
+    sym = _mlp_symbol()
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 6))])
+    mod.init_params()
+    mon = mx.mon.Monitor(interval=1)
+    mod.install_monitor(mon)
+    from incubator_mxnet_tpu.io import DataBatch
+
+    mon.tic()
+    mod.forward(DataBatch(data=[NDArray(onp.ones((2, 6), "float32"))], label=None))
+    res = mon.toc()
+    assert res and any("softmax_output" in n for _, n, _ in res)
+
+
+def test_monitor_on_hybridized_block_keeps_child_stats():
+    """Hybridized nets force the eager path on capture steps so child
+    hooks still fire (the jit cache never re-enters child Python)."""
+    from incubator_mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    mon = mx.mon.Monitor(interval=1)
+    mon.install(net)
+    x = NDArray(onp.ones((3, 4), "float32"))
+    net(x)  # warm the jit cache first
+    for _ in range(3):
+        mon.tic()
+        net(x)
+        res = mon.toc()
+        names = [n for _, n, _ in res]
+        assert any(".0_output" in n for n in names), names
+        assert any(".1_output" in n for n in names), names
+    # monitor off: the compiled path is used again (no capture)
+    mon.activated = False
+    net(x)
+
+
+def test_trainer_step_all_params_frozen_is_noop():
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    net = nn.Dense(4)
+    net.initialize()
+    net(NDArray(onp.ones((2, 3), "float32")))
+    for p in net.collect_params().values():
+        p.grad_req = "null"
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr.step(1)  # no grads anywhere: must be a harmless no-op
