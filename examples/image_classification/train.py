@@ -104,7 +104,8 @@ def train(args):
     trainer = Trainer(net.collect_params(), "sgd",
                       {"learning_rate": args.lr, "momentum": args.momentum,
                        "wd": args.wd,
-                       "multi_precision": args.dtype == "bfloat16"})
+                       "multi_precision": args.dtype == "bfloat16"},
+                      keep_grads=False)  # grads consumed in the fused step
     acc = metric_mod.Accuracy()
 
     total_samples = 0

@@ -165,17 +165,24 @@ def _try_lazy_backward(heads, head_grads, retain_graph) -> bool:
     pending = getattr(node, "pending", None)
     if pending is None or pending.fwd_done or pending.bwd_requested:
         return False
-    if len(heads) != len(node.outputs):
-        return False
-    for h, o in zip(heads, node.outputs):
-        if h is not o or h._grad_req != "null":
+    # heads may be any SUBSET of the node's outputs (e.g. the loss leaf
+    # of a chained net→loss program): other outputs seed zero cotangent
+    out_pos = {id(o): i for i, o in enumerate(node.outputs)}
+    positions = []
+    for h in heads:
+        i = out_pos.get(id(h))
+        if i is None or h._grad_req != "null":
             return False
+        positions.append(i)
+    if len(set(positions)) != len(positions):
+        return False  # duplicate heads accumulate 2x — eager walk only
     targets = []
     for pos, inp in enumerate(node.inputs):
         if inp._grad_req == "add":
             return False  # accumulation needs the eager walk
         if inp._grad_req == "write" and inp._grad is not None:
             targets.append((pos, inp))
+    pending.head_positions = tuple(sorted(set(positions)))
     pending.request_bwd(targets)
     _tape.new_tape()
     return True

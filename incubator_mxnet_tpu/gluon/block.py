@@ -249,6 +249,51 @@ class Block:
         return s + ")"
 
 
+_CHAIN_MISS = object()
+
+
+def _program_jits(raw_fn):
+    """The four compiled entry points every cached program exposes
+    (plain blocks via `_build_cache`, compositions via `_ChainedOp`):
+    fn, grad (remat flavor), fwd_record (saves residuals), bwd_record."""
+    fn = jax.jit(raw_fn, static_argnums=(0, 1))
+
+    def grad_fn(training, arg_tree, train_raws, aux_raws, rng, rng_ctr,
+                input_raws, cots):
+        def f(tr, ins):
+            out, _new_aux = raw_fn(training, arg_tree, tr, aux_raws,
+                                   rng, rng_ctr, *ins)
+            return out
+
+        _out, vjp = jax.vjp(f, tuple(train_raws), tuple(input_raws))
+        d_train, d_ins = vjp(cots)
+        return d_train, d_ins
+
+    # CachedOp::Backward equivalence, remat flavor: the backward
+    # graph recomputes the forward inside (jax.checkpoint-style
+    # FLOPs-for-HBM trade, opt-in via hybridize(remat_backward=True))
+    grad = jax.jit(grad_fn, static_argnums=(0, 1))
+
+    def fwd_record_fn(training, arg_tree, train_raws, aux_raws, rng,
+                      rng_ctr, input_raws):
+        def f(tr, ins):
+            return raw_fn(training, arg_tree, tr, aux_raws,
+                          rng, rng_ctr, *ins)  # (out, new_aux)
+
+        out, pullback, new_aux = jax.vjp(
+            f, tuple(train_raws), tuple(input_raws), has_aux=True)
+        # pullback is a jax.tree_util.Partial pytree: its leaves are
+        # the forward residuals, so it round-trips through jit — the
+        # backward jit below consumes them without recomputing the
+        # forward (standard fwd+bwd FLOP budget, CachedOp::Backward
+        # with saved intermediates)
+        return out, new_aux, pullback
+
+    fwd_record = jax.jit(fwd_record_fn, static_argnums=(0, 1))
+    bwd_record = jax.jit(lambda pullback, cots: pullback(cots))
+    return fn, grad, fwd_record, bwd_record
+
+
 def _grads_not_kept():
     from ..base import MXNetError
 
@@ -269,7 +314,8 @@ class _PendingStep:
     __slots__ = ("block", "training", "arg_tree", "train_raws", "aux_raws",
                  "rng", "rng_ctr", "input_raws", "out_treedef", "out_avals",
                  "out_cells", "aux_params", "aux_cells", "fwd_done", "pullback",
-                 "bwd_requested", "bwd_done", "grad_cells", "n_train")
+                 "bwd_requested", "bwd_done", "grad_cells", "n_train",
+                 "out_nds", "head_positions")
 
     def __init__(self, block, training, arg_tree, train_raws, aux_raws, rng,
                  rng_ctr, input_raws, out_treedef, out_avals, aux_params):
@@ -292,6 +338,8 @@ class _PendingStep:
         self.bwd_done = False
         self.grad_cells: Dict[int, LazyRef] = {}  # input position -> cell
         self.n_train = len(train_raws)
+        self.out_nds: List = []        # NDArrays returned to the caller
+        self.head_positions = None     # backward head out-leaf indices (None=all)
 
     # -- stage execution (the WaitForVar equivalences) ------------------- #
     def force_fwd(self):
@@ -323,8 +371,16 @@ class _PendingStep:
             g = nd._grad
             # reuse the existing grad buffer's aval (or a previous lazy
             # cell's) — constructing ShapeDtypeStructs per param per step
-            # costs real milliseconds at BERT scale
-            aval = g._lazy.aval if g._lazy is not None else g._raw.aval
+            # costs real milliseconds at BERT scale.  A grad buffer can
+            # hold a plain numpy array (host-initialized zeros): build
+            # the aval from shape/dtype then.
+            if g._lazy is not None:
+                aval = g._lazy.aval
+            else:
+                aval = getattr(g._raw, "aval", None)
+                if aval is None:
+                    aval = jax.ShapeDtypeStruct(tuple(g._raw.shape),
+                                                g._raw.dtype)
             cell = LazyRef(force, aval)
             g._data = cell
             cells[pos] = cell
@@ -334,7 +390,10 @@ class _PendingStep:
         if self.bwd_done:
             return
         self.force_fwd()
-        cts = [jnp.ones(a.shape, a.dtype) for a in self.out_avals]
+        heads = self.head_positions
+        cts = [jnp.ones(a.shape, a.dtype) if heads is None or i in heads
+               else jnp.zeros(a.shape, a.dtype)
+               for i, a in enumerate(self.out_avals)]
         cot_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
         d_train, d_ins = self.block._cached_bwd_record(self.pullback, cot_tree)
         all_d = tuple(d_train) + tuple(d_ins)
@@ -365,6 +424,113 @@ class _PendingStep:
         self.pullback = None
 
 
+class _ChainedOp:
+    """Composition of an upstream pending program and a downstream
+    hybridized block into ONE cached program.
+
+    This is how the canonical MXNet loop
+    ``L = loss_fn(net(x), y); L.backward(); trainer.step()`` — with the
+    loss a SEPARATE block from the net — still compiles to a single
+    fused fwd+bwd+update XLA program: calling a hybridized block on the
+    lazy outputs of another pending step does not force that step, it
+    splices both programs together (the dependency-engine composition
+    one level up).  Exposes the same protocol `_PendingStep`/`Trainer`
+    use on plain blocks: `_cached_fn/_cached_grad/_cached_fwd_record/
+    _cached_bwd_record`, `_cached_param_order`, `_cache_version`.
+
+    Output tree = (down_out, up_out): the upstream pending's existing
+    output cells are re-pointed at the chained step, so values the user
+    already holds (e.g. logits for the metric) materialize from the one
+    fused program.
+    """
+
+    def __init__(self, up_block, down_block, lazy_map, n_up_inputs):
+        up_tr, up_aux = up_block._cached_param_order
+        down_tr, down_aux = down_block._cached_param_order
+
+        def dedup(seq_up, seq_down):
+            # a Parameter shared between the two blocks must appear ONCE
+            # in the combined (donated!) buffer tuple; slots map each
+            # original position to its deduped index, and jax.vjp sums
+            # the shared param's gradient across both uses
+            comb, index_of, slots = [], {}, []
+            for p in list(seq_up) + list(seq_down):
+                j = index_of.get(id(p))
+                if j is None:
+                    j = len(comb)
+                    comb.append(p)
+                    index_of[id(p)] = j
+                slots.append(j)
+            return comb, tuple(slots)
+
+        comb_tr, tr_slots = dedup(up_tr, down_tr)
+        comb_aux, aux_slots = dedup(up_aux, down_aux)
+        self._cached_param_order = (comb_tr, comb_aux)
+        self._cache_version = (up_block._cache_version,
+                               down_block._cache_version)
+        self._aval_cache: Dict = {}
+        n_up_tr, n_up_aux = len(up_tr), len(up_aux)
+        up_fn, down_fn = up_block._cached_fn, down_block._cached_fn
+        # deterministic per-composition-depth RNG salt: nested chains
+        # must give each stochastic block a distinct key stream
+        depth = getattr(up_block, "chain_depth", 0) + 1
+        self.chain_depth = depth
+        # shared aux written by both halves: the DOWN half's new value
+        # wins (it ran last), mirroring sequential eager execution
+        n_aux_total = len(comb_aux)
+
+        def raw_fn(training, token, train_raws, aux_raws, rng, rng_ctr,
+                   *input_raws):
+            up_tree, down_tree, lmap, n_up_in = token
+            up_tr_raws = tuple(train_raws[tr_slots[i]]
+                               for i in range(n_up_tr))
+            up_aux_raws = tuple(aux_raws[aux_slots[i]]
+                                for i in range(n_up_aux))
+            up_out, up_new_aux = up_fn(
+                training, up_tree, up_tr_raws, up_aux_raws, rng, rng_ctr,
+                *input_raws[:n_up_in])
+            up_leaves = jax.tree_util.tree_leaves(up_out)
+            it = iter(input_raws[n_up_in:])
+            d_leaves = [up_leaves[j] if j is not None else next(it)
+                        for j in lmap]
+            # independent RNG stream for the downstream program
+            rng_d = jax.random.fold_in(rng, 0xC4A1 + depth)
+            # downstream sees upstream's aux updates for shared aux
+            aux_after_up = list(aux_raws)
+            for i in range(n_up_aux):
+                aux_after_up[aux_slots[i]] = up_new_aux[i]
+            down_tr_raws = tuple(train_raws[tr_slots[n_up_tr + i]]
+                                 for i in range(len(down_tr)))
+            down_aux_raws = tuple(aux_after_up[aux_slots[n_up_aux + i]]
+                                  for i in range(len(down_aux)))
+            down_out, down_new_aux = down_fn(
+                training, down_tree, down_tr_raws, down_aux_raws, rng_d,
+                rng_ctr, *d_leaves)
+            new_aux = aux_after_up
+            for i in range(len(down_aux)):
+                new_aux[aux_slots[n_up_aux + i]] = down_new_aux[i]
+            return ((down_out, up_out), tuple(new_aux[:n_aux_total]))
+
+        (self._cached_fn, self._cached_grad, self._cached_fwd_record,
+         self._cached_bwd_record) = _program_jits(raw_fn)
+        self.lazy_map = tuple(lazy_map)
+        self.n_up_inputs = n_up_inputs
+
+        def src_map(slots, n_up, n_comb):
+            # deduped index -> ("up", i) | ("down", i): first occurrence
+            # decides where _try_chain reads the concrete value from
+            # (upstream values come from the pending snapshot)
+            src = [None] * n_comb
+            for pos, j in enumerate(slots):
+                if src[j] is None:
+                    src[j] = ("up", pos) if pos < n_up \
+                        else ("down", pos - n_up)
+            return tuple(src)
+
+        self.tr_src = src_map(tr_slots, n_up_tr, len(comb_tr))
+        self.aux_src = src_map(aux_slots, n_up_aux, len(comb_aux))
+
+
 class HybridBlock(Block):
     """Block that can be compiled: ``hybridize()`` → `jax.jit` cache."""
 
@@ -377,6 +543,7 @@ class HybridBlock(Block):
         self._cached_param_order: Optional[List[Parameter]] = None
         self._aval_cache: Dict = {}
         self._cache_version = 0  # bumped on every _build_cache (Trainer key)
+        self._chain_cache: Dict = {}  # _ChainedOp compositions by key
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
                   static_shape: bool = False, remat_backward: bool = False,
@@ -397,6 +564,7 @@ class HybridBlock(Block):
         self._remat_backward = remat_backward
         self._cached_fn = None
         self._aval_cache = {}
+        self._chain_cache = {}
         for c in self._children.values():
             if isinstance(c, HybridBlock):
                 c.hybridize(active, static_alloc=static_alloc,
@@ -409,6 +577,7 @@ class HybridBlock(Block):
         super().cast(dtype)
         self._cached_fn = None
         self._aval_cache = {}
+        self._chain_cache = {}
         return self
 
     def infer_shape(self, *args):
@@ -457,57 +626,29 @@ class HybridBlock(Block):
             return apply_fn(train_raws, aux_raws, key, *full,
                             training=training)
 
-        self._cached_fn = jax.jit(raw_fn, static_argnums=(0, 1))
-
-        def grad_fn(training, arg_tree, train_raws, aux_raws, rng, rng_ctr,
-                    input_raws, cots):
-            def f(tr, ins):
-                out, _new_aux = raw_fn(training, arg_tree, tr, aux_raws,
-                                       rng, rng_ctr, *ins)
-                return out
-
-            _out, vjp = jax.vjp(f, tuple(train_raws), tuple(input_raws))
-            d_train, d_ins = vjp(cots)
-            return d_train, d_ins
-
-        # CachedOp::Backward equivalence, remat flavor: the backward
-        # graph recomputes the forward inside (jax.checkpoint-style
-        # FLOPs-for-HBM trade, opt-in via hybridize(remat_backward=True))
-        self._cached_grad = jax.jit(grad_fn, static_argnums=(0, 1))
-
-        def fwd_record_fn(training, arg_tree, train_raws, aux_raws, rng,
-                          rng_ctr, input_raws):
-            def f(tr, ins):
-                return raw_fn(training, arg_tree, tr, aux_raws,
-                              rng, rng_ctr, *ins)  # (out, new_aux)
-
-            out, pullback, new_aux = jax.vjp(
-                f, tuple(train_raws), tuple(input_raws), has_aux=True)
-            # pullback is a jax.tree_util.Partial pytree: its leaves are
-            # the forward residuals, so it round-trips through jit — the
-            # backward jit below consumes them without recomputing the
-            # forward (standard fwd+bwd FLOP budget, CachedOp::Backward
-            # with saved intermediates)
-            return out, new_aux, pullback
-
-        self._cached_fwd_record = jax.jit(fwd_record_fn, static_argnums=(0, 1))
-        self._cached_bwd_record = jax.jit(lambda pullback, cots: pullback(cots))
+        (self._cached_fn, self._cached_grad, self._cached_fwd_record,
+         self._cached_bwd_record) = _program_jits(raw_fn)
 
     def _call_cached_op(self, *args):
+        args_leaves, arg_tree = jax.tree_util.tree_flatten(args)
+        input_nds = [wrap(a) for a in args_leaves]
+        recording = _tape.is_recording()
+        if recording and not self._remat_backward:
+            # lazy inputs from another pending step: splice the two
+            # programs instead of forcing (dependency-engine composition)
+            out = self._try_chain(arg_tree, input_nds)
+            if out is not _CHAIN_MISS:
+                return out
         if self._cached_fn is None:
             self._ensure_shapes(args)
             self._build_cache()
         trainable, aux = self._cached_param_order
         train_raws = tuple(p._data_nd._data for p in trainable)
         aux_raws = tuple(p._data_nd._data for p in aux)
-        args_leaves, arg_tree = jax.tree_util.tree_flatten(args)
-        input_nds = [wrap(a) for a in args_leaves]
         input_raws = [a._data for a in input_nds]
         rng, rng_ctr = _random.step_key()
         training = _tape.is_training()
         fn = self._cached_fn
-
-        recording = _tape.is_recording()
         if not recording:
             out_raws, new_aux = fn(training, arg_tree, train_raws, aux_raws,
                                    rng, rng_ctr, *input_raws)
@@ -570,10 +711,149 @@ class HybridBlock(Block):
             d_train, d_ins = cached_bwd(pending.pullback, cot_tree)
             return tuple(d_train) + tuple(d_ins)
 
+        pending.out_nds = out_nds
         node = _tape.TapeNode(tape_inputs, out_nds, node_vjp, len(out_nds))
         node.pending = pending
         _tape.append_node(node)
         return jax.tree_util.tree_unflatten(treedef, out_nds)
+
+    def _try_chain(self, arg_tree, input_nds):
+        """Call-on-lazy-outputs: splice this block's program onto the
+        owning pending (one fused XLA program for net → loss → update).
+
+        Returns the downstream outputs (lazy), or `_CHAIN_MISS` when the
+        inputs aren't all from one open pending step."""
+        lazy_cells = [(i, nd._lazy) for i, nd in enumerate(input_nds)
+                      if isinstance(nd, NDArray) and nd._lazy is not None]
+        if not lazy_cells:
+            return _CHAIN_MISS
+        pend = None
+        for _, cell in lazy_cells:
+            owner = getattr(cell.force_fn, "__self__", None)
+            if not isinstance(owner, _PendingStep):
+                return _CHAIN_MISS
+            if pend is None:
+                pend = owner
+            elif owner is not pend:
+                return _CHAIN_MISS
+        if pend.fwd_done or pend.bwd_requested:
+            return _CHAIN_MISS
+        tape = _tape.current_tape()
+        if not tape or getattr(tape[-1], "pending", None) is not pend:
+            return _CHAIN_MISS
+        training = _tape.is_training()
+        if training != pend.training:
+            return _CHAIN_MISS
+        cell_pos = {id(c): j for j, c in enumerate(pend.out_cells)}
+        lazy_map = []
+        concrete_nds = []
+        for nd in input_nds:
+            if isinstance(nd, NDArray) and nd._lazy is not None:
+                j = cell_pos.get(id(nd._lazy))
+                if j is None:
+                    return _CHAIN_MISS
+                lazy_map.append(j)
+            else:
+                lazy_map.append(None)
+                concrete_nds.append(nd)
+        if self._cached_fn is None:
+            # building the cache must not force the upstream: only
+            # proceed when no param shapes are deferred
+            if any(p._deferred_init is not None
+                   for p in self.collect_params().values()):
+                return _CHAIN_MISS
+            self._build_cache()
+
+        up_block = pend.block
+        key = ("chain", id(up_block), up_block._cache_version,
+               self._cache_version, tuple(lazy_map), pend.arg_tree, arg_tree)
+        chained = self._chain_cache.get(key)
+        if chained is None:
+            chained = _ChainedOp(up_block, self, lazy_map,
+                                 len(pend.input_raws))
+            self._chain_cache[key] = chained
+
+        comb_tr, comb_aux = chained._cached_param_order
+        up_tr, up_aux = up_block._cached_param_order
+        down_tr, down_aux = self._cached_param_order
+        # upstream raws come from the pending snapshot (its aux params
+        # are currently rebound to lazy cells — do NOT read them);
+        # params shared between the halves appear once (tr_src/aux_src)
+        train_raws = tuple(
+            pend.train_raws[i] if where == "up"
+            else down_tr[i]._data_nd._data
+            for where, i in chained.tr_src)
+        aux_raws = tuple(
+            pend.aux_raws[i] if where == "up"
+            else down_aux[i]._data_nd._data
+            for where, i in chained.aux_src)
+        input_raws = tuple(pend.input_raws) \
+            + tuple(nd._data for nd in concrete_nds)
+        token = (pend.arg_tree, arg_tree, chained.lazy_map,
+                 chained.n_up_inputs)
+
+        sig = (key, training,
+               tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
+        spec = self._aval_cache.get(sig)
+        if spec is None:
+            import functools
+
+            out_shape, _aux_shape = jax.eval_shape(
+                functools.partial(chained._cached_fn, training, token),
+                train_raws, aux_raws, pend.rng, pend.rng_ctr, *input_raws)
+            down_shape, up_shape = out_shape
+            d_leaves, d_treedef = jax.tree_util.tree_flatten(down_shape)
+            leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
+            spec = (treedef, leaves_avals, d_treedef, len(d_leaves))
+            self._aval_cache[sig] = spec
+        treedef, out_avals, down_treedef, n_down = spec
+        if len(out_avals) - n_down != len(pend.out_cells):
+            return _CHAIN_MISS  # upstream output arity changed underneath
+
+        pending2 = _PendingStep(chained, training, token, train_raws,
+                                aux_raws, pend.rng, pend.rng_ctr, input_raws,
+                                treedef, out_avals, comb_aux)
+        for p, a in zip(comb_aux, aux_raws):
+            cell = LazyRef(pending2.force_fwd,
+                           jax.ShapeDtypeStruct(a.shape, a.dtype))
+            pending2.aux_cells.append(cell)
+            p._data_nd._data = cell
+        # the upstream's existing output cells become the tail of this
+        # pending's outputs — values the caller already holds fill from
+        # the one chained program
+        for j, old_cell in enumerate(pend.out_cells):
+            old_cell.force_fn = pending2.force_fwd
+            old_cell.value = None
+            pending2.out_cells[n_down + j] = old_cell
+
+        down_nds = []
+        for cell in pending2.out_cells[:n_down]:
+            ndo = NDArray(cell)
+            ndo._in_graph = True
+            down_nds.append(ndo)
+        pending2.out_nds = down_nds + list(pend.out_nds)
+
+        up_node = tape.pop()
+        up_input_nds = up_node.inputs[len(up_tr):]
+        tape_inputs = [p._data_nd for p in comb_tr] + list(up_input_nds) \
+            + list(concrete_nds)
+        cached_bwd = chained._cached_bwd_record
+        out_dtypes = [a.dtype for a in out_avals]
+
+        def node_vjp(cotangents):
+            pending2.force_fwd()
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            cts = tuple(c.astype(dt) if c.dtype != dt else c
+                        for c, dt in zip(cts, out_dtypes))
+            cot_tree = jax.tree_util.tree_unflatten(treedef, list(cts))
+            d_train, d_ins = cached_bwd(pending2.pullback, cot_tree)
+            return tuple(d_train) + tuple(d_ins)
+
+        node = _tape.TapeNode(tape_inputs, pending2.out_nds, node_vjp,
+                              len(pending2.out_nds))
+        node.pending = pending2
+        _tape.append_node(node)
+        return jax.tree_util.tree_unflatten(down_treedef, down_nds)
 
     def _record_remat(self, training, arg_tree, trainable, aux, train_raws,
                       aux_raws, rng, rng_ctr, input_nds, input_raws):

@@ -37,6 +37,11 @@ class Loss(HybridBlock):
         super().__init__(**kwargs)
         self._weight = weight
         self._batch_axis = batch_axis
+        # losses are pure elementwise programs: hybridize by default so
+        # `loss_fn(net(x), y)` on a hybridized net chains into the ONE
+        # fused fwd+bwd+update program (block._try_chain) instead of
+        # forcing the net's pending step
+        self.hybridize()
 
     def _mean_all_but_batch(self, x):
         axes = tuple(i for i in range(x.ndim) if i != self._batch_axis)

@@ -292,7 +292,7 @@ class Trainer:
         idx_of = ctx["idx_of"] if ctx is not None else None
         mults = self._mults_key(idx_of) if idx_of is not None else None
         sig = (id(block), block._cache_version, pending.training,
-               pending.arg_tree,
+               pending.arg_tree, pending.head_positions,
                tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
         if ctx is None or ctx["sig"] != sig or ctx["mults"] != mults:
             ctx = self._prepare_full_step(pending, sig)
@@ -368,6 +368,7 @@ class Trainer:
         training, arg_tree = pending.training, pending.arg_tree
         stacked = self._make_stacked_update(*mults)
         keep_grads = self._keep_grads
+        heads = pending.head_positions  # out-leaf indices seeded with ones
 
         def full(train_raws, aux_raws, states, rng, rng_ctr, input_raws, ts,
                  lr, wd, rescale, keys):
@@ -377,7 +378,10 @@ class Trainer:
                 return out, new_aux
 
             out, pullback, new_aux = jax.vjp(f, tuple(train_raws), has_aux=True)
-            cot = jax.tree_util.tree_map(jnp.ones_like, out)
+            leaves, tdef = jax.tree_util.tree_flatten(out)
+            cts = [jnp.ones_like(l) if heads is None or i in heads
+                   else jnp.zeros_like(l) for i, l in enumerate(leaves)]
+            cot = jax.tree_util.tree_unflatten(tdef, cts)
             (grads,) = pullback(cot)
             new_w, new_s = stacked(train_raws, grads, states, ts, lr, wd,
                                    rescale, keys)

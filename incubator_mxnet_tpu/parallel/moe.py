@@ -96,7 +96,7 @@ def moe_layer_sharded(x, router_w, expert_ws, mesh: Mesh,
                       axis_name: str = "expert"):
     """Top-level: x (B, T, D) replicated batch; expert weights sharded
     on their leading (expert) dim."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     B, T, D = x.shape
     xf = x.reshape(B * T, D)
@@ -109,6 +109,6 @@ def moe_layer_sharded(x, router_w, expert_ws, mesh: Mesh,
 
     fn = shard_map(inner, mesh=mesh,
                    in_specs=(P(), P(), (P(axis_name), P(axis_name))),
-                   out_specs=(P(), P()), check_rep=False)
+                   out_specs=(P(), P()), check_vma=False)
     out, aux = fn(xf, router_w, expert_ws)
     return out.reshape(B, T, D), aux

@@ -89,7 +89,7 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
     all_stage_params: pytree whose leaves have leading dim = n_stages.
     x: (B, ...) global batch.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     B = x.shape[0]
     mb = B // num_microbatches
@@ -102,6 +102,6 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
 
     param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), all_stage_params)
     fn = shard_map(inner, mesh=mesh,
-                   in_specs=(param_spec, P()), out_specs=P(), check_rep=False)
+                   in_specs=(param_spec, P()), out_specs=P(), check_vma=False)
     out = fn(all_stage_params, xm)
     return out.reshape((B,) + out.shape[2:])

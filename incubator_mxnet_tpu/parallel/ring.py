@@ -146,11 +146,11 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = False,
                            impl: str = "flash"):
     """Top-level entry: q,k,v are (B, H, T, D) global arrays; shards T
     over `axis_name` and runs the ring under shard_map."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                           scale=scale, impl=impl),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -50,11 +50,11 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, causal: bool = False,
                               scale: Optional[float] = None, axis_name: str = "seq",
                               attn_fn: Optional[Callable] = None):
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name, causal=causal,
                           scale=scale, attn_fn=attn_fn),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -1,0 +1,227 @@
+"""Pending composition (`block._try_chain`): the canonical
+`L = loss_fn(net(x), y); L.backward(); trainer.step()` pattern with a
+SEPARATE loss block must fuse into one program AND match the eager
+oracle exactly."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd
+from incubator_mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _net():
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+X = onp.random.RandomState(0).randn(8, 5).astype("float32")
+Y = onp.random.RandomState(1).randint(0, 4, 8).astype("int32")
+
+
+def _train(net, hybridize, steps=4, keep_grads=True):
+    x, y = NDArray(X), NDArray(Y)
+    if hybridize:
+        net(x)
+        net.hybridize()
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9},
+                 keep_grads=keep_grads)
+    for _ in range(steps):
+        with autograd.record():
+            out = net(x)
+            L = loss_fn(out, y)
+        L.backward()
+        tr.step(1)
+    return net, tr, out, L
+
+
+def _params(net):
+    return [p.data().asnumpy() for p in net.collect_params().values()]
+
+
+def test_chained_separate_loss_fuses_and_matches_eager():
+    net1, tr1, out1, L1 = _train(_net(), hybridize=True)
+    assert tr1._fullstep_ctx is not None, "chain did not reach the full step"
+    net2, tr2, out2, L2 = _train(_net(), hybridize=False)
+    for a, b in zip(_params(net1), _params(net2)):
+        assert onp.allclose(a, b, atol=2e-5), "chained != eager"
+    # upstream logits stay readable after the fused step (metric pattern)
+    assert onp.allclose(out1.asnumpy(), out2.asnumpy(), atol=1e-4)
+    assert onp.allclose(L1.asnumpy(), L2.asnumpy(), atol=1e-5)
+
+
+def test_chained_keep_grads_false_reads_raise():
+    net, tr, out, L = _train(_net(), hybridize=True, keep_grads=False)
+    p = list(net.collect_params().values())[0]
+    with pytest.raises(mx.MXNetError, match="keep_grads"):
+        p.grad().asnumpy()
+    # params still updated (loss readable)
+    assert onp.isfinite(L.asnumpy()).all()
+
+
+def test_chained_grads_match_eager():
+    net1, tr1, _, _ = _train(_net(), hybridize=True, steps=1)
+    net2, tr2, _, _ = _train(_net(), hybridize=False, steps=1)
+    g1 = [p.grad().asnumpy() for p in net1.collect_params().values()]
+    g2 = [p.grad().asnumpy() for p in net2.collect_params().values()]
+    for a, b in zip(g1, g2):
+        assert onp.allclose(a, b, atol=1e-5)
+
+
+def test_two_stage_chain():
+    """net → head → loss: chains compose recursively into one pending."""
+    mx.random.seed(0)
+    body = nn.Dense(16, activation="relu")
+    head = nn.Dense(4)
+    body.initialize(); head.initialize()
+    x, y = NDArray(X), NDArray(Y)
+    head(body(x))
+    body.hybridize(); head.hybridize()
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    params = {**body.collect_params(), **head.collect_params()}
+    tr = Trainer(params, "sgd", {"learning_rate": 0.1})
+    for _ in range(2):
+        with autograd.record():
+            L = loss_fn(head(body(x)), y)
+        L.backward()
+        tr.step(1)
+    assert tr._fullstep_ctx is not None, "two-stage chain did not fuse"
+
+    # eager oracle
+    mx.random.seed(0)
+    body2 = nn.Dense(16, activation="relu")
+    head2 = nn.Dense(4)
+    body2.initialize(); head2.initialize()
+    params2 = {**body2.collect_params(), **head2.collect_params()}
+    tr2 = Trainer(params2, "sgd", {"learning_rate": 0.1})
+    for _ in range(2):
+        with autograd.record():
+            L2 = loss_fn(head2(body2(x)), y)
+        L2.backward()
+        tr2.step(1)
+    for (a, b) in zip(sorted(params), sorted(params2)):
+        assert onp.allclose(params[a].data().asnumpy(),
+                            params2[b].data().asnumpy(), atol=2e-5)
+
+
+def test_chain_with_input_grad_falls_back_correctly():
+    """x.attach_grad(): input grads need the staged path — numerics must
+    still match the eager oracle."""
+    net = _net()
+    x, y = NDArray(X), NDArray(Y)
+    net(x)
+    net.hybridize()
+    x.attach_grad()
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = loss_fn(net(x), y)
+    L.backward()
+    gx_hyb = x.grad.asnumpy()
+
+    net2 = _net()
+    x2 = NDArray(X)
+    x2.attach_grad()
+    with autograd.record():
+        L2 = loss_fn(net2(x2), y)
+    L2.backward()
+    assert onp.allclose(gx_hyb, x2.grad.asnumpy(), atol=1e-5)
+
+
+def test_chained_with_batchnorm_aux_updates():
+    """BN moving stats (aux params) must advance through the chained
+    program identically to the eager path."""
+    def bn_net():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16), nn.BatchNorm(), nn.Dense(4))
+        net.initialize()
+        return net
+
+    x, y = NDArray(X), NDArray(Y)
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+
+    def run(hyb):
+        net = bn_net()
+        if hyb:
+            net(x)
+            net.hybridize()
+        tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+        for _ in range(3):
+            with autograd.record():
+                L = loss_fn(net(x), y)
+            L.backward()
+            tr.step(1)
+        return net
+
+    n1, n2 = run(True), run(False)
+    for (k1, p1), (k2, p2) in zip(sorted(n1.collect_params().items()),
+                                  sorted(n2.collect_params().items())):
+        assert onp.allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                            atol=2e-5), k1
+
+
+def test_chained_shared_parameter_dedup():
+    """A Parameter used by BOTH halves of a chain must be donated once
+    and receive the SUM of its gradients (tied-weight pattern)."""
+    mx.random.seed(0)
+    shared = nn.Dense(5, use_bias=False, in_units=5)
+    shared.initialize()
+    x, y = NDArray(X), NDArray(Y[:8] % 5)
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+
+    class Tower(nn.HybridSequential):
+        pass
+
+    # upstream: shared; downstream head: shared AGAIN then loss
+    up = nn.HybridSequential(); up.add(shared)
+    down = nn.HybridSequential(); down.add(shared)
+    up(x); down(up(x))
+    up.hybridize(); down.hybridize()
+    tr = Trainer(up.collect_params(), "sgd", {"learning_rate": 0.1})
+    for _ in range(2):
+        with autograd.record():
+            L = loss_fn(down(up(x)), y)
+        L.backward()
+        tr.step(1)
+    chained_w = shared.weight.data().asnumpy().copy()
+
+    # eager oracle
+    mx.random.seed(0)
+    shared2 = nn.Dense(5, use_bias=False, in_units=5)
+    shared2.initialize()
+    up2 = nn.Sequential(); up2.add(shared2)
+    tr2 = Trainer({"w": shared2.weight}, "sgd", {"learning_rate": 0.1})
+    for _ in range(2):
+        with autograd.record():
+            L2 = loss_fn(shared2(shared2(x)), y)
+        L2.backward()
+        tr2.step(1)
+    assert onp.allclose(chained_w, shared2.weight.data().asnumpy(), atol=2e-5)
+
+
+def test_backward_duplicate_heads_accumulates():
+    """backward([L, L]) doubles the cotangent — lazy path must not
+    silently dedup (it falls back to the eager walk)."""
+    net = _net()
+    x, y = NDArray(X), NDArray(Y)
+    net(x)
+    net.hybridize()
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        L = loss_fn(net(x), y)
+    autograd.backward([L, L])
+    g2x = [p.grad().asnumpy() for p in net.collect_params().values()]
+
+    net2 = _net()
+    with autograd.record():
+        L2 = loss_fn(net2(x), y)
+    autograd.backward([L2])
+    g1x = [p.grad().asnumpy() for p in net2.collect_params().values()]
+    for a, b in zip(g2x, g1x):
+        assert onp.allclose(a, 2 * b, atol=1e-5)

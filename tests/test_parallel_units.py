@@ -134,7 +134,7 @@ def test_moe_dispatch_conservation():
 
 
 def test_collectives_psum_across_mesh():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = par.create_mesh(data=8)
