@@ -1,8 +1,13 @@
 """Evaluation metrics (parity: `python/mxnet/metric.py` [UNVERIFIED],
 SURVEY.md §2.6 + §5.5): EvalMetric zoo with the reference's
 `update(labels, preds)` / `get()` protocol, plus composite and custom
-metrics.  Accumulation is host-side numpy — metrics are a sync point
-exactly as in the reference (SURVEY.md §3.2 "metric.update ... WaitForVar").
+metrics.
+
+TPU divergence from the reference: in MXNet `metric.update` is a sync
+point (SURVEY.md §3.2 "metric.update ... WaitForVar").  Here the
+hot-loop metrics (Accuracy, Loss) accumulate ON DEVICE when given
+NDArrays — the single host transfer happens in `get()` (Speedometer
+interval), so per-step training never stalls on the device link.
 """
 from __future__ import annotations
 
@@ -47,6 +52,7 @@ class EvalMetric:
         self.sum_metric = 0.0
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
+        self._dev_updates = 0
 
     def reset_local(self):
         self.num_inst = 0
@@ -56,19 +62,35 @@ class EvalMetric:
         raise NotImplementedError
 
     def _update(self, metric, num):
-        self.sum_metric += metric
+        self.sum_metric = self.sum_metric + metric
         self.num_inst += num
-        self.global_sum_metric += metric
+        self.global_sum_metric = self.global_sum_metric + metric
         self.global_num_inst += num
+        if not isinstance(metric, (int, float)):
+            # device-scalar accumulation runs in float32, which loses
+            # integer exactness past 2^24 — flush the partial into the
+            # host float64 every 128 updates (amortized single sync)
+            self._dev_updates += 1
+            if self._dev_updates >= 128:
+                self._flush_dev()
+
+    def _flush_dev(self):
+        self.sum_metric = float(self.sum_metric)
+        self.global_sum_metric = float(self.global_sum_metric)
+        self._dev_updates = 0
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
+        # sum_metric may be a device scalar (async accumulation) — the
+        # host transfer happens HERE, not per update() call
+        self._flush_dev()
         return (self.name, self.sum_metric / self.num_inst)
 
     def get_global(self):
         if self.global_num_inst == 0:
             return (self.name, float("nan"))
+        self._flush_dev()
         return (self.name, self.global_sum_metric / self.global_num_inst)
 
     def get_name_value(self):
@@ -90,6 +112,22 @@ class Accuracy(EvalMetric):
 
     def update(self, labels, preds):
         for label, pred in zip(_to_list(labels), _to_list(preds)):
+            if isinstance(pred, NDArray) and isinstance(label, NDArray):
+                # on-device accumulation: metric.update is NOT a sync
+                # point (unlike the reference, SURVEY §3.2) — the count
+                # stays a device scalar until get()
+                import jax.numpy as jnp
+
+                from .ndarray.ndarray import raw
+
+                p, l = raw(pred), raw(label)
+                if p.ndim > l.ndim:
+                    p = jnp.argmax(p, axis=self.axis)
+                p = p.astype(jnp.int32).reshape(-1)
+                l = l.astype(jnp.int32).reshape(-1)
+                n = min(p.shape[0], l.shape[0])
+                self._update((p[:n] == l[:n]).sum(), n)
+                continue
             label = _as_np(label)
             pred = _as_np(pred)
             if pred.ndim > label.ndim:
@@ -276,8 +314,14 @@ class Loss(EvalMetric):
 
     def update(self, _, preds):
         for pred in _to_list(preds):
-            loss = float(_as_np(pred).sum())
-            self._update(loss, _as_np(pred).size)
+            if isinstance(pred, NDArray):
+                from .ndarray.ndarray import raw
+
+                r = raw(pred)
+                self._update(r.sum(), r.size)  # device scalar, no sync
+            else:
+                loss = float(_as_np(pred).sum())
+                self._update(loss, _as_np(pred).size)
 
 
 class CompositeEvalMetric(EvalMetric):

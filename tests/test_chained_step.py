@@ -175,9 +175,6 @@ def test_chained_shared_parameter_dedup():
     x, y = NDArray(X), NDArray(Y[:8] % 5)
     loss_fn = gloss.SoftmaxCrossEntropyLoss()
 
-    class Tower(nn.HybridSequential):
-        pass
-
     # upstream: shared; downstream head: shared AGAIN then loss
     up = nn.HybridSequential(); up.add(shared)
     down = nn.HybridSequential(); down.add(shared)

@@ -209,3 +209,24 @@ def test_losses_differentiable():
     out.backward()
     g = p.grad.asnumpy()
     onp.testing.assert_allclose(g, p.asnumpy() / 4, rtol=1e-5)
+
+
+def test_accuracy_device_accumulation_flushes_exactly():
+    """Device-side accumulation must not lose counts to float32 (the
+    128-update flush keeps the host sum float64-exact)."""
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    m = metric_mod.Accuracy()
+    pred = NDArray(jnp.eye(4, dtype=jnp.float32))     # argmax == [0,1,2,3]
+    lab = NDArray(jnp.arange(4, dtype=jnp.int32))
+    for _ in range(300):  # crosses two flush boundaries
+        m.update([lab], [pred])
+    name, acc = m.get()
+    assert acc == 1.0
+    assert m.num_inst == 1200
+    assert isinstance(m.sum_metric, float) and m.sum_metric == 1200.0
+    # get_global flushes too
+    _, gacc = m.get_global()
+    assert gacc == 1.0
