@@ -90,15 +90,18 @@ def train(args):
         path_imgrec=rec_path, data_shape=shape, batch_size=args.batch_size,
         shuffle=True, rand_mirror=True,
         mean_r=123.68, mean_g=116.78, mean_b=103.94,
-        std_r=58.4, std_g=57.1, std_b=57.4)
+        std_r=58.4, std_g=57.1, std_b=57.4,
+        device_normalize=True)  # uint8 over the link; affine fuses on device
 
     mx.random.seed(0)
-    net = vision.get_model(args.network, classes=args.num_classes)
-    net.initialize()
+    body = vision.get_model(args.network, classes=args.num_classes)
+    body.initialize()
     # materialize deferred shapes before optional bf16 cast
-    net(NDArray(mx.nd.zeros((args.batch_size,) + shape)._data))
+    body(NDArray(mx.nd.zeros((args.batch_size,) + shape)._data))
     if args.dtype == "bfloat16":
-        net.cast("bfloat16")
+        body.cast("bfloat16")
+    # uint8 over the link; normalize+cast fuse into the compiled step
+    net = train_iter.wrap_net(body, dtype=args.dtype)
     net.hybridize(remat_backward=args.remat)
     loss_fn = loss_mod.SoftmaxCrossEntropyLoss()
     trainer = Trainer(net.collect_params(), "sgd",
@@ -117,9 +120,7 @@ def train(args):
         for nbatch, batch in enumerate(train_iter):
             if args.max_batches and nbatch >= args.max_batches:
                 break
-            x = batch.data[0]
-            if args.dtype == "bfloat16":
-                x = x.astype("bfloat16")
+            x = batch.data[0]  # raw uint8: normalization is inside net
             y = batch.label[0]
             with autograd.record():
                 out = net(x)
@@ -132,7 +133,9 @@ def train(args):
                                          eval_metric=acc, locals=locals()))
         print(f"Epoch {epoch}: train_acc={acc.get()[1]:.4f}")
         if args.model_prefix:
-            net.save_parameters(f"{args.model_prefix}-{epoch:04d}.params")
+            # save from the inner model: keys stay loadable into a bare
+            # vision.get_model() network (no wrapper prefix)
+            body.save_parameters(f"{args.model_prefix}-{epoch:04d}.params")
 
     dt = time.time() - t_start
     img_s = total_samples / dt

@@ -306,7 +306,12 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  preprocess_threads=4, label_width=1, round_batch=True,
-                 resize=0, seed=0, use_native=True, scale=1.0, **kwargs):
+                 resize=0, seed=0, use_native=True, scale=1.0,
+                 device_normalize=False, **kwargs):
+        """device_normalize=True (TPU extension): the iterator emits RAW
+        uint8 pixels — 4x fewer bytes over the host→device link — and
+        mean/std/scale move into the compiled model via `normalize()`.
+        The reference normalizes on host (fp32 batches)."""
         super().__init__(batch_size)
         from .. import recordio as rio
 
@@ -319,6 +324,13 @@ class ImageRecordIter(DataIter):
         self._scale = scale
         self._resize = resize
         self._round_batch = round_batch
+        self._device_normalize = device_normalize
+        if device_normalize:
+            # host pipeline must leave pixels raw: normalization happens
+            # on device inside the traced program (see normalize())
+            mean_r = mean_g = mean_b = 0.0
+            std_r = std_g = std_b = 1.0
+            scale = 1.0
         self._native = None
         if use_native and path_imgidx:
             # The native pipeline builds its own sequential index; a
@@ -390,12 +402,53 @@ class ImageRecordIter(DataIter):
         arr = _center_or_rand_crop(arr, h, w, self.rand_crop)
         if self.rand_mirror and onp.random.rand() < 0.5:
             arr = arr[:, :, ::-1]
-        arr = (arr * self._scale - self.mean) / self.std
+        if not self._device_normalize:
+            arr = (arr * self._scale - self.mean) / self.std
         return arr, onp.float32(header.label if onp.isscalar(header.label) else header.label[0])
+
+    def normalize(self, x):
+        """On-device normalization for `device_normalize=True` batches.
+
+        Call INSIDE a hybridized block's forward so the cast+affine
+        fuses into the compiled step:
+        ``x = train_iter.normalize(x); out = net(x)``"""
+        from .. import ndarray as nd
+
+        x = x.astype("float32")
+        if self._scale != 1.0:
+            x = x * float(self._scale)
+        mean = self.mean.reshape(1, -1, 1, 1)
+        std = self.std.reshape(1, -1, 1, 1)
+        if (mean != 0).any():
+            x = x - nd.NDArray(jnp.asarray(mean))
+        if (std != 1).any():
+            x = x / nd.NDArray(jnp.asarray(std))
+        return x
+
+    def wrap_net(self, net, dtype="float32"):
+        """Consumer side of `device_normalize=True`: returns a
+        HybridBlock doing uint8 → on-device normalize → cast(dtype) →
+        net, all inside one traced program.  Save/load parameters via
+        the INNER net (the wrapper adds no params of its own)."""
+        from ..gluon.block import HybridBlock
+
+        it = self
+
+        class _NormalizedNet(HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.net = net
+
+            def forward(self, x):
+                return self.net(it.normalize(x).astype(dtype))
+
+        return _NormalizedNet()
 
     def next(self) -> DataBatch:
         if self._native is not None:
             d, l, pad = self._native.next()
+            if self._device_normalize:
+                d = d.astype("uint8")  # 4x fewer bytes to the device
             return DataBatch(data=[NDArray(jnp.asarray(d))],
                              label=[NDArray(jnp.asarray(l))], pad=pad)
         if getattr(self, "_padded_last", False):
@@ -427,7 +480,10 @@ class ImageRecordIter(DataIter):
                     continue
                 datas.append(d)
                 labels.append(l)
-        data = NDArray(jnp.asarray(onp.stack(datas)))
+        stacked = onp.stack(datas)
+        if self._device_normalize:
+            stacked = stacked.astype("uint8")  # raw pixels, small transfer
+        data = NDArray(jnp.asarray(stacked))
         label = NDArray(jnp.asarray(onp.stack(labels)))
         return DataBatch(data=[data], label=[label], pad=pad)
 

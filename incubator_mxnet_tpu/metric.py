@@ -45,6 +45,10 @@ class EvalMetric:
         self.output_names = output_names
         self.label_names = label_names
         self._kwargs = kwargs
+        # set before reset(): subclasses may override reset() without
+        # calling super (e.g. CompositeEvalMetric)
+        self._dev_partial = None
+        self._dev_updates = 0
         self.reset()
 
     def reset(self):
@@ -52,9 +56,11 @@ class EvalMetric:
         self.sum_metric = 0.0
         self.global_num_inst = 0
         self.global_sum_metric = 0.0
+        self._dev_partial = None   # float32 device scalar, ≤128 updates
         self._dev_updates = 0
 
     def reset_local(self):
+        self._flush_dev()  # pending device partial belongs to global too
         self.num_inst = 0
         self.sum_metric = 0.0
 
@@ -62,28 +68,37 @@ class EvalMetric:
         raise NotImplementedError
 
     def _update(self, metric, num):
-        self.sum_metric = self.sum_metric + metric
         self.num_inst += num
-        self.global_sum_metric = self.global_sum_metric + metric
         self.global_num_inst += num
-        if not isinstance(metric, (int, float)):
-            # device-scalar accumulation runs in float32, which loses
-            # integer exactness past 2^24 — flush the partial into the
-            # host float64 every 128 updates (amortized single sync)
-            self._dev_updates += 1
-            if self._dev_updates >= 128:
-                self._flush_dev()
+        if isinstance(metric, (int, float)):
+            self.sum_metric += metric
+            self.global_sum_metric += metric
+            return
+        # device scalar: accumulate a bounded float32 PARTIAL on device
+        # (upcast — bf16 sums round away increments within tens of
+        # updates) and fold it into the host float64 totals at flush.
+        # The host totals never touch device dtypes, so long-run sums
+        # keep float64 exactness.
+        import jax.numpy as jnp
+
+        m = metric.astype(jnp.float32)
+        self._dev_partial = m if self._dev_partial is None \
+            else self._dev_partial + m
+        self._dev_updates += 1
+        if self._dev_updates >= 128:
+            self._flush_dev()
 
     def _flush_dev(self):
-        self.sum_metric = float(self.sum_metric)
-        self.global_sum_metric = float(self.global_sum_metric)
+        if self._dev_partial is not None:
+            v = float(self._dev_partial)  # the single host transfer
+            self.sum_metric += v
+            self.global_sum_metric += v
+            self._dev_partial = None
         self._dev_updates = 0
 
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))
-        # sum_metric may be a device scalar (async accumulation) — the
-        # host transfer happens HERE, not per update() call
         self._flush_dev()
         return (self.name, self.sum_metric / self.num_inst)
 

@@ -102,3 +102,28 @@ def test_image_record_iter_python_path(tmp_path):
     b = next(iter(it))
     assert b.data[0].shape == (4, 3, 16, 16)
     assert b.label[0].shape[0] == 4
+
+
+def test_image_record_iter_device_normalize_parity(tmp_path):
+    """device_normalize=True: uint8 batches + on-device normalize()
+    must equal the host-normalized fp32 batches."""
+    rec = str(tmp_path / "devnorm.rec")
+    rng = onp.random.RandomState(2)
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (12, 12, 3), dtype=onp.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+    kw = dict(path_imgrec=rec, data_shape=(3, 12, 12), batch_size=4,
+              shuffle=False, rand_mirror=False,
+              mean_r=100.0, mean_g=110.0, mean_b=120.0,
+              std_r=50.0, std_g=55.0, std_b=60.0, scale=1.0)
+    host = mx.io.ImageRecordIter(**kw)
+    dev = mx.io.ImageRecordIter(device_normalize=True, **kw)
+    b_host = host.next()
+    b_dev = dev.next()
+    x = b_dev.data[0]
+    assert str(x.dtype) == "uint8"
+    normed = dev.normalize(x)
+    assert onp.allclose(normed.asnumpy(), b_host.data[0].asnumpy(), atol=1e-4)
+    assert onp.allclose(b_dev.label[0].asnumpy(), b_host.label[0].asnumpy())

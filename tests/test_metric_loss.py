@@ -230,3 +230,15 @@ def test_accuracy_device_accumulation_flushes_exactly():
     # get_global flushes too
     _, gacc = m.get_global()
     assert gacc == 1.0
+
+
+def test_loss_metric_bf16_accumulation_upcast():
+    """bf16 loss tensors must accumulate in fp32/float64, not bf16
+    (bf16 running sums round away increments past ~256)."""
+    m = metric_mod.Loss()
+    val = NDArray(jnp.full((4,), 100.0, jnp.bfloat16))
+    for _ in range(200):  # bf16 partial would saturate ~256 quickly
+        m.update(None, [val])
+    _, avg = m.get()
+    assert abs(avg - 100.0) < 0.5, avg
+    assert m.num_inst == 800
