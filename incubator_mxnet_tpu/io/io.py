@@ -429,10 +429,14 @@ class ImageRecordIter(DataIter):
         """Consumer side of `device_normalize=True`: returns a
         HybridBlock doing uint8 → on-device normalize → cast(dtype) →
         net, all inside one traced program.  Save/load parameters via
-        the INNER net (the wrapper adds no params of its own)."""
+        the INNER net (the wrapper adds no params of its own).  The
+        wrapper copies mean/std/scale — it does NOT keep the iterator
+        alive, so the model stays usable after the iterator is gone."""
         from ..gluon.block import HybridBlock
 
-        it = self
+        mean = self.mean.reshape(1, -1, 1, 1).copy()
+        std = self.std.reshape(1, -1, 1, 1).copy()
+        scale = float(self._scale)
 
         class _NormalizedNet(HybridBlock):
             def __init__(self, **kw):
@@ -440,7 +444,16 @@ class ImageRecordIter(DataIter):
                 self.net = net
 
             def forward(self, x):
-                return self.net(it.normalize(x).astype(dtype))
+                from .. import ndarray as nd
+
+                x = x.astype("float32")
+                if scale != 1.0:
+                    x = x * scale
+                if (mean != 0).any():
+                    x = x - nd.NDArray(jnp.asarray(mean))
+                if (std != 1).any():
+                    x = x / nd.NDArray(jnp.asarray(std))
+                return self.net(x.astype(dtype))
 
         return _NormalizedNet()
 

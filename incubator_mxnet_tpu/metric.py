@@ -358,6 +358,11 @@ class CompositeEvalMetric(EvalMetric):
         for m in getattr(self, "metrics", []):
             m.reset()
 
+    def reset_local(self):
+        # Speedometer auto_reset must clear the CHILDREN's local sums
+        for m in getattr(self, "metrics", []):
+            m.reset_local()
+
     def get(self):
         names, values = [], []
         for m in self.metrics:

@@ -301,22 +301,32 @@ def _translate_eqn(ctx: _Ctx, eqn):
         inner = closed.jaxpr if closed else sub
         consts = closed.consts if closed else p.get("consts", ())
         # wire sub-jaxpr invars to our names, recurse (dead-code
-        # eliminated — kills inference-dead PRNG-key chains), wire back
+        # eliminated — kills inference-dead PRNG-key chains), wire back.
+        # The recursion runs in a FRESH name scope: jax shares one inner
+        # jaxpr object across identical calls (e.g. two structurally
+        # equal residual blocks), so its Var objects repeat — without
+        # scoping, the second invocation would silently reuse the first
+        # one's tensor names and alias both blocks' computations.
         from jax._src.core import Literal
 
-        for iv, outer in zip(inner.invars, ins[:len(inner.invars)]):
-            if str(getattr(iv.aval, "dtype", "")).startswith("key"):
-                ctx.names[iv] = None
-            else:
-                ctx.names[iv] = ctx.name_of(outer)
+        outer_in_names = [
+            None if str(getattr(iv.aval, "dtype", "")).startswith("key")
+            else ctx.name_of(outer)
+            for iv, outer in zip(inner.invars, ins[:len(inner.invars)])]
+        saved_names = ctx.names
+        ctx.names = {}
+        for iv, nm in zip(inner.invars, outer_in_names):
+            ctx.names[iv] = nm
         for cv, c in zip(inner.constvars, consts):
             ctx.names[cv] = ctx.add_const(onp.asarray(c)) \
                 if not str(getattr(c, "dtype", "")).startswith("key") else None
         live_out = [v for v in inner.outvars if not isinstance(v, Literal)]
         for sub_eqn in _live_eqns(inner, live_out):
             _translate_eqn(ctx, sub_eqn)
-        for ov, outer in zip(inner.outvars, outs):
-            ctx.names[outer] = ctx.name_of(ov)
+        inner_out_names = [ctx.name_of(ov) for ov in inner.outvars]
+        ctx.names = saved_names
+        for outer, nm in zip(outs, inner_out_names):
+            ctx.names[outer] = nm
         return
     raise NotImplementedError(
         f"ONNX export: no mapping for jax primitive {prim!r}")

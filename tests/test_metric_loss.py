@@ -242,3 +242,16 @@ def test_loss_metric_bf16_accumulation_upcast():
     _, avg = m.get()
     assert abs(avg - 100.0) < 0.5, avg
     assert m.num_inst == 800
+
+
+def test_composite_metric_reset_local_clears_children():
+    comp = metric_mod.CompositeEvalMetric([metric_mod.Accuracy(),
+                                           metric_mod.Loss()])
+    pred = NDArray(jnp.eye(4, dtype=jnp.float32))
+    lab = NDArray(jnp.arange(4, dtype=jnp.int32))
+    comp.update([lab], [pred])
+    comp.reset_local()
+    acc = comp.get_metric(0)
+    assert acc.num_inst == 0 and acc.sum_metric == 0.0
+    # global totals survive the local reset
+    assert acc.global_num_inst == 4

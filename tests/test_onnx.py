@@ -129,3 +129,16 @@ def test_export_hybridized_block(tmp_path):
     x = NDArray(jnp.ones((3, 10)))
     net(x)
     _roundtrip(net, x, tmp_path / "hyb.onnx")
+
+
+def test_export_import_resnet18(tmp_path):
+    """Model-zoo round-trip — the realistic inference-interop case
+    (residual adds, BN inference stats, global pooling)."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(3)
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = NDArray(jax.random.normal(jax.random.PRNGKey(5), (2, 3, 32, 32)))
+    net(x)
+    _roundtrip(net, x, tmp_path / "resnet18.onnx", atol=1e-3)

@@ -169,3 +169,48 @@ def test_pipeline_skip_inactive_matches_masked():
                                 rtol=1e-5, atol=1e-5)
     onp.testing.assert_allclose(onp.asarray(skipped), onp.asarray(want),
                                 rtol=1e-5, atol=1e-5)
+
+
+def test_sync_batchnorm_global_stats_under_sharding():
+    """The SyncBatchNorm ≡ BatchNorm SPMD-equivalence claim, verified:
+    a jitted BN training forward over a data-SHARDED batch must use the
+    GLOBAL batch statistics (XLA inserts the cross-device reduction),
+    matching the single-device full-batch oracle."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.contrib.nn import SyncBatchNorm
+    from incubator_mxnet_tpu.gluon.block import functionalize
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu import parallel
+
+    mx.random.seed(0)
+    bn = SyncBatchNorm(in_channels=4)
+    bn.initialize()
+    # deliberately NON-IID across the batch so per-shard statistics
+    # differ wildly from the global ones (each shard has a different
+    # mean) — a local-stats BN would give a very different answer
+    rs = onp.random.RandomState(0)
+    x = onp.concatenate([rs.randn(2, 4, 3, 3).astype("float32") + 10 * i
+                         for i in range(8)], axis=0)  # (16, 4, 3, 3)
+
+    apply_fn, train_raws, aux_raws = functionalize(bn, NDArray(jnp.asarray(x)))
+
+    mesh = parallel.create_mesh(data=8)
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def fwd(tr, aux, xv):
+        (out), new_aux = apply_fn(tr, aux, jax.random.PRNGKey(0), xv,
+                                  training=True)
+        return out
+
+    sharded = onp.asarray(fwd(train_raws, aux_raws, xs))
+    oracle = onp.asarray(fwd(train_raws, aux_raws, jnp.asarray(x)))
+    assert onp.allclose(sharded, oracle, atol=1e-4), \
+        "BN over a sharded batch diverged from global-batch statistics"
+    # sanity: the global result is actually normalized (mean~0 per ch)
+    assert abs(float(sharded.mean())) < 0.2
