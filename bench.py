@@ -22,23 +22,10 @@ import sys
 import time
 
 
-# bf16 peak FLOP/s per chip by device kind substring
-_PEAKS = [
-    ("v6e", 918e12), ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
-
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for sub, peak in _PEAKS:
-        if sub in kind:
-            return peak
-    return 1e12  # unknown accelerator / CPU: nominal 1 TFLOP/s
+    from incubator_mxnet_tpu.callback import device_peak_flops
+
+    return device_peak_flops(device)
 
 
 def main():

@@ -7,8 +7,9 @@ import collections
 import logging
 import time
 
-__all__ = ["BatchEndParam", "Speedometer", "do_checkpoint", "log_train_metric",
-           "LogValidationMetricsCallback", "module_checkpoint"]
+__all__ = ["BatchEndParam", "Speedometer", "MFUMeter", "do_checkpoint",
+           "log_train_metric", "LogValidationMetricsCallback",
+           "module_checkpoint"]
 
 # ref python/mxnet/model.py BatchEndParam — the record batch callbacks receive
 BatchEndParam = collections.namedtuple(
@@ -38,7 +39,7 @@ class Speedometer:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
                         param.eval_metric.reset_local()
-                    msg = f"Epoch[{param.epoch}] Batch [{count}]\tSpeed: {speed:.2f} samples/sec"
+                    msg = self._speed_msg(param, count, speed)
                     for name, value in name_value:
                         msg += f"\t{name}={value:f}"
                     logging.info(msg)
@@ -49,6 +50,56 @@ class Speedometer:
         else:
             self.init = True
             self.tic = time.time()
+
+    def _speed_msg(self, param, count, speed) -> str:
+        """Subclass hook: the line prefix before the metric values."""
+        return (f"Epoch[{param.epoch}] Batch [{count}]\t"
+                f"Speed: {speed:.2f} samples/sec")
+
+
+_BF16_PEAKS = [  # chip-kind substring -> bf16 peak FLOP/s (canonical
+    ("v6e", 918e12), ("v6", 918e12),     # table — bench.py imports it)
+    ("v5p", 459e12),
+    ("v5e", 197e12), ("v5 lite", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
+
+
+def device_peak_flops(device=None) -> float:
+    """Best-effort bf16 peak for the (first) local accelerator."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    for sub, peak in _BF16_PEAKS:
+        if sub in kind:
+            return peak
+    return 1e12  # unknown device: nominal 1 TFLOP/s
+
+
+class MFUMeter(Speedometer):
+    """Speedometer that also reports model FLOPs utilization.
+
+    `flops_per_sample`: analytic training FLOPs per sample (≈ 6·params
+    per token × tokens for transformers, 3 × fwd-FLOPs for convnets).
+    SURVEY.md §5.5 "step-rate/MFU meters" — no reference counterpart
+    (MFU is the TPU-era metric of record, BASELINE.json north star).
+    Inherits Speedometer's full state machine (epoch rollover, metric
+    auto-reset); only the report line differs.
+    """
+
+    def __init__(self, batch_size, flops_per_sample, frequent=50,
+                 auto_reset=True, peak_flops=None):
+        super().__init__(batch_size, frequent, auto_reset)
+        self.flops_per_sample = float(flops_per_sample)
+        self.peak_flops = peak_flops
+
+    def _speed_msg(self, param, count, speed) -> str:
+        if self.peak_flops is None:
+            self.peak_flops = device_peak_flops()
+        mfu = speed * self.flops_per_sample / self.peak_flops
+        return (f"Epoch[{param.epoch}] Batch [{count}]\t"
+                f"Speed: {speed:.2f} samples/sec\tMFU: {100 * mfu:.2f}%")
 
 
 def do_checkpoint(prefix, period=1):

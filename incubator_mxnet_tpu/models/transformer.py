@@ -200,6 +200,10 @@ class LabelSmoothedCELoss(HybridBlock):
         super().__init__(**kwargs)
         self._eps = smoothing
         self._ignore = ignore_index
+        # hybridized like gluon.loss.*: `loss_fn(net(x), y)` chains into
+        # the single fused train-step program instead of forcing the
+        # net's pending step (block._try_chain)
+        self.hybridize()
 
     def forward(self, logits, labels):
         import jax

@@ -255,3 +255,22 @@ def test_composite_metric_reset_local_clears_children():
     assert acc.num_inst == 0 and acc.sum_metric == 0.0
     # global totals survive the local reset
     assert acc.global_num_inst == 4
+
+
+def test_mfu_meter_reports(caplog):
+    import logging
+
+    from incubator_mxnet_tpu import callback
+
+    meter = callback.MFUMeter(batch_size=4, flops_per_sample=1e9,
+                              frequent=2, peak_flops=1e12)
+    m = metric_mod.Accuracy()
+    pred = NDArray(jnp.eye(4, dtype=jnp.float32))
+    lab = NDArray(jnp.arange(4, dtype=jnp.int32))
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 5):
+            m.update([lab], [pred])
+            meter(callback.BatchEndParam(epoch=0, nbatch=nb, eval_metric=m,
+                                         locals=None))
+    out = "\n".join(r.message for r in caplog.records)
+    assert "MFU:" in out and "samples/sec" in out and "accuracy" in out
