@@ -22,7 +22,8 @@ __all__ = ["pipeline_forward", "pipeline_apply"]
 
 
 def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
-                     axis_name: str = "pipe", skip_inactive: bool = False):
+                     axis_name: str = "pipe", skip_inactive: bool = False,
+                     remat_stage: bool = False):
     """Inside-shard_map GPipe forward.
 
     stage_fn(params, x) -> y : one stage's compute (same signature all
@@ -37,7 +38,17 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
     r1 review's PP-efficiency gap).  ONLY safe when stage_fn contains
     no collectives — with e.g. TP psum inside the stage, divergent
     per-device branches would deadlock, so it defaults off.
+
+    remat_stage: recompute the stage in the backward instead of saving
+    its internals per tick.  Under jax.grad the scan otherwise stores
+    every tick's stage residuals (GPipe's O(M) activation memory —
+    the problem 1F1B schedules exist to fix); with remat only the
+    per-tick INPUT survives, so activation memory drops from
+    O(M · stage_residuals) to O(M · activation) + one in-flight
+    recompute — the 1F1B memory profile with XLA's reverse pipeline.
     """
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
@@ -82,7 +93,7 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
 
 def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
                    num_microbatches: int, axis_name: str = "pipe",
-                   skip_inactive: bool = False):
+                   skip_inactive: bool = False, remat_stage: bool = False):
     """Top-level: split batch into microbatches, shard stage params over
     `axis_name` (leading axis = stage), run the GPipe schedule.
 
@@ -98,7 +109,8 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
     def inner(params, xmb):
         local = jax.tree_util.tree_map(lambda p: p[0], params)  # this stage's slice
         return pipeline_forward(stage_fn, local, xmb, axis_name,
-                                skip_inactive=skip_inactive)
+                                skip_inactive=skip_inactive,
+                                remat_stage=remat_stage)
 
     param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), all_stage_params)
     fn = shard_map(inner, mesh=mesh,
