@@ -39,7 +39,8 @@ class Trainer:
                  optimizer, optimizer_params: Optional[dict] = None,
                  kvstore="device", compression_params=None, update_on_kvstore=None,
                  fuse_step: bool = True, donate: bool = True,
-                 keep_grads: bool = True, max_inflight_steps: int = 8):
+                 keep_grads: bool = True, max_inflight_steps: int = 8,
+                 mesh=None, data_axis: str = "data"):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -85,6 +86,72 @@ class Trainer:
         from collections import deque
 
         self._inflight = deque()
+        # SPMD: an explicit Mesh (or one inferred from already-sharded
+        # params via parallel.sharding.shard_params) makes the fused
+        # step a multi-device GSPMD program: optimizer states are
+        # created on each param's sharding and unsharded batch inputs
+        # are placed on the data axis.  The training loop is unchanged —
+        # this is how "gluon.Trainer scales across a TPU pod"
+        # (BASELINE.json north star) without a DataParallelExecutorGroup.
+        self._mesh = mesh
+        self._data_axis = data_axis
+
+    def _get_mesh(self):
+        """Explicit mesh, else inferred from any NamedSharded param.
+        Re-probes while None so `shard_params` called after Trainer
+        construction (or after a warmup step) is still picked up."""
+        if self._mesh is None:
+            from jax.sharding import NamedSharding
+
+            for p in self._params:
+                if p._data_nd is None or p._data_nd._lazy is not None:
+                    continue
+                sh = getattr(p._data_nd._raw, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    self._mesh = sh.mesh
+                    break
+        return self._mesh
+
+    def _shard_state_like(self, state, w):
+        """Place same-shape optimizer-state leaves (momentum, fp32
+        master, ...) on the weight's sharding — TP memory savings apply
+        to the full train state, not just the weights."""
+        from jax.sharding import NamedSharding
+
+        sh = getattr(w, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            return state
+
+        def put(leaf):
+            if hasattr(leaf, "shape") and tuple(leaf.shape) == tuple(w.shape):
+                return jax.device_put(leaf, sh)
+            return leaf
+
+        return jax.tree_util.tree_map(put, state)
+
+    def _shard_inputs(self, input_raws):
+        """Place uncommitted/unsharded batch inputs on the data axis.
+
+        Inputs the user already NamedSharded (seq-parallel splits, ...)
+        are left untouched; anything fresh from host whose leading dim
+        divides the data axis gets P(data, None, ...)."""
+        mesh = self._get_mesh()
+        if mesh is None or self._data_axis not in mesh.axis_names:
+            return input_raws
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = mesh.shape[self._data_axis]
+        if n <= 1:
+            return input_raws
+        out = []
+        for r in input_raws:
+            sh = getattr(r, "sharding", None)
+            if (not isinstance(sh, NamedSharding) and hasattr(r, "shape")
+                    and r.ndim >= 1 and r.shape[0] % n == 0):
+                spec = P(self._data_axis, *([None] * (r.ndim - 1)))
+                r = jax.device_put(r, NamedSharding(mesh, spec))
+            out.append(r)
+        return tuple(out)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -210,8 +277,10 @@ class Trainer:
             self._fused_key = key
             for i in idxs:
                 if i not in self._states:
-                    self._states[i] = opt.create_state_multi_precision(
-                        i, self._params[i].data())
+                    self._states[i] = self._shard_state_like(
+                        opt.create_state_multi_precision(
+                            i, self._params[i].data()),
+                        self._params[i]._data_nd._data)
             donate = (0, 2) if self._donate else ()
             stacked = self._make_stacked_update(lr_mults, wd_mults, clip)
 
@@ -302,9 +371,10 @@ class Trainer:
         idx_of = ctx["idx_of"]
         ts, lr, keys = self._step_scalars(idx_of)
         states = ctx["states"]
+        input_raws = self._shard_inputs(pending.input_raws)
         out_leaves, new_aux, grads, new_w, new_s = ctx["fn"](
             pending.train_raws, pending.aux_raws, states, pending.rng,
-            pending.rng_ctr, pending.input_raws, ts, lr, opt.wd,
+            pending.rng_ctr, input_raws, ts, lr, opt.wd,
             opt.rescale_grad, keys)
         pending.fill_from_full_step(out_leaves, new_aux,
                                     grads if self._keep_grads else None)
@@ -340,8 +410,9 @@ class Trainer:
         self._sync_states()
         for i in idx_of:
             if i not in self._states:
-                self._states[i] = opt.create_state_multi_precision(
-                    i, self._params[i].data())
+                self._states[i] = self._shard_state_like(
+                    opt.create_state_multi_precision(i, self._params[i].data()),
+                    self._params[i]._data_nd._data)
         mults = self._mults_key(idx_of)
         fn = self._build_full_step(pending, mults)
         return {
@@ -420,7 +491,9 @@ class Trainer:
             if p.grad_req == "null" or p._data_nd is None:
                 continue
             if i not in self._states:
-                self._states[i] = self._optimizer.create_state_multi_precision(i, p.data())
+                self._states[i] = self._shard_state_like(
+                    self._optimizer.create_state_multi_precision(i, p.data()),
+                    p._data_nd._data)
             self._states[i] = self._optimizer.update_multi_precision(
                 i, p.data(), p.grad(), self._states[i])
             # grads are left in place (reference semantics): with

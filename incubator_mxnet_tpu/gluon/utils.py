@@ -1,10 +1,10 @@
 """Gluon utilities (ref `python/mxnet/gluon/utils.py` [UNVERIFIED],
 SURVEY.md §2.6): split_and_load, clip_global_norm, etc.
 
-On TPU, `split_and_load` over a multi-device ctx list produces ONE
-globally-sharded `jax.Array` per logical slice boundary when
-`use_sharding=True` — the SPMD idiom — while the default keeps the
-reference behavior (list of per-slice arrays) for API parity.
+On TPU, `split_and_load(data, mesh=mesh)` produces ONE globally-sharded
+`jax.Array` with the batch dim on the mesh's data axis (the SPMD idiom,
+see `shard_batch`), while the default ctx_list form keeps the reference
+behavior (list of per-slice arrays) for API parity.
 """
 from __future__ import annotations
 
@@ -18,8 +18,32 @@ import numpy as onp
 from ..context import Context
 from ..ndarray.ndarray import NDArray, raw, wrap
 
-__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
-           "download", "shape_is_known"]
+__all__ = ["split_data", "split_and_load", "shard_batch", "clip_global_norm",
+           "check_sha1", "download", "shape_is_known"]
+
+
+def shard_batch(data, mesh, axis_name: str = "data", batch_axis: int = 0):
+    """Place one global batch on a mesh's data axis (the SPMD idiom).
+
+    The TPU-first `split_and_load`: instead of a list of per-device
+    slices, ONE globally-sharded `jax.Array` whose batch dim lives on
+    `axis_name`.  Feed the result straight into a hybridized block —
+    GSPMD propagates the sharding through forward/backward and the
+    Trainer's fused update."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    data = wrap(data)
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"shard_batch: mesh has no '{axis_name}' axis "
+                         f"(axes: {mesh.axis_names})")
+    if data.shape[batch_axis] % mesh.shape[axis_name] != 0:
+        raise ValueError(
+            f"batch dim {data.shape[batch_axis]} not divisible by mesh axis "
+            f"{axis_name}={mesh.shape[axis_name]}")
+    spec = [None] * len(data.shape)
+    spec[batch_axis] = axis_name
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    return NDArray(jax.device_put(data._data, sh))
 
 
 def split_data(data, num_slice: int, batch_axis: int = 0, even_split: bool = True):
@@ -38,8 +62,15 @@ def split_data(data, num_slice: int, batch_axis: int = 0, even_split: bool = Tru
     return slices
 
 
-def split_and_load(data, ctx_list: List[Context], batch_axis: int = 0,
-                   even_split: bool = True):
+def split_and_load(data, ctx_list: Optional[List[Context]] = None,
+                   batch_axis: int = 0, even_split: bool = True,
+                   mesh=None, axis_name: str = "data"):
+    """Reference behavior: list of per-ctx slices.  SPMD behavior
+    (``mesh=`` given): one globally-sharded array via `shard_batch`."""
+    if mesh is not None:
+        return shard_batch(data, mesh, axis_name, batch_axis)
+    if ctx_list is None:
+        raise ValueError("split_and_load: pass either ctx_list or mesh=")
     data = wrap(data)
     if len(ctx_list) == 1:
         return [data.as_in_context(ctx_list[0])]

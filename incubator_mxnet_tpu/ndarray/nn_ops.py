@@ -459,6 +459,17 @@ def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: boo
 
     key = _random.next_key()
 
+    from ..ops.dropout_kernel import _use_kernel
+
+    if not axes and _use_kernel():
+        # TPU: in-kernel PRNG mask (ops/dropout_kernel) — no threefry
+        # mask materialized through HBM (the BERT "dropout tax",
+        # BASELINE.md); backward regenerates the mask from the seed.
+        from ..ops.dropout_kernel import fused_dropout
+
+        seed_arr = _random.key_to_seed(key)
+        return apply_op(lambda x: fused_dropout(x, seed_arr, float(p)), data)
+
     def f(x, k):
         shape = list(x.shape)
         for a in axes:

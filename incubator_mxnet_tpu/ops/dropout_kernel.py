@@ -1,0 +1,121 @@
+"""Fused dropout — mask generated IN-KERNEL by the TPU core PRNG.
+
+Kills the "dropout tax" (BASELINE.md: threefry mask generation cost
+~16 ms/step ≈ 20 MFU points on BERT-large): instead of materializing a
+full-size mask through XLA's counter-based threefry (bandwidth-bound:
+mask write + read on top of the data traffic), each Pallas program
+seeds the per-core PRNG (`pltpu.prng_seed`) and draws the keep-mask for
+its tile on the fly — the op touches HBM exactly twice (read x, write
+out), the bandwidth floor of any elementwise op.
+
+Backward regenerates the SAME bits from the same (seed, program_id)
+instead of saving the mask — zero extra memory, the recompute trick the
+reference's fused dropout uses for cuDNN-free paths
+(ref: src/operator/nn/dropout.cc MSHADOW path, SURVEY.md §2.3).
+
+CPU/interpret falls back to the threefry reference (`_dropout_ref`) —
+identical distribution, different stream; tests assert statistics and
+the fwd/bwd mask-consistency property, not bit equality with XLA.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_dropout"]
+
+# one grid row owns (_BLOCK_ROWS, cols) in VMEM; cols padded to lanes
+_BLOCK_ROWS = 1024
+
+
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # distinct stream per grid program: same (seed, pid) in fwd and bwd
+    # regenerates the identical mask
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    # raw bits come back int32 — bitcast before the unsigned compare
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
+    # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)
+    thresh = jnp.uint32(min(int(rate * (1 << 32)), (1 << 32) - 1))
+    keep = bits >= thresh
+    scale = 1.0 / (1.0 - rate)
+    x = x_ref[...]
+    o_ref[...] = jnp.where(keep, x * jnp.asarray(scale, x.dtype),
+                           jnp.zeros_like(x))
+
+
+def _run(x, seed, rate, interpret):
+    """Reshape to (rows, 128k) tiles, pad the tail row, run the kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x.size
+    cols = 512 if n % 512 == 0 else 128
+    if n % cols != 0:  # ragged tail: pad to a full row
+        pad = cols - n % cols
+        flat = jnp.pad(x.reshape(-1), (0, pad))
+    else:
+        pad = 0
+        flat = x.reshape(-1)
+    x2d = flat.reshape(-1, cols)
+    rows = x2d.shape[0]
+    br = min(_BLOCK_ROWS, rows)
+    out = pl.pallas_call(
+        functools.partial(_dropout_kernel, rate=rate),
+        grid=((rows + br - 1) // br,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # seed scalar
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=interpret,
+    )(seed, x2d)
+    flat_out = out.reshape(-1)
+    if pad:
+        flat_out = flat_out[:n]
+    return flat_out.reshape(x.shape)
+
+
+def _dropout_ref(x, seed, rate):
+    """Threefry reference path (CPU / correctness oracle)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(0), seed[0])
+    keep = jax.random.bernoulli(key, 1.0 - rate, shape=x.shape)
+    return jnp.where(keep, x / jnp.asarray(1.0 - rate, x.dtype),
+                     jnp.zeros_like(x)).astype(x.dtype)
+
+
+def _use_kernel():
+    # TPU backends only ("axon" = this sandbox's tunneled v5e); CUDA/
+    # Metal/CPU take the threefry reference — pltpu primitives are
+    # Mosaic-TPU-only.  nn_ops.Dropout gates on this same predicate.
+    return jax.default_backend() in ("tpu", "axon")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_dropout(x, seed, rate: float):
+    """Dropout with in-kernel PRNG mask. ``seed``: (1,) int32 array —
+    derive it from the step key via `random.key_to_seed`; same seed →
+    same mask (what makes the zero-memory backward exact)."""
+    if rate >= 1.0:  # degenerate: drop everything (threefry-path parity)
+        return jnp.zeros_like(x)
+    if _use_kernel():
+        return _run(x, seed, rate, interpret=False)
+    return _dropout_ref(x, seed, rate)
+
+
+def _fwd(x, seed, rate):
+    return fused_dropout(x, seed, rate), seed
+
+
+def _bwd(rate, seed, dy):
+    # regenerate the identical mask: dx = mask * scale * dy — exactly
+    # the forward applied to dy
+    return fused_dropout(dy, seed, rate), None
+
+
+fused_dropout.defvjp(_fwd, _bwd)

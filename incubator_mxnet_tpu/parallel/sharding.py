@@ -2,72 +2,231 @@
 
 The reference's only model-parallel primitive is `group2ctx` manual
 placement (SURVEY.md §2.4 TP row).  Here: Megatron-style PartitionSpec
-rules assigned by parameter-name pattern — Dense column/row pairs,
-attention QKV column-sharded, output proj row-sharded, embeddings
-vocab-sharded — applied by `shard_params(block, mesh)`, after which any
-jitted step over those arrays gets XLA-inserted ICI collectives.
+rules matched against a Block's STRUCTURAL parameter paths (e.g.
+``encoder.layer0.attention.qkv.weight`` — stable attribute paths from
+`Block._collect_params_with_prefix`, not the instance-counter global
+names), applied by `shard_params(block, mesh)`.  After placement, any
+jitted step over those arrays gets XLA-inserted ICI collectives via
+GSPMD propagation — including the Trainer's fused fwd+bwd+update
+program, which is how `gluon.Trainer` scales over a mesh with zero
+changes to the training loop.
+
+`shard_params` returns a `ShardingReport`: every decision is recorded
+and silent full replication is impossible — anything that *looked*
+shardable but wasn't (no rule matched, or a mesh axis didn't divide the
+dim) is listed, and a warning fires when TP was requested but nothing
+was actually sharded.
 """
 from __future__ import annotations
 
+import logging
 import re
-from typing import Dict, List, Optional, Tuple
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["TP_RULES_TRANSFORMER", "spec_for", "shard_params", "shard_param_tree",
-           "data_parallel_spec"]
+log = logging.getLogger(__name__)
 
-# (name regex, PartitionSpec) — first match wins.  Specs refer to the
-# 'model' mesh axis; params are (out, in) per FullyConnected convention.
+__all__ = ["TP_RULES_TRANSFORMER", "ShardingReport", "spec_for",
+           "shard_params", "shard_param_tree", "data_parallel_spec"]
+
+# (path regex, PartitionSpec) — first match wins; matched with
+# re.search against the structural path.  Specs refer to the 'model'
+# mesh axis; Dense weights are (out, in), Embedding weights are
+# (vocab, units) per gluon/nn/basic_layers.py.
 TP_RULES_TRANSFORMER: List[Tuple[str, P]] = [
-    (r".*(query|key|value|qkv).*weight", P("model", None)),   # column parallel
-    (r".*(proj|out_proj|o_proj).*weight", P(None, "model")),  # row parallel
-    (r".*ffn.*(up|gate|inter|fc1|dense1).*weight", P("model", None)),
-    (r".*ffn.*(down|fc2|dense2|out).*weight", P(None, "model")),
-    (r".*embed.*weight", P("model", None)),                   # vocab-sharded
-    (r".*(gamma|beta|bias)$", P()),                           # replicated
+    # column parallel: QKV projections, fused or split
+    (r"(query|key|value|qkv|q_proj|k_proj|v_proj)\.weight$", P("model", None)),
+    # column parallel: FFN up / gate (before the bare-proj rule: up_proj/
+    # gate_proj must not be captured as row-parallel)
+    (r"(ffn_dense1|fc1|dense1|w1|up_proj|gate_proj|inter)\.weight$",
+     P("model", None)),
+    # row parallel: FFN down
+    (r"(ffn_dense2|fc2|dense2|w2|down_proj)\.weight$", P(None, "model")),
+    # vocab-sharded: embedding tables (vocab, units) and LM heads (vocab, units)
+    (r"(embed|embedding|decoder|lm_head|vocab_proj)[^.]*\.weight$",
+     P("model", None)),
+    # row parallel: attention output projection — the bare `proj`
+    # alternative is anchored to a path segment so it cannot swallow
+    # `*_proj` names handled above
+    (r"(^|\.)(out_proj|o_proj|proj)\.weight$", P(None, "model")),
+    # replicated: norms, biases, BN stats
+    (r"(gamma|beta|bias|running_mean|running_var)$", P()),
 ]
 
 
+class ShardingReport(dict):
+    """``{structural_name: final PartitionSpec}`` plus full accounting.
+
+    - ``sharded``:    name → spec actually placed on ≥1 mesh axis
+    - ``replicated``: name → "why" for every fully-replicated param
+    - ``fallbacks``:  name → (wanted_spec, reason) where a rule matched
+                      but validation had to drop an axis (non-dividing
+                      dim / axis missing from the mesh) — the silent-
+                      replication trap, now loud
+    - ``unmatched``:  names of ndim≥2 params no rule matched
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.sharded: Dict[str, P] = {}
+        self.replicated: Dict[str, str] = {}
+        self.fallbacks: Dict[str, Tuple[P, str]] = {}
+        self.unmatched: List[str] = []
+        self._elems_sharded = 0
+        self._elems_matrix = 0
+
+    @property
+    def coverage(self) -> float:
+        """Fraction of matrix (ndim≥2) parameter elements that ended up
+        sharded — the honest TP-memory-savings number."""
+        return self._elems_sharded / max(1, self._elems_matrix)
+
+    def summary(self) -> str:
+        lines = [f"shard_params: {len(self.sharded)} sharded / "
+                 f"{len(self.replicated)} replicated "
+                 f"({self.coverage:.0%} of matrix-param elements sharded)"]
+        for n, (want, why) in self.fallbacks.items():
+            lines.append(f"  FALLBACK {n}: wanted {want} but {why}")
+        if self.unmatched:
+            lines.append(f"  no rule matched (replicated): "
+                         f"{', '.join(self.unmatched)}")
+        return "\n".join(lines)
+
+
 def spec_for(name: str, shape, rules=None) -> P:
-    rules = rules or TP_RULES_TRANSFORMER
-    for pat, spec in rules:
-        if re.match(pat, name):
-            # drop axes that don't divide; fall back to replication per-axis
-            cleaned = []
-            for dim, ax in zip(shape, list(spec) + [None] * (len(shape) - len(spec))):
-                cleaned.append(ax)
-            return P(*cleaned[:len(shape)])
-    return P()
+    """Rule lookup only (no mesh validation); P() when nothing matches."""
+    spec, _matched = _match_rule(name, rules)
+    return _pad_spec(spec, len(shape))
 
 
-def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None):
-    """Assign NamedShardings to every initialized Parameter of a Block
-    and device_put the arrays accordingly. Returns {name: spec}."""
-    assigned = {}
-    for name, p in block.collect_params().items():
-        if p._data_nd is None:
-            continue
-        spec = spec_for(name, p.shape, rules)
-        spec = _validate(spec, p.shape, mesh)
-        p.sharding = spec
-        sh = NamedSharding(mesh, spec)
-        p._data_nd._data = jax.device_put(p._data_nd._data, sh)
-        if p._data_nd._grad is not None:
-            p._data_nd._grad._data = jax.device_put(p._data_nd._grad._data, sh)
-        assigned[name] = spec
-    return assigned
+def _match_rule(name: str, rules) -> Tuple[P, bool]:
+    for pat, spec in (rules or TP_RULES_TRANSFORMER):
+        if re.search(pat, name):
+            return spec, True
+    return P(), False
 
 
-def _validate(spec: P, shape, mesh: Mesh) -> P:
-    axes = []
+def _pad_spec(spec: P, ndim: int) -> P:
+    axes = list(spec) + [None] * (ndim - len(spec))
+    return P(*axes[:ndim])
+
+
+def _validate(spec: P, shape, mesh: Mesh) -> Tuple[P, Optional[str]]:
+    """Drop axes that can't apply; return (clean spec, reason|None)."""
+    axes, reason = [], None
     for dim, ax in zip(shape, list(spec) + [None] * (len(shape) - len(spec))):
-        if ax is None or ax not in mesh.axis_names or dim % mesh.shape[ax] != 0:
+        if ax is None:
             axes.append(None)
+        elif ax not in mesh.axis_names:
+            axes.append(None)
+            reason = f"mesh has no '{ax}' axis"
+        elif dim % mesh.shape[ax] != 0:
+            axes.append(None)
+            reason = f"dim {dim} not divisible by {ax}={mesh.shape[ax]}"
         else:
             axes.append(ax)
+    return P(*axes), reason
+
+
+def _structural_params(block) -> Dict[str, object]:
+    """Structural-path name → Parameter.  Bare ParameterDict inputs only
+    expose instance-counter global names (``dense0_weight``) which the
+    default path-anchored TP rules can never match — warn loudly so a
+    `shard_params(net.collect_params(), mesh)` call doesn't silently
+    train fully replicated; pass the Block itself instead."""
+    if hasattr(block, "_collect_params_with_prefix"):
+        return dict(block._collect_params_with_prefix())
+    warnings.warn(
+        "shard_params: got a ParameterDict — TP rules match structural "
+        "paths ('encoder.layer0.attention.qkv.weight') which only a Block "
+        "provides; with global names the default rules will not shard "
+        "anything. Pass the Block itself (shard_params(net, mesh)).",
+        stacklevel=3)
+    return dict(block.collect_params().items()
+                if hasattr(block, "collect_params") else block.items())
+
+
+def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None,
+                 warn: bool = True, min_fsdp_elems: int = 2 ** 16
+                 ) -> ShardingReport:
+    """Assign NamedShardings to every initialized Parameter of `block`
+    and device_put data (and grad buffers) accordingly.
+
+    ``dp_axis``: optional FSDP-style fallback — params the TP rules left
+    fully replicated and larger than `min_fsdp_elems` are sharded on
+    their first dividing dim over this axis (XLA all-gathers on use;
+    ZeRO-3 memory profile).  Returns a `ShardingReport`.
+    """
+    report = ShardingReport()
+    tp_requested = any(
+        ax in mesh.axis_names and mesh.shape[ax] > 1
+        for _pat, spec in (rules or TP_RULES_TRANSFORMER)
+        for ax in spec if ax is not None)
+    for name, p in _structural_params(block).items():
+        if p._data_nd is None:
+            continue
+        want, matched = _match_rule(name, rules)
+        spec, reason = _validate(want, p.shape, mesh)
+        if dp_axis and len(p.shape) >= 1 and not any(spec) \
+                and _nelems(p.shape) >= min_fsdp_elems:
+            spec = _fsdp_spec(p.shape, mesh, dp_axis)
+        _place(p, mesh, spec)
+        report[name] = spec
+        if any(ax is not None for ax in spec):
+            report.sharded[name] = spec
+            report._elems_sharded += _nelems(p.shape) if len(p.shape) >= 2 else 0
+        else:
+            if matched and any(ax is not None for ax in want):
+                report.fallbacks[name] = (want, reason or "validation dropped axes")
+                report.replicated[name] = reason or "validation"
+            elif not matched and len(p.shape) >= 2:
+                report.unmatched.append(name)
+                report.replicated[name] = "no rule matched"
+            else:
+                report.replicated[name] = "rule: replicated"
+        if len(p.shape) >= 2:
+            report._elems_matrix += _nelems(p.shape)
+    if warn:
+        if report.fallbacks:
+            warnings.warn("shard_params: some matched TP rules fell back to "
+                          "replication —\n" + report.summary(), stacklevel=2)
+        elif tp_requested and not report.sharded:
+            warnings.warn("shard_params: TP axes requested but NO parameter "
+                          "was sharded (model would train fully replicated) —\n"
+                          + report.summary(), stacklevel=2)
+    log.info(report.summary())
+    return report
+
+
+def _nelems(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def _fsdp_spec(shape, mesh: Mesh, dp_axis: str) -> P:
+    if dp_axis not in mesh.axis_names:
+        return P(*([None] * len(shape)))
+    n = mesh.shape[dp_axis]
+    axes = [None] * len(shape)
+    for i, d in enumerate(shape):
+        if d % n == 0:
+            axes[i] = dp_axis
+            break
     return P(*axes)
+
+
+def _place(p, mesh: Mesh, spec: P) -> None:
+    p.sharding = spec
+    sh = NamedSharding(mesh, spec)
+    p._data_nd._data = jax.device_put(p._data_nd._data, sh)
+    g = p._data_nd._grad
+    if g is not None and g._lazy is None:
+        g._data = jax.device_put(g._data, sh)
 
 
 def shard_param_tree(params, mesh: Mesh, spec_tree):

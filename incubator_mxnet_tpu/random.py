@@ -73,6 +73,14 @@ class TraceKeyProvider:
         _STATE.provider = self._old
 
 
+def key_to_seed(key):
+    """Collapse a threefry key (uint32[2]) to the (1,) int32 seed the
+    in-kernel TPU PRNG consumes (`ops.dropout_kernel.fused_dropout`).
+    Works on traced keys — the jitted program stays key-parametric."""
+    k = jnp.asarray(key).astype(jnp.uint32).reshape(-1)
+    return (k[0] ^ k[-1]).astype(jnp.int32).reshape(1)
+
+
 def seed(seed_state: int, ctx=None):
     _STATE.key = jax.random.PRNGKey(int(seed_state))
     _STATE.cache = None
