@@ -493,7 +493,14 @@ class _ChainedOp:
             it = iter(input_raws[n_up_in:])
             d_leaves = [up_leaves[j] if j is not None else next(it)
                         for j in lmap]
-            # independent RNG stream for the downstream program
+            # independent RNG stream for the downstream program.
+            # DIVERGENCE (documented): the eager/fallback path would
+            # instead draw a fresh step key for the downstream block, so
+            # a STOCHASTIC downstream block (dropout-bearing head) sees
+            # different randomness depending on whether chaining engaged.
+            # Distributions are identical; exact bits are not.  Chaining
+            # is deterministic for a given program shape, so seeded runs
+            # remain reproducible among themselves.
             rng_d = jax.random.fold_in(rng, 0xC4A1 + depth)
             # downstream sees upstream's aux updates for shared aux
             aux_after_up = list(aux_raws)

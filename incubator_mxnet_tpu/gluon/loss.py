@@ -33,15 +33,22 @@ def _reshape_like(pred, label):
 
 
 class Loss(HybridBlock):
-    def __init__(self, weight=None, batch_axis=0, **kwargs):
+    """Base loss.
+
+    DIVERGENCE from the reference: losses hybridize by default (pure
+    elementwise programs — so `loss_fn(net(x), y)` on a hybridized net
+    chains into the ONE fused fwd+bwd+update program via
+    block._try_chain instead of forcing the net's pending step).  A
+    custom subclass whose `forward` uses data-dependent Python control
+    flow would fail at trace time — construct it with
+    ``hybridize=False`` to keep the reference's eager behavior."""
+
+    def __init__(self, weight=None, batch_axis=0, hybridize=True, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
         self._batch_axis = batch_axis
-        # losses are pure elementwise programs: hybridize by default so
-        # `loss_fn(net(x), y)` on a hybridized net chains into the ONE
-        # fused fwd+bwd+update program (block._try_chain) instead of
-        # forcing the net's pending step
-        self.hybridize()
+        if hybridize:
+            self.hybridize()
 
     def _mean_all_but_batch(self, x):
         axes = tuple(i for i in range(x.ndim) if i != self._batch_axis)

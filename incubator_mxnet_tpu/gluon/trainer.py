@@ -372,19 +372,19 @@ class Trainer:
         ts, lr, keys = self._step_scalars(idx_of)
         states = ctx["states"]
         input_raws = self._shard_inputs(pending.input_raws)
-        out_leaves, new_aux, grads, new_w, new_s = ctx["fn"](
+        out_leaves, new_aux, grads, new_w, new_s, sync = ctx["fn"](
             pending.train_raws, pending.aux_raws, states, pending.rng,
             pending.rng_ctr, input_raws, ts, lr, opt.wd,
             opt.rescale_grad, keys)
         pending.fill_from_full_step(out_leaves, new_aux,
                                     grads if self._keep_grads else None)
-        if self._keep_grads:
-            # bound the dispatch queue (see __init__): every queued step
-            # holds its grads outputs (~model size) until it retires.
-            # With keep_grads=False all outputs are donated aliases or
-            # scalars, so unbounded run-ahead is harmless — skip the
-            # sync, which costs a round-trip on relayed devices.
-            self._throttle(out_leaves[0] if out_leaves else new_w[0])
+        # ALWAYS bound the dispatch queue: even with keep_grads=False the
+        # non-donated forward outputs (e.g. a (B,T,V) logits leaf in the
+        # canonical net→loss chain) are held by every in-flight step, so
+        # unbounded run-ahead still exhausts HBM.  The sync leaf is a
+        # dedicated non-donated scalar — waiting on it never touches the
+        # donated buffers.
+        self._throttle(sync)
         for nd, nw in zip(ctx["nds"], new_w):
             nd._data = nw
         ctx["states"] = new_s
@@ -458,7 +458,13 @@ class Trainer:
                                    rescale, keys)
             out_leaves = jax.tree_util.tree_leaves(out)
             out_grads = tuple(grads) if keep_grads else ()
-            return (tuple(out_leaves), new_aux, out_grads, new_w, new_s)
+            # tiny NON-donated output depending on the update: the
+            # throttle's sync target (donated aliases can't be waited
+            # on, and with keep_grads=False the forward outputs still
+            # include logits-sized buffers each in-flight step holds)
+            sync = new_w[0].ravel()[0].astype(jnp.float32) if new_w \
+                else jnp.float32(0)
+            return (tuple(out_leaves), new_aux, out_grads, new_w, new_s, sync)
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(full, donate_argnums=donate)

@@ -103,23 +103,26 @@ class Monitor:
 
     # -- control ----------------------------------------------------------- #
     def tic(self):
-        """Start collecting for this step if the interval hits."""
+        """Start collecting for this step if the interval hits.
+
+        Advances the step counter (reference semantics): users may call
+        `tic()` every batch and `toc()`/`toc_print()` only when they
+        want stats — the interval must still progress."""
         if self.step % self.interval == 0:
             self.activated = True
             self.queue = []
+        self.step += 1
         return self
 
     def toc(self) -> List[Tuple[int, str, object]]:
         """Stop collecting; returns [(step, name, stat), ...]."""
         if not self.activated:
-            self.step += 1
             return []
         self.activated = False
         res = list(self.queue)
         self.queue = []
         if self.sort:
             res.sort(key=lambda t: t[1])
-        self.step += 1
         return res
 
     def toc_print(self):

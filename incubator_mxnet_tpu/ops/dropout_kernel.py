@@ -93,7 +93,15 @@ def _use_kernel():
     # TPU backends only ("axon" = this sandbox's tunneled v5e); CUDA/
     # Metal/CPU take the threefry reference — pltpu primitives are
     # Mosaic-TPU-only.  nn_ops.Dropout gates on this same predicate.
-    return jax.default_backend() in ("tpu", "axon")
+    #
+    # Single-device processes only: a pallas_call is not
+    # GSPMD-partitionable, so inside a sharded (mesh) train step it
+    # would fail to compile / force replication.  Multi-chip runs take
+    # the threefry path until the kernel grows a custom_partitioning
+    # rule (tracked as future work; the single-chip bench keeps the
+    # in-kernel PRNG win).
+    return (jax.default_backend() in ("tpu", "axon")
+            and len(jax.devices()) == 1)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

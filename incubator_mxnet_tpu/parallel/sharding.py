@@ -170,6 +170,12 @@ def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None,
             continue
         want, matched = _match_rule(name, rules)
         spec, reason = _validate(want, p.shape, mesh)
+        # the TP intent failed → record the fallback BEFORE any FSDP
+        # rescue, so the report never hides a broken TP rule
+        tp_failed = matched and any(ax is not None for ax in want) \
+            and not any(ax is not None for ax in spec)
+        if tp_failed:
+            report.fallbacks[name] = (want, reason or "validation dropped axes")
         if dp_axis and len(p.shape) >= 1 and not any(spec) \
                 and _nelems(p.shape) >= min_fsdp_elems:
             spec = _fsdp_spec(p.shape, mesh, dp_axis)
@@ -179,8 +185,7 @@ def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None,
             report.sharded[name] = spec
             report._elems_sharded += _nelems(p.shape) if len(p.shape) >= 2 else 0
         else:
-            if matched and any(ax is not None for ax in want):
-                report.fallbacks[name] = (want, reason or "validation dropped axes")
+            if tp_failed:
                 report.replicated[name] = reason or "validation"
             elif not matched and len(p.shape) >= 2:
                 report.unmatched.append(name)
@@ -209,10 +214,10 @@ def _nelems(shape) -> int:
 
 
 def _fsdp_spec(shape, mesh: Mesh, dp_axis: str) -> P:
-    if dp_axis not in mesh.axis_names:
-        return P(*([None] * len(shape)))
-    n = mesh.shape[dp_axis]
     axes = [None] * len(shape)
+    if dp_axis not in mesh.axis_names or mesh.shape[dp_axis] <= 1:
+        return P(*axes)  # size-1 axis would be fake sharding
+    n = mesh.shape[dp_axis]
     for i, d in enumerate(shape):
         if d % n == 0:
             axes[i] = dp_axis

@@ -89,3 +89,54 @@ def test_convert_model_casts_params():
     net(NDArray(jnp.ones((2, 6))))
     amp.convert_model(net, "bfloat16")
     assert net.weight.data()._data.dtype == jnp.bfloat16
+
+
+def test_amp_lists_fully_resolve():
+    """Every AMP list entry must resolve to a real exported op — a
+    non-resolving entry silently escapes the rewrite (VERDICT r2 #5)."""
+    from incubator_mxnet_tpu import amp
+
+    cov = amp.list_coverage()
+    assert cov == {"FP16_FUNCS": [], "FP32_FUNCS": [], "FP16_FP32_FUNCS": []}, cov
+
+
+def test_amp_wraps_contrib_ops():
+    """Dotted entries (contrib.interleaved_matmul_*) really get wrapped
+    and restored — previously they silently no-opped."""
+    from incubator_mxnet_tpu import amp
+    from incubator_mxnet_tpu import ndarray as nd
+
+    orig = nd.contrib.interleaved_matmul_selfatt_qk
+    amp.init("bfloat16")
+    try:
+        assert nd.contrib.interleaved_matmul_selfatt_qk is not orig
+        assert getattr(nd.contrib.interleaved_matmul_selfatt_qk,
+                       "__wrapped__", None) is orig
+    finally:
+        amp.reset()
+    assert nd.contrib.interleaved_matmul_selfatt_qk is orig
+
+
+def test_device_peak_flops_warns_on_unknown_accel():
+    import warnings
+
+    from incubator_mxnet_tpu.callback import device_peak_flops
+
+    class FakeDev:
+        device_kind = "QuantumAccel 9000"
+        platform = "quantum"
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        peak = device_peak_flops(FakeDev())
+    assert peak == 1e12
+    assert any("unknown accelerator" in str(x.message) for x in w)
+
+    class FakeCPU:
+        device_kind = "cpu"
+        platform = "cpu"
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        device_peak_flops(FakeCPU())
+    assert not w  # CPU stays silent

@@ -189,3 +189,51 @@ def test_split_and_load_mesh_mode():
     assert isinstance(out, NDArray)
     assert len(out._data.addressable_shards) >= 4
     onp.testing.assert_array_equal(onp.asarray(jax.device_get(out._data)), x)
+
+
+def test_gluon_bert_tp_dp_with_dropout_composes():
+    """Dropout-enabled BERT must still train sharded (the threefry path
+    engages under GSPMD — the Pallas PRNG kernel is gated to
+    single-device processes).  Same seed → same mask on both runs, so
+    full parity holds even with dropout on."""
+    def build():
+        mx.random.seed(0)
+        net = bert.BERTForPretraining(vocab_size=V, units=D, hidden_size=DFF,
+                                      num_layers=L, num_heads=H, dropout=0.1)
+        net.initialize()
+        net(NDArray(jnp.ones((B, T), jnp.int32)))
+        model = PretrainWithLoss(net)
+        model.hybridize()
+        return net, model
+
+    net0, model0 = build()
+    tr0 = Trainer(model0.collect_params(), "sgd", {"learning_rate": 0.1})
+    losses0 = _train(model0, tr0, 2)
+
+    net1, model1 = build()
+    mesh = create_mesh(jax.devices()[:4], data=2, model=2)
+    shard_params(net1, mesh)
+    tr1 = Trainer(model1.collect_params(), "sgd", {"learning_rate": 0.1},
+                  mesh=mesh)
+    losses1 = _train(model1, tr1, 2, mesh=mesh)
+    onp.testing.assert_allclose(losses0, losses1, rtol=3e-4, atol=3e-5)
+    for n, a in _params_host(net0).items():
+        onp.testing.assert_allclose(a, _params_host(net1)[n], rtol=2e-3,
+                                    atol=1e-4, err_msg=n)
+
+
+def test_fsdp_spec_ignores_size_one_axis():
+    """dp_axis over a size-1 mesh axis must NOT count as sharded."""
+    import warnings as _w
+
+    mx.random.seed(2)
+    net = bert.BERTModel(vocab_size=V, units=D, hidden_size=DFF, num_layers=1,
+                         num_heads=H, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((2, 8), jnp.int32)))
+    mesh = create_mesh(jax.devices()[:2], data=1, model=2)
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        rep = shard_params(net, mesh, dp_axis="data", min_fsdp_elems=1)
+    for name, spec in rep.sharded.items():
+        assert "data" not in tuple(spec), (name, spec)

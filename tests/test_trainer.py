@@ -235,3 +235,49 @@ def test_record_backward_grads_match_jax_oracle():
     got2 = {p.name: onp.asarray(g) for p, g in zip(tp, oracle)}
     for (n1, g1), (n2, g2) in zip(sorted(got.items()), sorted(got2.items())):
         onp.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_full_step_throttles_runahead_without_keep_grads():
+    """keep_grads=False still bounds the async dispatch queue: the
+    forward outputs of every in-flight chained step are real buffers
+    (ADVICE r2 medium) — the sync leaf must be tracked regardless."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    mx.random.seed(0)
+    net = nn.Dense(8, in_units=8)
+    net.initialize()
+    net.hybridize()
+    loss_fn = mx.gluon.loss.L2Loss()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01},
+                 keep_grads=False, max_inflight_steps=2)
+    x = NDArray(onp.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = NDArray(onp.zeros((4, 8), "float32"))
+    for _ in range(10):
+        with autograd.record():
+            L = loss_fn(net(x), y).mean()
+        L.backward()
+        tr.step(1)
+    assert len(tr._inflight) <= tr._max_inflight + 1
+
+
+def test_loss_hybridize_opt_out_allows_python_control_flow():
+    """Loss(hybridize=False) keeps the reference's eager semantics for
+    data-dependent control flow (ADVICE r2)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon.loss import Loss
+
+    class BranchyLoss(Loss):
+        def forward(self, pred, label):
+            d = (pred - label).abs().mean()
+            if float(d.asnumpy()) > 1.0:  # data-dependent python branch
+                return d * 2
+            return d
+
+    loss_fn = BranchyLoss(hybridize=False)
+    p = NDArray(onp.full((2, 2), 3.0, "float32"))
+    l = NDArray(onp.zeros((2, 2), "float32"))
+    out = loss_fn(p, l)
+    assert abs(float(out.asnumpy()) - 6.0) < 1e-5

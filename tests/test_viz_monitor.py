@@ -150,3 +150,18 @@ def test_trainer_step_all_params_frozen_is_noop():
         p.grad_req = "null"
     tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
     tr.step(1)  # no grads anywhere: must be a harmless no-op
+
+
+def test_monitor_reference_tic_only_pattern():
+    """Reference usage: tic() every batch, toc() only occasionally —
+    the interval must advance via tic() (ADVICE r2)."""
+    from incubator_mxnet_tpu.monitor import Monitor
+
+    mon = Monitor(interval=3)
+    seen_active = []
+    for _ in range(7):
+        mon.tic()
+        seen_active.append(mon.activated)
+        mon.activated = False  # user never calls toc()
+    # activation hits exactly at steps 0, 3, 6
+    assert seen_active == [True, False, False, True, False, False, True]
