@@ -39,6 +39,7 @@ from . import image
 from . import profiler
 from . import onnx
 from . import operator
+from . import library
 from . import contrib
 from . import amp
 from . import parallel

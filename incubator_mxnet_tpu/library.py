@@ -1,0 +1,164 @@
+"""Native operator plugin loading — `mx.library.load()`.
+
+Re-design of the reference's `python/mxnet/library.py` `MXLoadLib`
+(dynamic custom-operator libraries, `example/extensions/lib_custom_op`,
+SURVEY.md §2.3 "custom op bridges"): a plugin is a shared library whose
+kernels implement the XLA FFI ABI (jaxlib ships the headers —
+``jax.ffi.include_dir()``), plus a tiny enumeration table
+(`mxtpu_plugin_op_*`, see `native/plugin_example.cc`).
+
+`load(path)` dlopens the library, registers every handler as an XLA
+custom_call target on the host platform, and installs one wrapper per
+op into the `mx.nd` namespace.  A kernel named as the ``grad_of``
+another op becomes that op's custom VJP — the loaded op then trains
+inside `autograd.record()` and composes with jit/hybridize exactly like
+a built-in (the reference's CustomOp::Backward parity).
+
+Host (CPU) custom_calls only: on a TPU device the call runs in the
+host callback stream; compute-critical TPU kernels belong in Pallas
+(`ops/`), not plugins — same division of labor as the reference's
+CPU-only custom op libs vs its CUDA ops.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["load", "loaded_ops", "build_example_plugin"]
+
+_LOADED: Dict[str, object] = {}
+
+
+def loaded_ops() -> List[str]:
+    return sorted(_LOADED.keys())
+
+
+def _capsule(ptr: int):
+    """Wrap a raw function pointer in a PyCapsule for jax.ffi."""
+    PyCapsule_New = ctypes.pythonapi.PyCapsule_New
+    PyCapsule_New.restype = ctypes.py_object
+    PyCapsule_New.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+    return PyCapsule_New(ctypes.c_void_p(ptr), None, None)
+
+
+def load(path: str, verbose: bool = True):
+    """Load a native operator plugin (`MXLoadLib` parity).
+
+    Returns the list of op names installed into `mx.nd`.
+    """
+    import jax
+
+    from . import ndarray as nd_mod
+
+    if not os.path.exists(path):
+        raise OSError(f"library.load: no such file {path}")
+    lib = ctypes.CDLL(os.path.abspath(path))
+    for sym in ("mxtpu_plugin_abi_version", "mxtpu_plugin_op_count",
+                "mxtpu_plugin_op_name", "mxtpu_plugin_op_handler"):
+        if not hasattr(lib, sym):
+            raise OSError(f"library.load: {path} is not an mxtpu plugin "
+                          f"(missing {sym})")
+    lib.mxtpu_plugin_abi_version.restype = ctypes.c_int
+    abi = lib.mxtpu_plugin_abi_version()
+    if abi != 1:
+        raise OSError(f"library.load: unsupported plugin ABI {abi}")
+    lib.mxtpu_plugin_op_count.restype = ctypes.c_int
+    lib.mxtpu_plugin_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_plugin_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_plugin_op_handler.restype = ctypes.c_void_p
+    lib.mxtpu_plugin_op_handler.argtypes = [ctypes.c_int]
+    has_grad_of = hasattr(lib, "mxtpu_plugin_op_grad_of")
+    if has_grad_of:
+        lib.mxtpu_plugin_op_grad_of.restype = ctypes.c_char_p
+        lib.mxtpu_plugin_op_grad_of.argtypes = [ctypes.c_int]
+
+    n = lib.mxtpu_plugin_op_count()
+    entries = []
+    for i in range(n):
+        name = lib.mxtpu_plugin_op_name(i).decode()
+        grad_of = None
+        if has_grad_of:
+            g = lib.mxtpu_plugin_op_grad_of(i)
+            grad_of = g.decode() if g else None
+        target = f"mxtpu_plugin_{name}"
+        jax.ffi.register_ffi_target(target, _capsule(lib.mxtpu_plugin_op_handler(i)),
+                                    platform="cpu")
+        entries.append((name, grad_of, target))
+
+    grads = {g: t for (name, g, t) in entries if g}
+    installed = []
+    for name, grad_of, target in entries:
+        if grad_of:
+            continue  # grad kernels are wired into their primal, not exposed
+        fn = _make_op(name, target, grads.get(name))
+        setattr(nd_mod, name, fn)
+        _LOADED[name] = fn
+        installed.append(name)
+        if verbose:
+            print(f"library.load: registered op mx.nd.{name}"
+                  + (" (+custom grad)" if grads.get(name) else ""))
+    # keep the CDLL alive (registered pointers reference its code)
+    _LOADED[f"__lib__{os.path.abspath(path)}"] = lib
+    return installed
+
+
+def _make_op(name: str, target: str, grad_target: Optional[str]):
+    """Build the nd-namespace wrapper: tape-aware, jit-composable."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import apply_op, wrap
+
+    def raw_call(x):
+        call = jax.ffi.ffi_call(
+            target, jax.ShapeDtypeStruct(x.shape, x.dtype))
+        return call(x)
+
+    if grad_target is None:
+        def op(data):
+            return apply_op(raw_call, wrap(data))
+
+        op.__name__ = name
+        return op
+
+    @jax.custom_vjp
+    def core(x):
+        return raw_call(x)
+
+    def fwd(x):
+        return core(x), x
+
+    def bwd(x, dy):
+        call = jax.ffi.ffi_call(
+            grad_target, jax.ShapeDtypeStruct(x.shape, x.dtype))
+        return (call(x, dy),)
+
+    core.defvjp(fwd, bwd)
+
+    def op(data):
+        return apply_op(core, wrap(data))
+
+    op.__name__ = name
+    return op
+
+
+def build_example_plugin(out_dir: Optional[str] = None) -> str:
+    """Compile `native/plugin_example.cc` with the jaxlib FFI headers;
+    returns the .so path (cached)."""
+    import subprocess
+    import sys
+
+    import jax
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "native", "plugin_example.cc")
+    out_dir = out_dir or os.path.join(here, "native", "build")
+    os.makedirs(out_dir, exist_ok=True)
+    so = os.path.join(out_dir, "libmxtpu_plugin_example.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    cmd = ["g++", "-shared", "-fPIC", "-O2", "-std=c++17",
+           f"-I{jax.ffi.include_dir()}", src, "-o", so]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return so

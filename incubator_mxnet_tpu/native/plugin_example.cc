@@ -1,0 +1,89 @@
+// Example native operator plugin — the MXLoadLib parity story.
+//
+// Re-design of the reference's `example/extensions/lib_custom_op`
+// (`MXLoadLib` dynamic operator libraries, SURVEY.md §2.3 "custom op
+// bridges"): a plugin is a plain shared library that implements its
+// kernels against the XLA FFI ABI (the TPU-era replacement for the
+// reference's CustomOp C ABI) and exports a small enumeration table.
+// `incubator_mxnet_tpu.library.load(path)` dlopens it, registers every
+// handler with XLA as a custom_call target, and exposes each op in the
+// `mx.nd` namespace — usable inside jit and the autograd tape.
+//
+// Ops here: `sqrelu` (x>0 ? x*x : 0) and its gradient kernel
+// `sqrelu_grad` — together they demo a custom op with a custom VJP.
+//
+// Build (see library.build_example_plugin):
+//   g++ -shared -fPIC -O2 -std=c++17 -I<jax.ffi.include_dir()> \
+//       plugin_example.cc -o libmxtpu_plugin_example.so
+
+#include <cstddef>
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error SqReluImpl(ffi::Buffer<ffi::F32> x,
+                             ffi::ResultBuffer<ffi::F32> y) {
+  const float* in = x.typed_data();
+  float* out = y->typed_data();
+  const size_t n = x.element_count();
+  for (size_t i = 0; i < n; ++i) {
+    const float v = in[i];
+    out[i] = v > 0.0f ? v * v : 0.0f;
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(mxtpu_sqrelu, SqReluImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// dL/dx = dy * (x > 0 ? 2x : 0)
+static ffi::Error SqReluGradImpl(ffi::Buffer<ffi::F32> x,
+                                 ffi::Buffer<ffi::F32> dy,
+                                 ffi::ResultBuffer<ffi::F32> dx) {
+  const float* in = x.typed_data();
+  const float* ct = dy.typed_data();
+  float* out = dx->typed_data();
+  const size_t n = x.element_count();
+  for (size_t i = 0; i < n; ++i) {
+    out[i] = in[i] > 0.0f ? 2.0f * in[i] * ct[i] : 0.0f;
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(mxtpu_sqrelu_grad, SqReluGradImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+// ------------------------------------------------------------------ //
+// enumeration table consumed by library.load()
+// ------------------------------------------------------------------ //
+extern "C" {
+
+struct MxtpuOpEntry {
+  const char* name;        // op name exposed in mx.nd
+  const char* grad_of;     // non-null: this op is the VJP kernel of `grad_of`
+  void* handler;           // XLA_FFI_Handler*
+};
+
+static const MxtpuOpEntry kOps[] = {
+    {"sqrelu", nullptr, reinterpret_cast<void*>(&mxtpu_sqrelu)},
+    {"sqrelu_grad", "sqrelu", reinterpret_cast<void*>(&mxtpu_sqrelu_grad)},
+};
+
+int mxtpu_plugin_abi_version() { return 1; }
+
+int mxtpu_plugin_op_count() { return 2; }
+
+const char* mxtpu_plugin_op_name(int i) { return kOps[i].name; }
+
+const char* mxtpu_plugin_op_grad_of(int i) { return kOps[i].grad_of; }
+
+void* mxtpu_plugin_op_handler(int i) { return kOps[i].handler; }
+
+}  // extern "C"
