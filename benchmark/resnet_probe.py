@@ -1,9 +1,13 @@
 """ResNet-50 train-step perf probe (VERDICT r3 item: ≥40% of the bf16
-compute ceiling).  Measures the canonical Gluon path and pure-JAX
-variants to localize where the step time goes: framework overhead vs
-XLA conv scheduling vs layout.
+compute ceiling).  Measures the canonical Gluon path and a pure-JAX
+hand-rolled step to localize where the step time goes: framework
+overhead vs XLA conv scheduling.
 
-Run ON THE TPU: python benchmark/resnet_probe.py [variants...]
+Run ON THE TPU: python benchmark/resnet_probe.py [gluon|purejax ...]
+
+NOTE: the tunneled v5e is shared; when another tenant fragments HBM
+(contiguous allocations ≳4 GB fail while total free is ~15 GB), the
+BS128 step OOMs — retry when the chip is quiet (BASELINE.md note).
 """
 from __future__ import annotations
 
@@ -17,125 +21,105 @@ import jax
 import jax.numpy as jnp
 
 
-def fetch(x):
-    return float(jnp.sum(jax.tree_util.tree_leaves(x)[0].ravel()[:1]))
-
-
-def time_steps(step, args, n=20, warm=3):
+def time_steps(step_once, n=20, warm=3):
+    """step_once() must return something value-fetchable (relay rule:
+    fetch a scalar, never block_until_ready)."""
     for _ in range(warm):
-        out = step(*args)
-    fetch(out)
+        out = step_once()
+    float(jnp.asarray(out).ravel()[0])
     t0 = time.perf_counter()
     for _ in range(n):
-        out = step(*args)
-    fetch(out)
+        out = step_once()
+    float(jnp.asarray(out).ravel()[0])
     return (time.perf_counter() - t0) / n
 
 
-def gluon_variant(B, dtype="bfloat16"):
-    """The measured-of-record Gluon loop (train.py config)."""
+def _build_net(B):
     import incubator_mxnet_tpu as mx
-    from incubator_mxnet_tpu import autograd
-    from incubator_mxnet_tpu.gluon import Trainer
     from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from incubator_mxnet_tpu.ndarray.ndarray import NDArray
 
     mx.random.seed(0)
     net = resnet50_v1(classes=1000)
     net.initialize()
-    x0 = NDArray(jnp.ones((B, 3, 224, 224), jnp.float32))
-    net(x0)
-    if dtype == "bfloat16":
-        net.cast("bfloat16")
+    # resolve deferred shapes with a TINY batch: the eager forward
+    # materializes every intermediate activation
+    net(NDArray(jnp.ones((4, 3, 224, 224), jnp.float32)))
+    net.cast("bfloat16")
+    return net
+
+
+def gluon_variant(B):
+    """The measured-of-record Gluon loop (train.py config)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    net = _build_net(B)
     net.hybridize()
     loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
     tr = Trainer(net.collect_params(), "sgd",
                  {"learning_rate": 0.1, "momentum": 0.9,
                   "multi_precision": True}, keep_grads=False)
-    x = NDArray(jnp.ones((B, 3, 224, 224),
-                         jnp.bfloat16 if dtype == "bfloat16" else jnp.float32))
+    x = NDArray(jnp.ones((B, 3, 224, 224), jnp.bfloat16))
     y = NDArray(jnp.zeros((B,), jnp.int32))
 
-    def step(x, y):
+    def step_once():
         with autograd.record():
             L = loss_fn(net(x), y).mean()
         L.backward()
         tr.step(1)
-        return L
+        return L.asnumpy()
 
-    dt = time_steps(lambda *a: step(*a).asnumpy(), (x, y))
-    return B / dt
+    return B / time_steps(step_once)
 
 
-def purejax_variant(B, layout="NCHW", dtype=jnp.bfloat16, bn_dtype="same"):
-    """Hand-rolled ResNet-50 train step — the XLA ceiling probe.
-
-    layout: logical activation layout fed to conv_general_dilated.
-    """
-    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+def purejax_variant(B):
+    """Hand-rolled ResNet-50 train step — the XLA ceiling probe."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu.gluon.block import functionalize
-    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
 
-    mx.random.seed(0)
-    net = resnet50_v1(classes=1000)
-    net.initialize()
-    x0 = NDArray(jnp.ones((B, 3, 224, 224), jnp.float32))
-    net(x0)
-    net.cast("bfloat16")
+    net = _build_net(B)
     apply_fn, train_raws, aux_raws = functionalize(net)
     rng = jax.random.PRNGKey(0)
     y = jnp.zeros((B,), jnp.int32)
-    x = jnp.ones((B, 3, 224, 224), dtype)
+    x = jnp.ones((B, 3, 224, 224), jnp.bfloat16)
 
     masters = tuple(w.astype(jnp.float32) for w in train_raws)
     moms = tuple(jnp.zeros_like(m) for m in masters)
 
-    def loss_of(tr, aux, xx):
-        out, new_aux = apply_fn(tr, aux, rng, xx, training=True)
-        logits = out.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1)), new_aux
-
     @jax.jit
     def step(masters, moms, aux, xx):
         tr = tuple(m.astype(jnp.bfloat16) for m in masters)
-        (L, new_aux), grads = jax.value_and_grad(
-            lambda t: loss_of(t, aux, xx), has_aux=True)(tr)
+
+        def loss_of(t):
+            out, new_aux = apply_fn(t, aux, rng, xx, training=True)
+            logp = jax.nn.log_softmax(out.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1)), new_aux
+
+        (L, new_aux), grads = jax.value_and_grad(loss_of, has_aux=True)(tr)
         new_moms = tuple(0.9 * v + g.astype(jnp.float32)
                          for v, g in zip(moms, grads))
         new_masters = tuple(m - 0.1 * v for m, v in zip(masters, new_moms))
         return new_masters, new_moms, new_aux, L
 
-    def run(masters, moms, aux):
-        return step(masters, moms, aux, x)
-
     state = [masters, moms, aux_raws]
 
-    def stepper():
+    def step_once():
         m, v, a, L = step(state[0], state[1], state[2], x)
         state[0], state[1], state[2] = m, v, a
         return L
 
-    for _ in range(3):
-        L = stepper()
-    fetch(L)
-    t0 = time.perf_counter()
-    for _ in range(20):
-        L = stepper()
-    fetch(L)
-    dt = (time.perf_counter() - t0) / 20
-    return B / dt
+    return B / time_steps(step_once)
 
 
 def main():
     which = sys.argv[1:] or ["gluon", "purejax"]
-    B = 128
+    B = int(os.environ.get("RESNET_PROBE_BS", "128"))
     for w in which:
-        if w == "gluon":
-            print(f"gluon bf16 BS{B}: {gluon_variant(B):.0f} img/s", flush=True)
-        elif w == "purejax":
-            print(f"purejax bf16 BS{B}: {purejax_variant(B):.0f} img/s", flush=True)
+        fn = {"gluon": gluon_variant, "purejax": purejax_variant}[w]
+        print(f"{w} bf16 BS{B}: {fn(B):.0f} img/s", flush=True)
 
 
 if __name__ == "__main__":

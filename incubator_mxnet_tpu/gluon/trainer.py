@@ -192,11 +192,66 @@ class Trainer:
         if kv is not None:
             if kv._compression is not None or kv._updater is not None:
                 return False
-            if kv._is_dist and jax.process_count() > 1:
-                return False  # cross-host reduction needs the kvstore path
+            if kv._is_dist and jax.process_count() > 1 \
+                    and not self._dist_spmd_ready():
+                # legacy dist contract: process-LOCAL params/batches rely
+                # on the kvstore push/pull reduction — fusing would skip
+                # it and silently diverge the replicas
+                return False
+            # dist multi-process with GLOBAL state IS fusable (SURVEY.md
+            # §5.8): params were placed on a multi-process mesh
+            # (shard_params) and the batch enters as a global array
+            # (gluon.utils.shard_batch), so the gradient reduction
+            # compiles into the jitted step (GSPMD psum over the data
+            # axis, DCN between slices) — no per-key host path, comm/
+            # compute overlap for free.
         if type(self._optimizer).pure_update is opt_mod.Optimizer.pure_update:
             return False  # custom optimizer without a pure rule
         return True
+
+    def _dist_spmd_ready(self) -> bool:
+        """True iff the training state is multi-process global: EVERY
+        managed param's array spans beyond this process's devices (the
+        signature `shard_params(block, global_mesh)` leaves).  A MIXED
+        state (some params global, some process-local) is not fusable —
+        the local params' grads would silently skip the cross-process
+        reduction — and warns once."""
+        n_global = n_local = 0
+        for p in self._params:
+            if p.grad_req == "null" or p._data_nd is None \
+                    or p._data_nd._lazy is not None:
+                continue
+            r = p._data_nd._raw
+            if hasattr(r, "is_fully_addressable") and not r.is_fully_addressable:
+                n_global += 1
+            else:
+                n_local += 1
+        if n_global and n_local and not getattr(self, "_warned_mixed", False):
+            import warnings
+
+            self._warned_mixed = True
+            warnings.warn(
+                f"Trainer: {n_global} params are multi-process global but "
+                f"{n_local} are process-local — falling back to the per-key "
+                f"kvstore reduction. Apply shard_params to the WHOLE block "
+                f"for the fused SPMD dist step.", stacklevel=3)
+        return n_global > 0 and n_local == 0
+
+    def _can_fuse_packed_compression(self) -> bool:
+        """Dist + gradient compression: grads exchange as ONE bit-packed
+        buffer (all params concatenated), then the stacked fused update
+        runs — per-key DCN latency eliminated while keeping the 2-bit
+        wire format and error feedback (VERDICT r2 #4)."""
+        if not self._fuse_step or self._update_on_kvstore:
+            return False
+        kv = self._kvstore
+        if kv is None or kv._compression is None or kv._updater is not None:
+            return False
+        if not (kv._is_dist and jax.process_count() > 1):
+            return False  # single-process: per-key path is cheap, keep
+            # the kvstore-store-visible semantics
+        return type(self._optimizer).pure_update \
+            is not opt_mod.Optimizer.pure_update
 
     # -- shared machinery of the two fused paths ------------------------ #
     def _mults_key(self, idxs):
@@ -321,6 +376,10 @@ class Trainer:
             pending = self._detect_pending()
             if pending is not None and self._try_full_step(pending):
                 return
+            self._fused_step()
+            return
+        if self._can_fuse_packed_compression():
+            self._allreduce_grads_packed()
             self._fused_step()
             return
         self._allreduce_grads()
@@ -468,6 +527,37 @@ class Trainer:
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(full, donate_argnums=donate)
+
+    def _allreduce_grads_packed(self):
+        """ONE compressed exchange for the whole model: concat all grads
+        flat → 2-bit pack (error feedback on the flat buffer) → single
+        process_allgather → decompress+sum → scatter back into the grad
+        buffers.  Elementwise quantization makes this bit-identical to
+        the per-key path, minus ~#params DCN round-trips."""
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        comp = self._kvstore._compression
+        ps = [p for p in self._params
+              if p.grad_req != "null" and p._data_nd is not None]
+        grads = [raw(p.grad()) for p in ps]
+        flat = jnp.concatenate([g.reshape(-1).astype(jnp.float32)
+                                for g in grads])
+        # residual key includes the layout: if the managed set changes
+        # (freeze/unfreeze), a fresh residual starts instead of applying
+        # old error feedback at the wrong offsets
+        rkey = ("__trainer_packed__",
+                tuple(self._param2idx[p.name] for p in ps), int(flat.size))
+        packed = comp.compress_packed(rkey, flat)
+        gathered = multihost_utils.process_allgather(packed)
+        summed = sum(comp.decompress(gathered[r], flat.shape)
+                     for r in range(gathered.shape[0]))
+        off = 0
+        for p, g in zip(ps, grads):
+            n = g.size
+            p._data_nd._grad._data = summed[off:off + n] \
+                .reshape(g.shape).astype(g.dtype)
+            off += n
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -23,26 +23,50 @@ __all__ = ["split_data", "split_and_load", "shard_batch", "clip_global_norm",
 
 
 def shard_batch(data, mesh, axis_name: str = "data", batch_axis: int = 0):
-    """Place one global batch on a mesh's data axis (the SPMD idiom).
+    """Place one batch on a mesh's data axis (the SPMD idiom).
 
     The TPU-first `split_and_load`: instead of a list of per-device
     slices, ONE globally-sharded `jax.Array` whose batch dim lives on
     `axis_name`.  Feed the result straight into a hybridized block —
     GSPMD propagates the sharding through forward/backward and the
-    Trainer's fused update."""
+    Trainer's fused update.
+
+    Multi-process meshes (SURVEY.md §5.8 "data axis across slices"):
+    ``data`` is this process's LOCAL shard of the global batch — the
+    global array is assembled across processes
+    (`jax.make_array_from_process_local_data`), so each worker feeds
+    its own data and the returned array's batch dim is the GLOBAL
+    batch (process-local batch × #processes on the axis)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     data = wrap(data)
     if axis_name not in mesh.axis_names:
         raise ValueError(f"shard_batch: mesh has no '{axis_name}' axis "
                          f"(axes: {mesh.axis_names})")
+    spec = [None] * len(data.shape)
+    spec[batch_axis] = axis_name
+    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    n_proc = len({d.process_index for d in mesh.devices.flat})
+    if n_proc > 1:
+        raw_arr = data._data
+        if hasattr(raw_arr, "is_fully_addressable") \
+                and not raw_arr.is_fully_addressable:
+            # already a global array (idempotent re-shard)
+            if getattr(raw_arr, "sharding", None) == sh:
+                return NDArray(raw_arr)
+            return NDArray(jax.device_put(raw_arr, sh))
+        shard_div = max(1, mesh.shape[axis_name] // n_proc)
+        if data.shape[batch_axis] % shard_div != 0:
+            raise ValueError(
+                f"local batch dim {data.shape[batch_axis]} not divisible by "
+                f"this process's share of mesh axis {axis_name} "
+                f"({shard_div} of {mesh.shape[axis_name]})")
+        local = onp.asarray(jax.device_get(raw_arr))
+        return NDArray(jax.make_array_from_process_local_data(sh, local))
     if data.shape[batch_axis] % mesh.shape[axis_name] != 0:
         raise ValueError(
             f"batch dim {data.shape[batch_axis]} not divisible by mesh axis "
             f"{axis_name}={mesh.shape[axis_name]}")
-    spec = [None] * len(data.shape)
-    spec[batch_axis] = axis_name
-    sh = NamedSharding(mesh, PartitionSpec(*spec))
     return NDArray(jax.device_put(data._data, sh))
 
 

@@ -160,5 +160,8 @@ def build_example_plugin(out_dir: Optional[str] = None) -> str:
         return so
     cmd = ["g++", "-shared", "-fPIC", "-O2", "-std=c++17",
            f"-I{jax.ffi.include_dir()}", src, "-o", so]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"plugin build failed ({' '.join(cmd)}):\n{proc.stderr[-4000:]}")
     return so

@@ -91,3 +91,34 @@ def test_distributed_training_example():
             break
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.count("replicas consistent OK") == 3, proc.stdout[-2000:]
+
+
+def test_dist_fused_dp_multiprocess():
+    """Fused SPMD data-parallel across 3 REAL processes (VERDICT r2 #4):
+    grads reduce INSIDE the jitted step on a global mesh; numerics match
+    the single-process full-batch oracle and the per-key path; the
+    packed compression exchange matches per-key compression exactly."""
+    n = 3
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+             "-n", str(n), "--launcher", "local",
+             sys.executable, os.path.join(_ROOT, "tests", "dist_fused_worker.py"),
+             str(n)],
+            cwd=_ROOT, env=env, capture_output=True, text=True, timeout=600)
+        # substring count: concurrent workers can interleave OK lines
+        n_ok = proc.stdout.count("DIST FUSED DP OK")
+        if proc.returncode == 0 and n_ok == n:
+            return
+        if not (attempt == 0
+                and _RENDEZVOUS_RE.search(proc.stdout + proc.stderr)):
+            break
+    assert proc.returncode == 0, \
+        f"launcher rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}" \
+        f"\nstderr:\n{proc.stderr[-3000:]}"
+    assert n_ok == n, \
+        f"expected {n} OK markers, got {n_ok}:\n{proc.stdout[-3000:]}"
