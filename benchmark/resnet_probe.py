@@ -67,10 +67,14 @@ def gluon_variant(B):
 
     def step_once():
         with autograd.record():
-            L = loss_fn(net(x), y).mean()
+            # canonical loop: backward on the per-sample loss (NO .mean()
+            # — an eager op on the lazy outputs breaks the one-program
+            # chain and forces the residual-materializing staged path,
+            # which at BS128 OOMs the chip)
+            L = loss_fn(net(x), y)
         L.backward()
-        tr.step(1)
-        return L.asnumpy()
+        tr.step(B)
+        return L.asnumpy().ravel()[:1]
 
     return B / time_steps(step_once)
 

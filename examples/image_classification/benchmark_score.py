@@ -27,7 +27,9 @@ def build_parser():
     p.add_argument("--batch-sizes", type=str, default="1,8,32")
     p.add_argument("--num-batches", type=int, default=20)
     p.add_argument("--dtype", type=str, default="float32",
-                   choices=["float32", "bfloat16"])
+                   choices=["float32", "bfloat16", "int8"])
+    p.add_argument("--calib-mode", type=str, default="minmax",
+                   choices=["minmax", "entropy"])
     return p
 
 
@@ -43,13 +45,27 @@ def score(args):
     net(NDArray(mx.nd.zeros((1,) + shape)._data))
     if args.dtype == "bfloat16":
         net.cast("bfloat16")
-    net.hybridize()
+    if args.dtype == "int8":
+        # PTQ: conv+dense swapped for int8 MXU kernels (ref
+        # quantized ResNet flow, src/operator/quantization/); the rest
+        # of the net (BN/pool/relu) runs bf16 so the epilogues don't
+        # give back the int8 win
+        from incubator_mxnet_tpu.contrib.quantization import quantize_net
+
+        import jax
+
+        net.cast("bfloat16")
+        calib = [NDArray(jax.random.normal(jax.random.PRNGKey(i),
+                                           (8,) + shape).astype("bfloat16"))
+                 for i in range(2)]
+        quantize_net(net, calib, calib_mode=args.calib_mode)
+    net.hybridize()  # one compiled program either way (int8 kernels trace)
 
     results = []
     for bs in (int(b) for b in args.batch_sizes.split(",")):
         x = mx.nd.zeros((bs,) + shape)
-        if args.dtype == "bfloat16":
-            x = x.astype("bfloat16")
+        if args.dtype in ("bfloat16", "int8"):
+            x = x.astype("bfloat16")  # int8 nets run bf16 between convs
         out = net(x)  # compile
         float(out.asnumpy().ravel()[0])
         tic = time.time()

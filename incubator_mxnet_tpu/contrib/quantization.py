@@ -22,13 +22,14 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-__all__ = ["quantize_weight", "calibrate", "QuantizedDense", "quantize_net"]
+__all__ = ["quantize_weight", "calibrate", "QuantizedDense", "QuantizedConv",
+           "quantize_net"]
 
 
 def quantize_weight(w, axis: int = 0):
     """Symmetric per-output-channel int8 quantization: returns (int8
     weights, float scale per channel)."""
-    w = jnp.asarray(w)
+    w = jnp.asarray(w).astype(jnp.float32)  # bf16 nets: quantize in fp32
     amax = jnp.max(jnp.abs(w), axis=tuple(i for i in range(w.ndim) if i != axis),
                    keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
@@ -79,13 +80,80 @@ def calibrate(activations: List, mode: str = "minmax") -> float:
 @jax.jit
 def int8_dense(x, w_q, w_scale, act_scale, bias=None):
     """INT8×INT8→INT32 matmul with float rescale epilogue."""
-    xq = jnp.clip(jnp.round(x / act_scale), -127, 127).astype(jnp.int8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / act_scale),
+                  -127, 127).astype(jnp.int8)
     acc = jax.lax.dot_general(xq, w_q, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.int32)
     out = acc.astype(jnp.float32) * (act_scale * w_scale.reshape(1, -1))
     if bias is not None:
-        out = out + bias
-    return out
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("stride", "pad", "dilate",
+                                              "groups"))
+def int8_conv(x, w_q, w_scale, act_scale, bias, stride, pad, dilate, groups):
+    """INT8×INT8→INT32 convolution with per-output-channel float rescale
+    (ref `src/operator/quantization/quantized_conv.cc`; here the MXU int8
+    path via `lax.conv_general_dilated(preferred_element_type=int32)`).
+    x: NCHW float; w_q: (O, I/g, kh, kw) int8."""
+    nd = x.ndim - 2
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / act_scale),
+                  -127, 127).astype(jnp.int8)
+    spatial = "DHW"[-nd:]
+    acc = jax.lax.conv_general_dilated(
+        xq, w_q,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=("NC" + spatial, "OI" + spatial, "NC" + spatial),
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    scale = (act_scale * w_scale.reshape(-1)).reshape((1, -1) + (1,) * nd)
+    out = acc.astype(jnp.float32) * scale
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape((1, -1) + (1,) * nd)
+    # keep the net's compute dtype downstream (bf16 nets stay bf16 —
+    # fp32 epilogues were costing more than the int8 conv saved)
+    return out.astype(x.dtype)
+
+
+class QuantizedConv:
+    """Inference Conv over int8 weights (replaces nn.Conv1D/2D/3D
+    post-PTQ).  BatchNorm stays float downstream — the int32→float
+    rescale epilogue feeds it directly (the reference's quantized
+    ResNet does the same for non-fused BN)."""
+
+    def __init__(self, conv, act_threshold: float):
+        from ..ndarray.ndarray import raw
+
+        w = raw(conv.weight.data())
+        self.w_q, w_scale = quantize_weight(w, axis=0)
+        self.w_scale = w_scale.reshape(-1)
+        self.bias = raw(conv.bias.data()) if getattr(conv, "bias", None) is not None \
+            and conv.bias._data_nd is not None else None
+        self.act_scale = max(act_threshold, 1e-8) / 127.0
+        self.stride = tuple(conv._strides)
+        self.pad = tuple(conv._padding)
+        self.dilate = tuple(conv._dilation)
+        self.groups = int(conv._groups)
+        self.activation = getattr(conv, "_activation", None)
+        self._src = conv
+
+    def __call__(self, x):
+        from ..ndarray import nn_ops
+        from ..ndarray.ndarray import NDArray, raw, wrap
+
+        xr = raw(wrap(x))
+        out = int8_conv(xr, self.w_q, self.w_scale, self.act_scale, self.bias,
+                        self.stride, self.pad, self.dilate, self.groups)
+        nd_out = NDArray(out)
+        if self.activation:
+            nd_out = nn_ops.Activation(nd_out, act_type=self.activation)
+        return nd_out
 
 
 class QuantizedDense:
@@ -108,10 +176,13 @@ class QuantizedDense:
         from ..ndarray.ndarray import NDArray, raw, wrap
 
         xr = raw(wrap(x))
-        lead = xr.shape[:-1] if xr.ndim > 2 else None
-        if lead is not None:
+        lead = None
+        if getattr(self._src, "_flatten", False) and xr.ndim > 2:
+            xr = xr.reshape(xr.shape[0], -1)  # Dense(flatten=True)
+        elif xr.ndim > 2:
+            lead = xr.shape[:-1]
             xr = xr.reshape(-1, xr.shape[-1])
-        out = int8_dense(xr.astype(jnp.float32), self.w_q, self.w_scale,
+        out = int8_dense(xr, self.w_q, self.w_scale,
                          self.act_scale, self.bias)
         if lead is not None:
             out = out.reshape(*lead, -1)
@@ -122,12 +193,15 @@ class QuantizedDense:
 
 
 def quantize_net(net, calib_data, calib_mode: str = "minmax",
-                 layer_types=("Dense",)):
-    """Post-training-quantize a Gluon net's Dense layers in place.
+                 layer_types=("Dense", "Conv1D", "Conv2D", "Conv3D")):
+    """Post-training-quantize a Gluon net's Dense AND Conv layers in
+    place (ref quantizes conv/FC — `quantized_conv.cc`,
+    `quantized_fully_connected.cc`; pooling runs exact on TPU so it
+    needs no int8 variant).
 
     calib_data: iterable of input batches (NDArray).  Runs calibration
     forwards recording each target layer's input range, then swaps the
-    layer for a QuantizedDense.  Returns the net.
+    layer for a QuantizedDense/QuantizedConv.  Returns the net.
     """
     from ..gluon import nn
     from ..ndarray.ndarray import NDArray
@@ -142,27 +216,77 @@ def quantize_net(net, calib_data, calib_mode: str = "minmax",
                 walk(child)
 
     walk(net)
-    # record per-layer input activations over the calibration set
-    records: Dict[int, List] = {id(c): [] for _, _, c in targets}
+    # per-layer O(1)-memory calibration state: running |x| max plus a
+    # bounded subsample for the entropy histogram (the reference keeps
+    # histograms, not raw activations — full fp32 feature maps over a
+    # real calibration set would be GBs of host RAM)
+    records: Dict[int, dict] = {id(c): {"amax": 0.0, "samples": []}
+                                for _, _, c in targets}
+    _SAMPLE_CAP = 1 << 16
 
     hooks = []
     for _, _, child in targets:
         def mk_hook(key):
             def hook(blk, inputs):
-                records[key].append(inputs[0].asnumpy())
+                a = onp.abs(onp.asarray(inputs[0].asnumpy(), dtype="float32"))
+                rec = records[key]
+                rec["amax"] = max(rec["amax"], float(a.max()))
+                flat = a.ravel()
+                if calib_mode == "entropy":
+                    if flat.size > _SAMPLE_CAP:
+                        idx = onp.random.RandomState(len(rec["samples"])) \
+                            .choice(flat.size, _SAMPLE_CAP, replace=False)
+                        flat = flat[idx]
+                    rec["samples"].append(flat)
             return hook
 
         hooks.append((child, child.register_forward_pre_hook(mk_hook(id(child)))))
-    for batch in calib_data:
-        net(batch if isinstance(batch, NDArray) else NDArray(jnp.asarray(batch)))
-    for child, h in hooks:  # remove OUR hooks only; user hooks survive
-        child._forward_pre_hooks.remove(h)
+    # calibration needs the per-layer Python hooks to fire: a compiled
+    # (hybridized) net never re-enters child __call__, so force the
+    # eager path for the calibration forwards only
+    saved_active = []
+
+    def deactivate(block):
+        if hasattr(block, "_active"):
+            saved_active.append((block, block._active))
+            block._active = False
+        for c in block._children.values():
+            deactivate(c)
+
+    deactivate(net)
+    try:
+        for batch in calib_data:
+            net(batch if isinstance(batch, NDArray) else NDArray(jnp.asarray(batch)))
+    finally:
+        for block, act in saved_active:
+            block._active = act
+        for child, h in hooks:  # remove OUR hooks only; user hooks survive
+            child._forward_pre_hooks.remove(h)
     for parent, name, child in targets:
-        thr = calibrate(records[id(child)], calib_mode)
+        rec = records[id(child)]
+        if rec["amax"] == 0.0 and not rec["samples"]:
+            raise ValueError(
+                f"quantize_net: layer {child.name!r} saw no calibration "
+                f"activations — the calib_data batches never exercised it")
+        thr = _threshold_from_stats(rec, calib_mode)
         wrapper = _QuantizedWrapper(child, thr)
         parent._children[name] = wrapper
         object.__setattr__(parent, name, wrapper)
+        # swapped layers also hide inside plain-list attributes (model
+        # zoo blocks keep e.g. self.body as HybridSequential) — the
+        # _children rebind above covers Sequential dispatch
     return net
+
+
+def _threshold_from_stats(rec: dict, mode: str) -> float:
+    if mode == "minmax":
+        return rec["amax"]
+    if mode == "entropy":
+        flat = onp.concatenate(rec["samples"]) if rec["samples"] \
+            else onp.asarray([rec["amax"]])
+        hist, edges = onp.histogram(flat, bins=2048, range=(0.0, rec["amax"]))
+        return float(_entropy_threshold(hist, edges))
+    raise ValueError(f"unknown calib_mode {mode!r} (minmax|entropy)")
 
 
 from ..gluon.block import HybridBlock as _HybridBlock
@@ -173,10 +297,12 @@ class _QuantizedWrapper(_HybridBlock):
     (save_parameters walks Block children) keep the original fp32
     params — quantization is a runtime transform, not a format."""
 
-    def __init__(self, dense, threshold):
-        super().__init__(prefix=dense.name + "_int8_")
-        self.src = dense  # registered child: fp32 params persist
-        self._qd = QuantizedDense(dense, threshold)
+    def __init__(self, layer, threshold):
+        super().__init__(prefix=layer.name + "_int8_")
+        self.src = layer  # registered child: fp32 params persist
+        qcls = QuantizedConv if type(layer).__name__.startswith("Conv") \
+            else QuantizedDense
+        self._qd = qcls(layer, threshold)
 
     def forward(self, x):
         return self._qd(x)

@@ -55,14 +55,36 @@ def shard_batch(data, mesh, axis_name: str = "data", batch_axis: int = 0):
             if getattr(raw_arr, "sharding", None) == sh:
                 return NDArray(raw_arr)
             return NDArray(jax.device_put(raw_arr, sh))
-        shard_div = max(1, mesh.shape[axis_name] // n_proc)
-        if data.shape[batch_axis] % shard_div != 0:
+        # segments of axis_name owned by distinct process groups: the
+        # global batch is local_B × n_segments (axis across processes);
+        # n_segments == 1 means the axis is within-process and every
+        # process must feed identical data (replicated assembly)
+        ax = mesh.axis_names.index(axis_name)
+        grid = onp.moveaxis(mesh.devices, ax, 0)
+        groups = [frozenset(d.process_index
+                            for d in onp.atleast_1d(grid[i]).flat)
+                  for i in range(grid.shape[0])]
+        uniq = list(dict.fromkeys(groups))
+        all_equal = len(uniq) == 1
+        disjoint = all(a.isdisjoint(b) for i, a in enumerate(uniq)
+                       for b in uniq[i + 1:])
+        if not (all_equal or disjoint):
+            raise ValueError(
+                f"shard_batch: mesh axis '{axis_name}' is neither fully "
+                f"within-process nor cleanly split across processes — "
+                f"assemble the global array yourself")
+        n_seg = len(uniq)
+        per_proc_span = mesh.shape[axis_name] // n_seg
+        if data.shape[batch_axis] % per_proc_span != 0:
             raise ValueError(
                 f"local batch dim {data.shape[batch_axis]} not divisible by "
-                f"this process's share of mesh axis {axis_name} "
-                f"({shard_div} of {mesh.shape[axis_name]})")
+                f"this process's span of mesh axis {axis_name} "
+                f"({per_proc_span} of {mesh.shape[axis_name]})")
+        global_shape = list(data.shape)
+        global_shape[batch_axis] *= n_seg
         local = onp.asarray(jax.device_get(raw_arr))
-        return NDArray(jax.make_array_from_process_local_data(sh, local))
+        return NDArray(jax.make_array_from_process_local_data(
+            sh, local, tuple(global_shape)))
     if data.shape[batch_axis] % mesh.shape[axis_name] != 0:
         raise ValueError(
             f"batch dim {data.shape[batch_axis]} not divisible by mesh axis "

@@ -29,6 +29,10 @@ def test_dist_sync_kvstore_multiprocess(n):
     # the launcher scrubs accelerator vars itself; scrub here too so the
     # parent's pytest-CPU config doesn't leak conflicting XLA flags
     env.pop("XLA_FLAGS", None)
+    # the persistent compile cache may hold executables built on a
+    # host with different CPU features (SIGILL guard) — workers
+    # compile fresh
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     for attempt in range(2):
@@ -71,6 +75,10 @@ def test_distributed_training_example():
     replicas must converge identically (ref cifar10_dist.py pattern)."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    # the persistent compile cache may hold executables built on a
+    # host with different CPU features (SIGILL guard) — workers
+    # compile fresh
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     for attempt in range(2):
@@ -101,6 +109,10 @@ def test_dist_fused_dp_multiprocess():
     n = 3
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    # the persistent compile cache may hold executables built on a
+    # host with different CPU features (SIGILL guard) — workers
+    # compile fresh
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     for attempt in range(2):
