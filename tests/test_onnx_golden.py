@@ -1,0 +1,126 @@
+"""ONNX wire-format golden-byte fixtures (VERDICT r2 #6).
+
+The serde is a hand-rolled protobuf encoder; one byte off the onnx.proto3
+schema and every exported file is unreadable by real ONNX consumers —
+and a self-referential round-trip would never notice.  These fixtures
+are assembled BY HAND in this file, field number by field number from
+the public onnx.proto3 (field tags written as explicit byte literals,
+independently of serde's helpers), and pinned in both directions:
+
+  encode: serde.encode_model(model) must produce EXACTLY these bytes
+          (the encoder is deterministic, so byte equality is a valid
+          regression guard)
+  decode: serde.decode_model(golden) must recover the model
+
+onnx.proto3 field numbers used (same table as serde.py's docstring):
+  ModelProto:    ir_version=1, producer_name=2, graph=7, opset_import=8
+  OperatorSetId: domain=1, version=2
+  GraphProto:    node=1, name=2, initializer=5, input=11, output=12
+  NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5
+  AttributeProto:name=1, f=2, i=3, s=4, floats=6, ints=7, type=20
+  TensorProto:   dims=1, data_type=2, name=8, raw_data=9
+  ValueInfoProto:name=1, type=2; TypeProto.tensor_type=1;
+  Tensor.elem_type=1, shape=2; TensorShapeProto.dim=1; Dim.dim_value=1
+"""
+import struct
+
+import numpy as onp
+
+from incubator_mxnet_tpu.onnx import serde
+
+
+def LD(tag_byte: int, payload: bytes) -> bytes:
+    """length-delimited field, explicit pre-computed tag byte."""
+    assert len(payload) < 128  # all fixture payloads fit 1-byte varints
+    return bytes([tag_byte, len(payload)]) + payload
+
+
+def value_info(tag_byte: int, name: bytes, dims) -> bytes:
+    # TensorShapeProto: repeated dim, each Dim{dim_value=1 varint}
+    shape = b"".join(LD(0x0A, bytes([0x08, d])) for d in dims)
+    tensor_type = bytes([0x08, 0x01]) + LD(0x12, shape)  # elem_type=FLOAT
+    type_proto = LD(0x0A, tensor_type)                   # TypeProto.tensor_type
+    return LD(tag_byte, LD(0x0A, name) + LD(0x12, type_proto))
+
+
+def golden_relu_model() -> bytes:
+    """ModelProto{ ir=8, producer, graph{ Relu node, io (2,3) f32 }, opset 17 }"""
+    node = (LD(0x0A, b"x")          # NodeProto.input = "x"
+            + LD(0x12, b"y")        # .output = "y"
+            + LD(0x1A, b"y_node")   # .name
+            + LD(0x22, b"Relu"))    # .op_type
+    graph = (LD(0x0A, node)                    # GraphProto.node
+             + LD(0x12, b"g")                  # .name
+             + value_info(0x5A, b"x", (2, 3))  # .input  (field 11)
+             + value_info(0x62, b"y", (2, 3))) # .output (field 12)
+    opset = LD(0x0A, b"") + bytes([0x10, 0x11])  # domain "", version 17
+    return (bytes([0x08, 0x08])                  # ir_version = 8
+            + LD(0x12, b"incubator_mxnet_tpu")   # producer_name
+            + LD(0x3A, graph)                    # graph (field 7)
+            + LD(0x42, opset))                   # opset_import (field 8)
+
+
+def build_relu_model() -> serde.Model:
+    g = serde.Graph("g")
+    g.nodes.append(serde.Node("Relu", ["x"], ["y"], "y_node"))
+    g.inputs.append(("x", (2, 3), serde.FLOAT))
+    g.outputs.append(("y", (2, 3), serde.FLOAT))
+    return serde.Model(g)
+
+
+def test_encoder_matches_golden_bytes():
+    assert serde.encode_model(build_relu_model()) == golden_relu_model()
+
+
+def test_decoder_reads_golden_bytes():
+    m = serde.decode_model(golden_relu_model())
+    assert m.producer == "incubator_mxnet_tpu"
+    assert m.opset == 17
+    g = m.graph
+    assert g.name == "g"
+    assert len(g.nodes) == 1
+    n = g.nodes[0]
+    assert (n.op_type, n.inputs, n.outputs, n.name) == \
+        ("Relu", ["x"], ["y"], "y_node")
+    assert g.inputs == [("x", (2, 3), serde.FLOAT)]
+    assert g.outputs == [("y", (2, 3), serde.FLOAT)]
+
+
+def test_initializer_raw_data_layout():
+    """TensorProto: dims(1) data_type(2) name(8) raw_data(9), raw_data
+    little-endian fp32 — the layout every ONNX runtime accepts."""
+    arr = onp.asarray([[1.5, -2.0]], onp.float32)
+    got = serde._encode_tensor("w", arr)
+    want = (bytes([0x08, 0x01, 0x08, 0x02])      # dims 1, 2
+            + bytes([0x10, 0x01])                # data_type = FLOAT
+            + LD(0x42, b"w")                     # name (field 8)
+            + LD(0x4A, struct.pack("<2f", 1.5, -2.0)))  # raw_data (field 9)
+    assert got == want
+    name, back = serde._decode_tensor(want)
+    assert name == "w"
+    onp.testing.assert_array_equal(back, arr)
+
+
+def test_negative_int_attribute_ten_byte_varint():
+    """Protobuf int64: negative values encode as 10-byte two's-complement
+    varints (axis=-1 must survive; naive encoders truncate)."""
+    enc = serde._encode_attr("axis", -1)
+    # name field
+    assert enc.startswith(LD(0x0A, b"axis"))
+    rest = enc[len(LD(0x0A, b"axis")):]
+    # i field (3, varint): tag 0x18 then 10 bytes 0xFF..0x01
+    assert rest[:1] == b"\x18"
+    assert rest[1:11] == b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"
+    # and the reader sign-extends it back
+    name, val = serde._decode_attr(enc)
+    assert (name, val) == ("axis", -1)
+
+
+def test_varint_multibyte_lengths():
+    """Payloads >127 bytes must use multi-byte varint lengths."""
+    arr = onp.zeros(64, onp.float32)  # raw_data = 256 bytes
+    enc = serde._encode_tensor("big", arr)
+    name, back = serde._decode_tensor(enc)
+    assert name == "big" and back.shape == (64,)
+    # the raw_data length 256 encodes as varint 0x80 0x02
+    assert b"\x4a\x80\x02" in enc

@@ -1,0 +1,570 @@
+"""Operator matrix sweep (VERDICT r2 #7): every exported elementwise/
+binary/reduction/shape op × ≥2 shapes × ≥2 dtypes, with NumPy oracles
+and finite-difference gradient checks for the differentiable families —
+the density of the reference's `tests/python/unittest/test_operator.py`
+matrices, organized declaratively.
+
+Tolerance tiers: fp32 sweeps assert the default fp32 tolerances; bf16
+sweeps use the bf16 tier (~1e-2) via `assert_almost_equal`'s
+dtype-aware defaults.  Degenerate cases (zero-size arrays, size-1 dims,
+negative axes) are part of the shape matrix.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_numeric_gradient)
+
+RS = onp.random.RandomState(7)
+
+SHAPES = [(3, 4), (2, 3, 4), (1, 5), (6,)]
+DEGENERATE = [(0, 3), (2, 0), (1, 1, 1)]
+DTYPES = ["float32", "bfloat16"]
+
+
+def _data(shape, dtype, domain):
+    x = RS.uniform(-2.0, 2.0, size=shape).astype("float32")
+    if domain == "positive":
+        x = onp.abs(x) + 0.5
+    elif domain == "unit":
+        x = onp.clip(x * 0.4, -0.9, 0.9)
+    elif domain == "ge1":
+        x = onp.abs(x) + 1.5
+    elif domain == "nonzero":
+        x = onp.where(onp.abs(x) < 0.3, 0.5, x)
+    return x.astype(dtype)
+
+
+# (op name, numpy oracle, domain, differentiable)
+UNARY = [
+    ("abs", onp.abs, "nonzero", True),
+    ("negative", lambda x: -x, "any", True),
+    ("exp", onp.exp, "any", True),
+    ("expm1", onp.expm1, "any", True),
+    ("log", onp.log, "positive", True),
+    ("log1p", onp.log1p, "positive", True),
+    ("log2", onp.log2, "positive", True),
+    ("log10", onp.log10, "positive", True),
+    ("sqrt", onp.sqrt, "positive", True),
+    ("rsqrt", lambda x: 1.0 / onp.sqrt(x), "positive", True),
+    ("cbrt", onp.cbrt, "positive", True),
+    ("rcbrt", lambda x: 1.0 / onp.cbrt(x), "positive", True),
+    ("reciprocal", lambda x: 1.0 / x, "nonzero", True),
+    ("square", onp.square, "any", True),
+    ("sign", onp.sign, "nonzero", False),
+    ("floor", onp.floor, "nonzero", False),
+    ("ceil", onp.ceil, "nonzero", False),
+    ("trunc", onp.trunc, "nonzero", False),
+    ("rint", onp.rint, "nonzero", False),
+    ("round", onp.round, "nonzero", False),
+    ("sin", onp.sin, "any", True),
+    ("cos", onp.cos, "any", True),
+    ("tan", onp.tan, "unit", True),
+    ("sinh", onp.sinh, "any", True),
+    ("cosh", onp.cosh, "any", True),
+    ("tanh", onp.tanh, "any", True),
+    ("arcsin", onp.arcsin, "unit", True),
+    ("arccos", onp.arccos, "unit", True),
+    ("arctan", onp.arctan, "any", True),
+    ("arcsinh", onp.arcsinh, "any", True),
+    ("arccosh", onp.arccosh, "ge1", True),
+    ("arctanh", onp.arctanh, "unit", True),
+    ("sigmoid", lambda x: 1 / (1 + onp.exp(-x)), "any", True),
+    ("softsign", lambda x: x / (1 + onp.abs(x)), "any", True),
+    ("relu", lambda x: onp.maximum(x, 0), "nonzero", True),
+    ("erf", None, "any", True),   # oracle via scipy-free identity below
+    ("erfinv", None, "unit", True),
+    ("gamma", None, "positive", False),
+    ("gammaln", None, "positive", False),
+    ("degrees", onp.degrees, "any", True),
+    ("radians", onp.radians, "any", True),
+]
+
+try:  # math.erf vectorized — no scipy in the image
+    import math
+
+    _erf = onp.vectorize(math.erf)
+    _gamma = onp.vectorize(math.gamma)
+    _gammaln = onp.vectorize(math.lgamma)
+except Exception:  # pragma: no cover
+    _erf = _gamma = _gammaln = None
+
+
+def _oracle(name, fallback):
+    if fallback is not None:
+        return fallback
+    if name == "erf":
+        return _erf
+    if name == "gamma":
+        return _gamma
+    if name == "gammaln":
+        return _gammaln
+    if name == "erfinv":
+        from numpy import vectorize
+
+        # inverse via bisection against math.erf — exact enough at 1e-6
+        def inv(y):
+            lo, hi = -4.0, 4.0
+            for _ in range(50):
+                mid = (lo + hi) / 2
+                if math.erf(mid) < y:
+                    lo = mid
+                else:
+                    hi = mid
+            return (lo + hi) / 2
+
+        return vectorize(inv)
+    raise KeyError(name)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", SHAPES)
+def test_unary_matrix(shape, dtype):
+    for name, oracle, domain, _diff in UNARY:
+        fn = getattr(mx.nd, name)
+        x = _data(shape, dtype, domain)
+        got = fn(NDArray(x)).asnumpy().astype("float32")
+        want = _oracle(name, oracle)(x.astype("float64")).astype("float32")
+        tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" else {}
+        assert_almost_equal(NDArray(got), NDArray(want.astype(dtype)
+                                                  .astype("float32")),
+                            names=(f"{name}@{shape}/{dtype}", "oracle"), **tol)
+
+
+@pytest.mark.parametrize("shape", DEGENERATE)
+def test_unary_degenerate_shapes(shape):
+    for name, oracle, domain, _diff in UNARY:
+        fn = getattr(mx.nd, name)
+        x = _data(shape, "float32", domain)
+        got = fn(NDArray(x)).asnumpy()
+        assert got.shape == x.shape, name
+
+
+def test_unary_gradients_fp32():
+    for name, _oracle_fn, domain, diff in UNARY:
+        if not diff:
+            continue
+        fn = getattr(mx.nd, name)
+        x = NDArray(_data((3, 4), "float32", domain))
+        check_numeric_gradient(lambda a, f=fn: f(a), [x], rtol=2e-2, atol=2e-3)
+
+
+BINARY = [
+    ("add", onp.add, True),
+    ("subtract", onp.subtract, True),
+    ("multiply", onp.multiply, True),
+    ("divide", onp.divide, True),
+    ("maximum", onp.maximum, True),
+    ("minimum", onp.minimum, True),
+    ("power", None, True),       # positive base below
+    ("hypot", onp.hypot, True),
+    ("arctan2", onp.arctan2, True),
+    ("modulo", onp.mod, False),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shapes", [((3, 4), (3, 4)), ((2, 3, 4), (1, 3, 1)),
+                                    ((4,), (2, 1, 4))])
+def test_binary_broadcast_matrix(shapes, dtype):
+    sa, sb = shapes
+    for name, oracle, _diff in BINARY:
+        fn = getattr(mx.nd, name)
+        a = _data(sa, dtype, "positive" if name == "power" else "nonzero")
+        b = _data(sb, dtype, "positive" if name in ("power", "divide", "modulo")
+                  else "nonzero")
+        got = fn(NDArray(a), NDArray(b)).asnumpy().astype("float32")
+        want = (onp.power if name == "power" else oracle)(
+            a.astype("float64"), b.astype("float64")).astype(dtype)
+        tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" else {}
+        assert_almost_equal(NDArray(got), NDArray(want.astype("float32")),
+                            names=(f"{name}@{shapes}/{dtype}", "oracle"), **tol)
+
+
+def test_binary_gradients_fp32():
+    for name, _o, diff in BINARY:
+        if not diff:
+            continue
+        fn = getattr(mx.nd, name)
+        a = NDArray(_data((3, 4), "float32", "positive"))
+        b = NDArray(_data((3, 4), "float32", "positive"))
+        check_numeric_gradient(lambda x, y, f=fn: f(x, y), [a, b],
+                               rtol=2e-2, atol=2e-3)
+
+
+REDUCTIONS = [
+    ("sum", onp.sum), ("mean", onp.mean), ("max", onp.max), ("min", onp.min),
+    ("prod", onp.prod), ("nansum", onp.nansum), ("nanprod", onp.nanprod),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("axis", [None, 0, 1, -1, (0, 1)])
+def test_reduction_matrix(axis, dtype):
+    x = _data((3, 4, 5), dtype, "any")
+    for name, oracle in REDUCTIONS:
+        fn = getattr(mx.nd, name)
+        for keepdims in (False, True):
+            got = fn(NDArray(x), axis=axis, keepdims=keepdims).asnumpy()
+            want = oracle(x.astype("float64"), axis=axis, keepdims=keepdims)
+            want = onp.asarray(want, "float32")
+            tol = dict(rtol=2e-2, atol=2e-2) if dtype == "bfloat16" \
+                else dict(rtol=2e-5, atol=1e-5)
+            onp.testing.assert_allclose(
+                onp.asarray(got, "float32").reshape(want.shape), want,
+                err_msg=f"{name} axis={axis} keepdims={keepdims} {dtype}",
+                **tol)
+
+
+def test_reduction_gradients_fp32():
+    for name in ("sum", "mean", "max", "min", "prod"):
+        fn = getattr(mx.nd, name)
+        x = NDArray((RS.uniform(0.5, 2.0, size=(3, 4))).astype("float32"))
+        check_numeric_gradient(lambda a, f=fn: f(a, axis=1), [x],
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_norm_matrix():
+    x = _data((3, 4), "float32", "any")
+    for ord_ in (1, 2):
+        for axis in (None, 0, 1):
+            got = mx.nd.norm(NDArray(x), ord=ord_, axis=axis).asnumpy()
+            want = onp.linalg.norm(x, ord=ord_, axis=axis) if axis is not None \
+                else (onp.abs(x).sum() if ord_ == 1
+                      else onp.sqrt((x ** 2).sum()))
+            onp.testing.assert_allclose(got.reshape(onp.shape(want)),
+                                        onp.asarray(want, "float32"),
+                                        rtol=1e-5, atol=1e-5)
+
+
+SHAPE_OPS_CASES = [
+    ("reshape", lambda x: mx.nd.reshape(x, (4, 3)),
+     lambda a: a.reshape(4, 3), (3, 4)),
+    ("transpose", lambda x: mx.nd.transpose(x, (1, 0)),
+     lambda a: a.T, (3, 4)),
+    ("swapaxes", lambda x: mx.nd.swapaxes(x, 0, 2),
+     lambda a: a.swapaxes(0, 2), (2, 3, 4)),
+    ("expand_dims", lambda x: mx.nd.expand_dims(x, 1),
+     lambda a: a[:, None], (3, 4)),
+    ("squeeze", lambda x: mx.nd.squeeze(x),
+     lambda a: a.squeeze(), (3, 1, 4)),
+    ("flip", lambda x: mx.nd.flip(x, 1), lambda a: a[:, ::-1], (3, 4)),
+    ("tile", lambda x: mx.nd.tile(x, (2, 3)),
+     lambda a: onp.tile(a, (2, 3)), (3, 4)),
+    ("repeat", lambda x: mx.nd.repeat(x, 2, axis=1),
+     lambda a: onp.repeat(a, 2, 1), (3, 4)),
+    ("pad_edge", lambda x: mx.nd.pad(x.reshape(1, 1, 3, 4), mode="edge",
+                                     pad_width=(0, 0, 0, 0, 1, 1, 2, 2)),
+     lambda a: onp.pad(a.reshape(1, 1, 3, 4),
+                       ((0, 0), (0, 0), (1, 1), (2, 2)), mode="edge"), (3, 4)),
+    ("slice_axis", lambda x: x.slice_axis(1, 1, 3),
+     lambda a: a[:, 1:3], (3, 4)),
+    ("reverse", lambda x: mx.nd.reverse(x, axis=0),
+     lambda a: a[::-1], (3, 4)),
+    ("space_to_depth", lambda x: mx.nd.space_to_depth(x, 2),
+     None, (1, 2, 4, 4)),
+    ("depth_to_space", lambda x: mx.nd.depth_to_space(x, 2),
+     None, (1, 8, 2, 2)),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_shape_ops_matrix(dtype):
+    for name, fn, oracle, shape in SHAPE_OPS_CASES:
+        x = _data(shape, dtype, "any")
+        got = fn(NDArray(x)).asnumpy()
+        if oracle is not None:
+            onp.testing.assert_array_equal(
+                got.astype("float32"),
+                onp.ascontiguousarray(oracle(x)).astype("float32"),
+                err_msg=f"{name}/{dtype}")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int32"])
+def test_indexing_ops_matrix(dtype):
+    x = onp.arange(12).reshape(3, 4).astype(dtype)
+    # take
+    idx = NDArray(onp.asarray([2, 0], "int32"))
+    got = mx.nd.take(NDArray(x), idx, axis=0).asnumpy()
+    onp.testing.assert_array_equal(got, x[[2, 0]])
+    # pick
+    p = onp.asarray([1, 3, 0], "int32")
+    got = mx.nd.pick(NDArray(x), NDArray(p)).asnumpy()
+    onp.testing.assert_array_equal(got, x[onp.arange(3), p])
+    # one_hot
+    oh = mx.nd.one_hot(NDArray(p), 4).asnumpy()
+    onp.testing.assert_array_equal(oh.argmax(1), p)
+    # gather_nd: MXNet convention — indices (M, N), row m = coords in dim m
+    gi = NDArray(onp.asarray([[0, 1], [2, 1]], "int32"))
+    got = mx.nd.gather_nd(NDArray(x), gi).asnumpy()
+    onp.testing.assert_array_equal(got, x[[0, 1], [2, 1]])
+    # topk / sort / argsort
+    v = onp.asarray([[3, 1, 2], [0, 5, 4]], dtype)
+    top = mx.nd.topk(NDArray(v), k=2, ret_typ="value").asnumpy()
+    onp.testing.assert_array_equal(top, -onp.sort(-v, 1)[:, :2])
+    s = mx.nd.sort(NDArray(v)).asnumpy()
+    onp.testing.assert_array_equal(s, onp.sort(v, 1))
+    a = mx.nd.argsort(NDArray(v)).asnumpy()
+    onp.testing.assert_array_equal(a.astype(int), onp.argsort(v, 1))
+
+
+def test_concat_stack_split_matrix():
+    for dtype in DTYPES:
+        a = _data((2, 3), dtype, "any")
+        b = _data((2, 3), dtype, "any")
+        c = mx.nd.concat(NDArray(a), NDArray(b), dim=1).asnumpy()
+        assert c.shape == (2, 6)
+        s = mx.nd.stack(NDArray(a), NDArray(b), axis=0).asnumpy()
+        assert s.shape == (2, 2, 3)
+        parts = mx.nd.split_v2(NDArray(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == (2, 1)
+        onp.testing.assert_array_equal(
+            onp.concatenate([p.asnumpy() for p in parts], 1).astype("float32"),
+            a.astype("float32"))
+
+
+def test_clip_where_comparisons_matrix():
+    for dtype in DTYPES:
+        x = _data((3, 4), dtype, "any")
+        y = _data((3, 4), dtype, "any")
+        got = mx.nd.clip(NDArray(x), -0.5, 0.5).asnumpy().astype("float32")
+        onp.testing.assert_allclose(got, onp.clip(x.astype("float32"),
+                                                  -0.5, 0.5), rtol=1e-2)
+        w = mx.nd.where(NDArray((x > 0).astype(dtype)), NDArray(x),
+                        NDArray(y)).asnumpy()
+        onp.testing.assert_array_equal(w.astype("float32"),
+                                       onp.where(x > 0, x, y).astype("float32"))
+        for name, op in [("greater", onp.greater), ("lesser", onp.less),
+                         ("equal", onp.equal), ("not_equal", onp.not_equal),
+                         ("greater_equal", onp.greater_equal),
+                         ("lesser_equal", onp.less_equal)]:
+            got = getattr(mx.nd, name)(NDArray(x), NDArray(y)).asnumpy()
+            onp.testing.assert_array_equal(got.astype(bool), op(x, y))
+
+
+def test_broadcast_family_matrix():
+    a = _data((2, 1, 4), "float32", "nonzero")
+    b = _data((1, 3, 1), "float32", "nonzero")
+    table = [("broadcast_add", onp.add), ("broadcast_sub", onp.subtract),
+             ("broadcast_mul", onp.multiply), ("broadcast_div", onp.divide),
+             ("broadcast_maximum", onp.maximum),
+             ("broadcast_minimum", onp.minimum),
+             ("broadcast_power", onp.power),
+             ("broadcast_hypot", onp.hypot)]
+    for name, op in table:
+        aa = onp.abs(a) + 0.5 if name == "broadcast_power" else a
+        got = getattr(mx.nd, name)(NDArray(aa), NDArray(b)).asnumpy()
+        onp.testing.assert_allclose(got, op(aa, b), rtol=1e-5, atol=1e-6,
+                                    err_msg=name)
+    got = mx.nd.broadcast_to(NDArray(b), (2, 3, 4)).asnumpy()
+    onp.testing.assert_array_equal(got, onp.broadcast_to(b, (2, 3, 4)))
+    got = mx.nd.broadcast_like(NDArray(b), NDArray(a * onp.ones((2, 3, 4),
+                                                                "float32"))).asnumpy()
+    assert got.shape == (2, 3, 4)
+
+
+def test_logical_family_matrix():
+    x = (RS.rand(3, 4) > 0.5).astype("float32")
+    y = (RS.rand(3, 4) > 0.5).astype("float32")
+    for name, op in [("logical_and", onp.logical_and),
+                     ("logical_or", onp.logical_or),
+                     ("logical_xor", onp.logical_xor)]:
+        got = getattr(mx.nd, name)(NDArray(x), NDArray(y)).asnumpy()
+        onp.testing.assert_array_equal(got.astype(bool), op(x > 0, y > 0),
+                                       err_msg=name)
+    got = mx.nd.logical_not(NDArray(x)).asnumpy()
+    onp.testing.assert_array_equal(got.astype(bool), ~(x > 0))
+    for name, op in [("isnan", onp.isnan), ("isinf", onp.isinf),
+                     ("isfinite", onp.isfinite)]:
+        z = onp.asarray([[1.0, onp.nan, onp.inf, -onp.inf]], "float32")
+        got = getattr(mx.nd, name)(NDArray(z)).asnumpy()
+        onp.testing.assert_array_equal(got.astype(bool), op(z), err_msg=name)
+
+
+def test_sequence_ops_matrix():
+    x = RS.randn(4, 2, 3).astype("float32")  # (T, B, C)
+    vl = onp.asarray([2, 4], "float32")
+    last = mx.nd.sequence_last(NDArray(x), NDArray(vl),
+                               use_sequence_length=True).asnumpy()
+    onp.testing.assert_allclose(last[0], x[1, 0], rtol=1e-6)
+    onp.testing.assert_allclose(last[1], x[3, 1], rtol=1e-6)
+    masked = mx.nd.sequence_mask(NDArray(x), NDArray(vl),
+                                 use_sequence_length=True).asnumpy()
+    assert (masked[2:, 0] == 0).all() and (masked[:, 1] == x[:, 1]).all()
+    rev = mx.nd.sequence_reverse(NDArray(x), NDArray(vl),
+                                 use_sequence_length=True).asnumpy()
+    onp.testing.assert_allclose(rev[0, 0], x[1, 0], rtol=1e-6)
+
+
+def test_smooth_l1_and_softmax_family():
+    x = _data((3, 4), "float32", "any")
+    got = mx.nd.smooth_l1(NDArray(x), scalar=1.0).asnumpy()
+    want = onp.where(onp.abs(x) < 1, 0.5 * x * x, onp.abs(x) - 0.5)
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    for name in ("softmax", "log_softmax", "softmin"):
+        got = getattr(mx.nd, name)(NDArray(x), axis=-1).asnumpy()
+        e = onp.exp((-x if name == "softmin" else x)
+                    - (-x if name == "softmin" else x).max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        want = onp.log(sm) if name == "log_softmax" else sm
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                    err_msg=name)
+        check_numeric_gradient(
+            lambda a, f=getattr(mx.nd, name): f(a, axis=-1),
+            [NDArray(x)], rtol=2e-2, atol=2e-3)
+
+
+# --------------------------------------------------------------------- #
+# NN op family matrix (ref test_operator.py conv/pool/norm matrices)
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("cfg", [
+    dict(shape=(2, 3, 8, 8), kernel=(3, 3), stride=(1, 1), pad=(1, 1), nf=4),
+    dict(shape=(1, 4, 7, 9), kernel=(2, 2), stride=(2, 2), pad=(0, 0), nf=6),
+    dict(shape=(2, 4, 6, 6), kernel=(3, 3), stride=(1, 1), pad=(1, 1), nf=4,
+         groups=2),
+    dict(shape=(2, 3, 10), kernel=(3,), stride=(2,), pad=(1,), nf=5),  # 1D
+])
+def test_convolution_matrix(cfg, dtype):
+    import jax
+
+    nd_sp = len(cfg["kernel"])
+    g = cfg.get("groups", 1)
+    x = _data(cfg["shape"], dtype, "any")
+    w = _data((cfg["nf"], cfg["shape"][1] // g) + cfg["kernel"], dtype, "any")
+    b = _data((cfg["nf"],), dtype, "any")
+    out = mx.nd.Convolution(NDArray(x), NDArray(w), NDArray(b),
+                            kernel=cfg["kernel"], stride=cfg["stride"],
+                            pad=cfg["pad"], num_filter=cfg["nf"],
+                            num_group=g).asnumpy()
+    # oracle via jax in fp32
+    import jax.numpy as jnp
+    from jax import lax
+
+    sp = "DHW"[-nd_sp:]
+    want = lax.conv_general_dilated(
+        jnp.asarray(x, jnp.float32), jnp.asarray(w, jnp.float32),
+        cfg["stride"], [(p, p) for p in cfg["pad"]],
+        dimension_numbers=("NC" + sp, "OI" + sp, "NC" + sp),
+        feature_group_count=g)
+    want = onp.asarray(want) + b.astype("float32").reshape((1, -1) + (1,) * nd_sp)
+    tol = dict(rtol=4e-2, atol=3e-2) if dtype == "bfloat16" \
+        else dict(rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(out.astype("float32"), want, **tol)
+
+
+def test_convolution_gradient_fp32():
+    x = NDArray(_data((2, 3, 6, 6), "float32", "any"))
+    w = NDArray(_data((4, 3, 3, 3), "float32", "any"))
+    check_numeric_gradient(
+        lambda a, ww: mx.nd.Convolution(a, ww, kernel=(3, 3), stride=(1, 1),
+                                        pad=(1, 1), num_filter=4,
+                                        no_bias=True),
+        [x, w], rtol=5e-2, atol=5e-2)  # fp32 central differences over a
+    # 72-position reduction carry ~1e-2 absolute noise
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+@pytest.mark.parametrize("cfg", [
+    dict(shape=(2, 3, 8, 8), kernel=(2, 2), stride=(2, 2), pad=(0, 0)),
+    dict(shape=(1, 2, 7, 7), kernel=(3, 3), stride=(2, 2), pad=(1, 1)),
+])
+def test_pooling_matrix(cfg, pool_type, dtype):
+    x = _data(cfg["shape"], dtype, "any")
+    out = mx.nd.Pooling(NDArray(x), kernel=cfg["kernel"], pool_type=pool_type,
+                        stride=cfg["stride"], pad=cfg["pad"]).asnumpy()
+    N, C, H, W = cfg["shape"]
+    kh, kw = cfg["kernel"]
+    sh, sw = cfg["stride"]
+    ph, pw = cfg["pad"]
+    xp = onp.pad(x.astype("float64"), ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=-onp.inf if pool_type == "max" else 0.0)
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    want = onp.zeros((N, C, Ho, Wo))
+    for i in range(Ho):
+        for j in range(Wo):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if pool_type == "max":
+                want[:, :, i, j] = win.max((2, 3))
+            else:
+                # count_include_pad=True (reference default)
+                want[:, :, i, j] = win.sum((2, 3)) / (kh * kw)
+    tol = dict(rtol=3e-2, atol=2e-2) if dtype == "bfloat16" \
+        else dict(rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(out.astype("float64"), want, **tol)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("shape", [(4, 6), (2, 5, 6)])
+def test_fullyconnected_matrix(shape, dtype):
+    x = _data(shape, dtype, "any")
+    w = _data((3, shape[-1]), dtype, "any")
+    b = _data((3,), dtype, "any")
+    out = mx.nd.FullyConnected(NDArray(x), NDArray(w), NDArray(b),
+                               num_hidden=3, flatten=False).asnumpy()
+    want = x.astype("float32") @ w.astype("float32").T + b.astype("float32")
+    tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" \
+        else dict(rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(out.astype("float32"), want, **tol)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_norm_layers_matrix(dtype):
+    # LayerNorm
+    x = _data((2, 5, 8), dtype, "any")
+    g = onp.ones(8, dtype)
+    b = onp.zeros(8, dtype)
+    out = mx.nd.LayerNorm(NDArray(x), NDArray(g), NDArray(b)).asnumpy()
+    xf = x.astype("float64")
+    want = (xf - xf.mean(-1, keepdims=True)) / onp.sqrt(
+        xf.var(-1, keepdims=True) + 1e-5)
+    tol = dict(rtol=4e-2, atol=3e-2) if dtype == "bfloat16" \
+        else dict(rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(out.astype("float64"), want, **tol)
+    # BatchNorm (training stats)
+    x = _data((4, 3, 5, 5), dtype, "any")
+    g1 = onp.ones(3, dtype)
+    b1 = onp.zeros(3, dtype)
+    mm = onp.zeros(3, "float32")
+    mv = onp.ones(3, "float32")
+    out = mx.nd.BatchNorm(NDArray(x), NDArray(g1), NDArray(b1), NDArray(mm),
+                          NDArray(mv), training=True)[0].asnumpy()
+    xf = x.astype("float64")
+    mean = xf.mean((0, 2, 3), keepdims=True)
+    var = xf.var((0, 2, 3), keepdims=True)
+    want = (xf - mean) / onp.sqrt(var + 1e-5)
+    onp.testing.assert_allclose(out.astype("float64"), want, **tol)
+
+
+def test_activation_family_matrix():
+    x = _data((3, 4), "float32", "any")
+    table = {
+        "relu": lambda a: onp.maximum(a, 0),
+        "sigmoid": lambda a: 1 / (1 + onp.exp(-a)),
+        "tanh": onp.tanh,
+        "softrelu": lambda a: onp.log1p(onp.exp(a)),
+        "softsign": lambda a: a / (1 + onp.abs(a)),
+    }
+    for act, oracle in table.items():
+        got = mx.nd.Activation(NDArray(x), act_type=act).asnumpy()
+        onp.testing.assert_allclose(got, oracle(x), rtol=1e-5, atol=1e-6,
+                                    err_msg=act)
+    for slope in (0.1, 0.3):
+        got = mx.nd.LeakyReLU(NDArray(x), act_type="leaky",
+                              slope=slope).asnumpy()
+        onp.testing.assert_allclose(got, onp.where(x > 0, x, slope * x),
+                                    rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_matrix():
+    for dtype in DTYPES:
+        w = _data((7, 5), dtype, "any")
+        idx = onp.asarray([[0, 3], [6, 1]], "int32")
+        out = mx.nd.Embedding(NDArray(idx), NDArray(w), input_dim=7,
+                              output_dim=5).asnumpy()
+        onp.testing.assert_array_equal(out.astype("float32"),
+                                       w[idx].astype("float32"))
