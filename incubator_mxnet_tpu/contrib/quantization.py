@@ -281,11 +281,8 @@ def quantize_net(net, calib_data, calib_mode: str = "minmax",
     # the tree, or an already-hybridized net silently keeps running the
     # old fp32 jit closure
     def invalidate(block):
-        if hasattr(block, "_cached_fn"):
-            block._cached_fn = None
-            block._aval_cache = {}
-            block._chain_cache = {}
-            block._cache_version += 1
+        if hasattr(block, "_invalidate_cached_program"):
+            block._invalidate_cached_program()
         for c in block._children.values():
             invalidate(c)
 

@@ -569,9 +569,7 @@ class HybridBlock(Block):
         """
         self._active = active
         self._remat_backward = remat_backward
-        self._cached_fn = None
-        self._aval_cache = {}
-        self._chain_cache = {}
+        self._invalidate_cached_program()
         for c in self._children.values():
             if isinstance(c, HybridBlock):
                 c.hybridize(active, static_alloc=static_alloc,
@@ -582,10 +580,17 @@ class HybridBlock(Block):
     def cast(self, dtype):
         """Parameter dtype changes invalidate cached programs and avals."""
         super().cast(dtype)
+        self._invalidate_cached_program()
+        return self
+
+    def _invalidate_cached_program(self):
+        """Drop every cached compiled program/aval for THIS block — the
+        single reset used by hybridize/cast and structural rewrites
+        (e.g. contrib.quantization.quantize_net)."""
         self._cached_fn = None
         self._aval_cache = {}
         self._chain_cache = {}
-        return self
+        self._cache_version += 1
 
     def infer_shape(self, *args):
         """Run a shape-only forward to resolve deferred params."""

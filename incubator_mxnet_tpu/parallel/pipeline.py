@@ -1,12 +1,31 @@
-"""Pipeline parallelism — GPipe microbatch schedule over the `pipe` axis.
+"""Pipeline parallelism — GPipe and 1F1B microbatch schedules over the
+`pipe` axis.
 
 ABSENT in the reference (SURVEY.md §2.4: "build: shard_map stage mesh +
 microbatch lax.scan").  Implementation: every device holds ONE stage's
-params; a lax.scan over (num_microbatches + num_stages - 1) ticks keeps
-all stages busy; activations move stage→stage with a single ppermute
-per tick (ICI neighbor transfer).  The same schedule runs forward AND
-backward when jitted under jax.grad — XLA differentiates through scan
-and ppermute, yielding the 1F1B-equivalent reverse pipeline for free.
+params; a loop over ticks keeps all stages busy; activations move
+stage→stage with a single ppermute per tick (ICI neighbor transfer).
+
+Two schedules:
+
+- `pipeline_apply` (GPipe): forward-only schedule; under jax.grad XLA
+  differentiates through the loop, replaying ticks in reverse AFTER all
+  forward ticks — activation memory O(M · per-tick residuals), reduced
+  to O(M · activation) by `remat_stage`.
+- `pipeline_train_1f1b`: the REAL 1F1B tick order — each stage
+  alternates one-forward/one-backward in steady state, holding at most
+  `n_stages` microbatches of residuals in a circular buffer regardless
+  of M.  This is the schedule, not an emulation: backward of microbatch
+  m runs while later microbatches are still going forward.
+
+Collective safety (both schedules): every branch predicate (`active`,
+fwd/bwd tick parity) is a function of (tick, pipe index) ONLY, so it is
+uniform across the members of any collective group that does not span
+the `pipe` axis — in-stage TP/DP collectives (psum over 'model'/'data')
+therefore cannot diverge across their group and `skip_inactive`/1F1B
+branching is deadlock-free with them.  A collective spanning `pipe`
+inside a stage remains unsupported (members would sit in different
+branches).
 """
 from __future__ import annotations
 
@@ -18,7 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_forward", "pipeline_apply"]
+__all__ = ["pipeline_forward", "pipeline_apply", "pipeline_train_1f1b"]
 
 
 def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
@@ -35,9 +54,11 @@ def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
 
     skip_inactive: wrap the stage compute in `lax.cond(active, ...)` so
     bubble ticks skip the FLOPs instead of computing-and-masking (the
-    r1 review's PP-efficiency gap).  ONLY safe when stage_fn contains
-    no collectives — with e.g. TP psum inside the stage, divergent
-    per-device branches would deadlock, so it defaults off.
+    r1 review's PP-efficiency gap).  Safe with in-stage collectives
+    whose group does NOT span the pipe axis (TP/DP psum): `active`
+    depends only on (tick, pipe index), so all members of such a group
+    take the same branch (see module docstring; proven by the PP×TP
+    composed test).  Unsafe only for collectives spanning `pipe`.
 
     remat_stage: recompute the stage in the backward instead of saving
     its internals per tick.  Under jax.grad the scan otherwise stores
@@ -103,6 +124,10 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
     from jax import shard_map
 
     B = x.shape[0]
+    if B % num_microbatches:
+        raise ValueError(
+            f"pipeline_apply: batch {B} not divisible by "
+            f"num_microbatches {num_microbatches}")
     mb = B // num_microbatches
     xm = x.reshape((num_microbatches, mb) + x.shape[1:])
 
@@ -117,3 +142,213 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
                    in_specs=(param_spec, P()), out_specs=P(), check_vma=False)
     out = fn(all_stage_params, xm)
     return out.reshape((B,) + out.shape[2:])
+
+
+# --------------------------------------------------------------------- #
+# true 1F1B (PipeDream-flush) schedule
+# --------------------------------------------------------------------- #
+def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
+                 n_static, recompute_stage=True):
+    """One device's 1F1B train step (inside shard_map over `axis_name`).
+
+    Tick times (n stages, idx = this stage, m = microbatch):
+      forward(m)  at t = idx + 2m
+      backward(m) at t = 2n − 1 − idx + 2m
+    — opposite parities, so each tick a stage does one fwd OR one bwd.
+    Residency of microbatch m at stage idx = 2(n−idx)−1 ticks →
+    ≤ n microbatches in flight: state lives in a circular buffer of
+    n slots (fwd(m+n) lands strictly after bwd(m): t gap = 2·idx+1 > 0),
+    the 1F1B memory bound GPipe lacks.
+
+    recompute_stage=True (default): the buffer holds only each in-flight
+    microbatch's stage INPUT; the backward tick re-runs the stage vjp —
+    O(n·activation) memory, one extra stage forward per microbatch
+    (XLA's vjp residuals would otherwise duplicate the weight arrays
+    into every slot; measured in docs/pipeline_1f1b.md).
+    recompute_stage=False: full residuals are buffered — standard
+    fwd+bwd FLOP budget, O(n·residuals) memory.
+
+    Returns (sum of per-microbatch losses on the last stage, summed
+    param grads for this stage).
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = xm.shape[0]
+    mb_shape = xm.shape[1:]
+    dt = xm.dtype
+    total = 2 * (M + n_static - 1)
+    fwd_perm = [(i, (i + 1) % n_static) for i in range(n_static)]
+    bwd_perm = [(i, (i - 1) % n_static) for i in range(n_static)]
+
+    # varying-manual-axes discipline: under shard_map with vma checking
+    # ON (which is what makes the AD of in-stage collectives CORRECT —
+    # with check_vma=False psum transposes to psum and grads come out
+    # axis_size× too large), every cond branch pair must agree in vma,
+    # and cotangents must carry exactly the vma of the value they are
+    # cotangents OF (a psum-ending stage yields outputs invariant in the
+    # TP axis).  We track: activation/ring vma (fixpoint of the stage's
+    # output vma), per-residual-leaf vma, and per-param-grad vma.
+    def _vma(z):
+        return set(getattr(jax.typeof(z), "vma", ()))
+
+    def cast_to(z, target):
+        need = tuple(a for a in sorted(set(target) - _vma(z)))
+        return lax.pcast(z, need, to="varying") if need else z
+
+    act_vma = {axis_name}
+    y_t = pull_t = None
+    for _ in range(3):  # fixpoint: output vma feeds back as input vma
+        y_t, pull_t = jax.vjp(stage_fn, params,
+                              cast_to(jnp.zeros(mb_shape, dt), act_vma))
+        new_vma = act_vma | _vma(y_t)
+        if new_vma == act_vma:
+            break
+        act_vma = new_vma
+    xm = cast_to(xm, act_vma)
+    targets = cast_to(targets, act_vma)
+
+    if recompute_stage:
+        # buffer only the stage inputs; bwd re-derives residuals
+        res_leaves_t = [cast_to(jnp.zeros(mb_shape, dt), act_vma)]
+        res_treedef = None
+    else:
+        res_leaves_t, res_treedef = jax.tree_util.tree_flatten(pull_t)
+    res_buf0 = tuple(cast_to(jnp.zeros((n_static,) + l.shape, l.dtype),
+                             _vma(l) | {axis_name})
+                     for l in res_leaves_t)
+    # y buffer only needed when residuals are stored (recompute mode
+    # re-derives y at the bwd tick)
+    y_buf0 = cast_to(jnp.zeros((1,) if recompute_stage
+                               else (n_static,) + mb_shape, dt), act_vma)
+    dacc0 = jax.tree_util.tree_map(
+        lambda p: cast_to(jnp.zeros(p.shape, jnp.float32),
+                          _vma(p) | {axis_name}), params)
+
+    def pv(z):  # activations/scalars promote to the ring vma
+        return cast_to(z, act_vma)
+
+    def tick(t, carry):
+        ring_f, ring_b, res_buf, y_buf, dacc, loss_sum = carry
+        tf = t - idx
+        m_f = tf // 2
+        do_f = jnp.logical_and(jnp.logical_and(tf >= 0, tf % 2 == 0), m_f < M)
+        tb = t - (2 * n - 1 - idx)
+        m_b = tb // 2
+        do_b = jnp.logical_and(jnp.logical_and(tb >= 0, tb % 2 == 0), m_b < M)
+
+        def fwd_branch(op):
+            ring_f, res_buf, y_buf = op
+            mclip = jnp.clip(m_f, 0, M - 1)
+            x_in = jnp.where(idx == 0, xm[mclip], ring_f)
+            slot = mclip % n
+            if recompute_stage:
+                y = stage_fn(params, x_in)
+                leaves = [x_in]
+            else:
+                y, pull = jax.vjp(stage_fn, params, x_in)
+                leaves = jax.tree_util.tree_leaves(pull)
+            res_buf = tuple(
+                lax.dynamic_update_index_in_dim(b, pv(l).astype(b.dtype),
+                                                slot, 0)
+                for b, l in zip(res_buf, leaves))
+            if not recompute_stage:
+                y_buf = lax.dynamic_update_index_in_dim(
+                    y_buf, pv(y).astype(dt), slot, 0)
+            return pv(y).astype(dt), res_buf, y_buf
+
+        def fwd_skip(op):
+            ring_f, res_buf, y_buf = op
+            return pv(jnp.zeros(mb_shape, dt)), res_buf, y_buf
+
+        y_out, res_buf, y_buf = lax.cond(do_f, fwd_branch, fwd_skip,
+                                         (ring_f, res_buf, y_buf))
+
+        def bwd_branch(op):
+            ring_b, dacc, loss_sum = op
+            mclip = jnp.clip(m_b, 0, M - 1)
+            slot = mclip % n
+            leaves = [lax.dynamic_index_in_dim(b, slot, 0, keepdims=False)
+                      for b in res_buf]
+            if recompute_stage:
+                y_m, pull = jax.vjp(stage_fn, params, leaves[0])
+            else:
+                pull = jax.tree_util.tree_unflatten(res_treedef, leaves)
+                y_m = lax.dynamic_index_in_dim(y_buf, slot, 0, keepdims=False)
+            tgt = targets[mclip]
+            l_m, pl = jax.vjp(lambda yy: loss_fn(yy, tgt), y_m)
+            (dy_loss,) = pl(jnp.ones_like(l_m))
+            is_last = idx == n - 1
+            cot = jnp.where(is_last, pv(dy_loss).astype(dt), ring_b)
+            loss_sum = loss_sum + jnp.where(is_last,
+                                            pv(l_m).astype(jnp.float32), 0.0)
+            dparams_m, dx_m = pull(cot)
+            dacc = jax.tree_util.tree_map(
+                lambda a, g: a + pv(g).astype(jnp.float32), dacc, dparams_m)
+            return pv(dx_m).astype(dt), dacc, loss_sum
+
+        def bwd_skip(op):
+            ring_b, dacc, loss_sum = op
+            return pv(jnp.zeros(mb_shape, dt)), dacc, loss_sum
+
+        dx_out, dacc, loss_sum = lax.cond(do_b, bwd_branch, bwd_skip,
+                                          (ring_b, dacc, loss_sum))
+
+        ring_f = lax.ppermute(y_out, axis_name, fwd_perm)
+        ring_b = lax.ppermute(dx_out, axis_name, bwd_perm)
+        return ring_f, ring_b, res_buf, y_buf, dacc, loss_sum
+
+    carry0 = (pv(jnp.zeros(mb_shape, dt)), pv(jnp.zeros(mb_shape, dt)),
+              res_buf0, y_buf0, dacc0, pv(jnp.float32(0)))
+    *_rest, dacc, loss_sum = lax.fori_loop(0, total, tick, carry0)
+    return loss_sum, dacc
+
+
+def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
+                        all_stage_params, x, targets, mesh: Mesh,
+                        num_microbatches: int, axis_name: str = "pipe",
+                        recompute_stage: bool = True):
+    """True 1F1B pipeline train step.
+
+    stage_fn(params, x) -> y (uniform activation shape across stages;
+    in-stage collectives over non-`pipe` axes are allowed — see module
+    docstring).  loss_fn(y, target) -> scalar per microbatch, evaluated
+    on the LAST stage.
+
+    Returns ``(mean_loss, grads)`` where grads has the stages' leading
+    dim (like all_stage_params) and corresponds to the MEAN
+    per-microbatch loss.
+    """
+    from jax import shard_map
+
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(
+            f"pipeline_train_1f1b: batch {B} not divisible by "
+            f"num_microbatches {M}")
+    mb = B // M
+    xm = x.reshape((M, mb) + x.shape[1:])
+    tm = targets.reshape((M, mb) + targets.shape[1:])
+    n_static = mesh.shape[axis_name]
+
+    def inner(params_stacked, xmb, tmb):
+        params = jax.tree_util.tree_map(lambda p: p[0], params_stacked)
+        loss_sum, dacc = _1f1b_device(stage_fn, loss_fn, params, xmb, tmb,
+                                      axis_name, n_static,
+                                      recompute_stage=recompute_stage)
+        loss = lax.psum(loss_sum, axis_name) / M  # only last stage non-zero
+        for ax in sorted(set(getattr(jax.typeof(loss), "vma", ()))):
+            loss = lax.pmean(loss, ax)  # value replicated on TP axes
+        grads = jax.tree_util.tree_map(lambda g: (g / M)[None], dacc)
+        return loss, grads
+
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                        all_stage_params)
+    # vma checking ON: it is what makes in-stage collective AD correct
+    # (see _1f1b_device); TP'd stages compose by calling _1f1b_device
+    # under your own shard_map with pipe×model in_specs — the PP×TP test
+    # shows the pattern.
+    fn = shard_map(inner, mesh=mesh,
+                   in_specs=(param_spec, P(), P()),
+                   out_specs=(P(), param_spec))
+    return fn(all_stage_params, xm, tm)

@@ -236,3 +236,182 @@ def test_pipeline_remat_stage_grads_match():
     v1, g1 = jax.value_and_grad(lambda W: loss(W, True))(W)
     assert onp.allclose(float(v0), float(v1), rtol=1e-6)
     assert onp.allclose(onp.asarray(g0), onp.asarray(g1), atol=1e-5)
+
+
+def test_pipeline_1f1b_matches_oracle():
+    """True 1F1B schedule: loss + grads == sequential oracle."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel import create_mesh, pipeline as pp
+
+    n, M, mb, d = 4, 8, 2, 6
+    mesh = create_mesh(jax.devices()[:n], pipe=n)
+    k = jax.random.PRNGKey(0)
+    kw, kx, kt = jax.random.split(k, 3)
+    W = jax.random.normal(kw, (n, d, d)) * 0.3
+    x = jax.random.normal(kx, (M * mb, d))
+    tgt = jax.random.normal(kt, (M * mb, d))
+
+    def stage(w, a):
+        return jnp.tanh(a @ w)
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    loss, grads = pp.pipeline_train_1f1b(stage, loss_fn, W, x, tgt, mesh, M)
+
+    def oracle(W):
+        tot = 0.0
+        for m in range(M):
+            a = x[m * mb:(m + 1) * mb]
+            for i in range(n):
+                a = stage(W[i], a)
+            tot = tot + loss_fn(a, tgt[m * mb:(m + 1) * mb])
+        return tot / M
+
+    want_loss = oracle(W)
+    want_grads = jax.grad(oracle)(W)
+    onp.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(grads), onp.asarray(want_grads),
+                                rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_1f1b_composes_with_tp_collectives():
+    """PP×TP: the stage contains a psum over 'model' INSIDE the 1F1B
+    branches — the uniform-branch argument (predicates depend only on
+    the pipe coordinate) makes this deadlock-free; grads must match the
+    oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from incubator_mxnet_tpu.parallel import create_mesh, pipeline as pp
+
+    n, tp, M, mb, d = 2, 2, 4, 2, 4
+    mesh = create_mesh(jax.devices()[:n * tp], pipe=n, model=tp)
+    k = jax.random.PRNGKey(1)
+    kw, kx, kt = jax.random.split(k, 3)
+    # column-sharded weight: (stages, tp, d, d/tp) — each model shard
+    # computes its slice then psums the row-parallel projection back
+    W1 = jax.random.normal(kw, (n, tp, d, d // tp)) * 0.4
+    W2 = jax.random.normal(kt, (n, tp, d // tp, d)) * 0.4
+    x = jax.random.normal(kx, (M * mb, d))
+    tgt = jnp.zeros((M * mb, d))
+
+    def stage_tp(params, a):
+        w1, w2 = params  # (d, d/tp), (d/tp, d) — this shard's columns
+        h = jnp.tanh(a @ w1)
+        return lax.psum(h @ w2, "model")  # row-parallel reduction
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def run(W1, W2):
+        def inner(w1s, w2s, xmb, tmb):
+            params = (w1s[0, 0], w2s[0, 0])
+            loss_sum, dacc = pp._1f1b_device(stage_tp, loss_fn, params,
+                                             xmb, tmb, "pipe", n)
+            loss = lax.psum(loss_sum, "pipe") / M
+            import jax as _jax
+            for ax in sorted(set(getattr(_jax.typeof(loss), "vma", ()))):
+                loss = lax.pmean(loss, ax)
+            # grads: sum the TP shards' contributions is NOT needed —
+            # each shard's grad is for its own columns
+            return loss, jax.tree_util.tree_map(
+                lambda g: (g / M)[None, None], dacc)
+
+        xm = x.reshape((M, mb, d))
+        tm = tgt.reshape((M, mb, d))
+        fn = shard_map(inner, mesh=mesh,
+                       in_specs=(P("pipe", "model"), P("pipe", "model"),
+                                 P(), P()),
+                       out_specs=(P(), (P("pipe", "model"),
+                                        P("pipe", "model"))))
+        return fn(W1, W2, xm, tm)
+
+    loss, (g1, g2) = run(W1, W2)
+
+    # dense oracle: shard s computes tanh(a @ W1[i,s]) @ W2[i,s], summed over s
+    def oracle2(W1o, W2o):
+        tot = 0.0
+        for m in range(M):
+            a = x[m * mb:(m + 1) * mb]
+            for i in range(n):
+                a = sum(jnp.tanh(a @ W1o[i, s]) @ W2o[i, s]
+                        for s in range(tp))
+            tot = tot + loss_fn(a, tgt[m * mb:(m + 1) * mb])
+        return tot / M
+
+    want_loss = oracle2(W1, W2)
+    want_g1, want_g2 = jax.grad(oracle2, argnums=(0, 1))(W1, W2)
+    onp.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(g1), onp.asarray(want_g1),
+                                rtol=1e-4, atol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(g2), onp.asarray(want_g2),
+                                rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_gpipe_skip_inactive_with_tp_collective():
+    """GPipe skip_inactive=True with an in-stage 'model' psum (the
+    formerly-documented-unsafe combination): uniform branches make it
+    safe; output must match skip_inactive=False."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu.parallel import create_mesh, pipeline as pp
+
+    n, tp, M, mb, d = 2, 2, 2, 2, 4
+    mesh = create_mesh(jax.devices()[:n * tp], pipe=n, model=tp)
+    k = jax.random.PRNGKey(2)
+    W1 = jax.random.normal(k, (n, tp, d, d // tp)) * 0.4
+    W2 = jax.random.normal(jax.random.fold_in(k, 1),
+                           (n, tp, d // tp, d)) * 0.4
+    x = jax.random.normal(jax.random.fold_in(k, 2), (M * mb, d))
+
+    def stage_tp(params, a):
+        w1, w2 = params
+        return lax.psum(jnp.tanh(a @ w1) @ w2, "model")
+
+    def run(skip):
+        def inner(w1s, w2s, xmb):
+            return pp.pipeline_forward(stage_tp, (w1s[0, 0], w2s[0, 0]),
+                                       xmb, "pipe", skip_inactive=skip)
+
+        fn = shard_map(inner, mesh=mesh,
+                       in_specs=(P("pipe", "model"), P("pipe", "model"), P()),
+                       out_specs=P(), check_vma=False)
+        return fn(W1, W2, x.reshape(M, mb, d))
+
+    onp.testing.assert_allclose(onp.asarray(run(True)),
+                                onp.asarray(run(False)), rtol=1e-6)
+
+
+def test_pipeline_1f1b_residual_mode_matches_recompute():
+    """recompute_stage=False (stored residuals) must give identical
+    grads to the default recompute mode."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.parallel import create_mesh, pipeline as pp
+
+    n, M, mb, d = 2, 4, 2, 5
+    mesh = create_mesh(jax.devices()[:n], pipe=n)
+    k = jax.random.PRNGKey(3)
+    W = jax.random.normal(k, (n, d, d)) * 0.3
+    x = jax.random.normal(jax.random.fold_in(k, 1), (M * mb, d))
+    tgt = jax.random.normal(jax.random.fold_in(k, 2), (M * mb, d))
+
+    def stage(w, a):
+        return jnp.tanh(a @ w)
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    l1, g1 = pp.pipeline_train_1f1b(stage, loss_fn, W, x, tgt, mesh, M,
+                                    recompute_stage=True)
+    l2, g2 = pp.pipeline_train_1f1b(stage, loss_fn, W, x, tgt, mesh, M,
+                                    recompute_stage=False)
+    onp.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(g1), onp.asarray(g2), rtol=1e-5)
