@@ -21,16 +21,16 @@ import jax
 import jax.numpy as jnp
 
 
-def time_steps(step_once, n=20, warm=3):
-    """step_once() must return something value-fetchable (relay rule:
-    fetch a scalar, never block_until_ready)."""
+def time_steps(step_once, fetch, n=20, warm=3):
+    """Fetch a value ONLY at the timing boundaries (a per-step host
+    fetch costs an RTT on the relay and serializes the queue)."""
     for _ in range(warm):
         out = step_once()
-    float(jnp.asarray(out).ravel()[0])
+    fetch(out)
     t0 = time.perf_counter()
     for _ in range(n):
         out = step_once()
-    float(jnp.asarray(out).ravel()[0])
+    fetch(out)
     return (time.perf_counter() - t0) / n
 
 
@@ -74,9 +74,10 @@ def gluon_variant(B):
             L = loss_fn(net(x), y)
         L.backward()
         tr.step(B)
-        return L.asnumpy().ravel()[:1]
+        return L
 
-    return B / time_steps(step_once)
+    return B / time_steps(step_once,
+                          lambda L: float(L.asnumpy().ravel()[0]))
 
 
 def purejax_variant(B):
@@ -115,7 +116,7 @@ def purejax_variant(B):
         state[0], state[1], state[2] = m, v, a
         return L
 
-    return B / time_steps(step_once)
+    return B / time_steps(step_once, lambda L: float(jnp.asarray(L)))
 
 
 def main():

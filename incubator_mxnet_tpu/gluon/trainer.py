@@ -31,6 +31,18 @@ from ..base import MXNetError
 from ..ndarray.ndarray import raw
 from .parameter import Parameter, ParameterDict
 
+
+def _aval_bytes(a) -> int:
+    import math
+
+    import numpy as onp
+
+    try:
+        itemsize = int(onp.dtype(a.dtype).itemsize)
+    except TypeError:
+        itemsize = 2  # bfloat16 and friends
+    return math.prod(a.shape) * itemsize if a.shape else itemsize
+
 __all__ = ["Trainer"]
 
 
@@ -39,7 +51,9 @@ class Trainer:
                  optimizer, optimizer_params: Optional[dict] = None,
                  kvstore="device", compression_params=None, update_on_kvstore=None,
                  fuse_step: bool = True, donate: bool = True,
-                 keep_grads: bool = True, max_inflight_steps: int = 8,
+                 keep_grads: bool = True,
+                 max_inflight_steps: Optional[int] = None,
+                 max_inflight_bytes: int = 6 << 30,
                  mesh=None, data_axis: str = "data"):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
@@ -82,7 +96,17 @@ class Trainer:
         # output buffers (grads/new states) until it retires, so an
         # unbounded enqueue loop exhausts HBM.  The dependency-engine
         # equivalence of the reference's bounded engine queue.
-        self._max_inflight = max(1, int(max_inflight_steps))
+        # explicit step cap (tight-HBM chips): honored by BOTH throttle
+        # paths; None = default 8 for the eager-backward path, bytes-only
+        # for the one-program path
+        self._user_inflight_cap = None if max_inflight_steps is None \
+            else max(1, int(max_inflight_steps))
+        self._max_inflight = self._user_inflight_cap or 8
+        # one-program path: run-ahead bounded by BYTES actually held per
+        # in-flight step (non-donated program outputs), not step count —
+        # a host sync costs tens of ms on relayed devices, so programs
+        # with small outputs must never pay it (see _throttle_bytes)
+        self._max_inflight_bytes = int(max_inflight_bytes)
         from collections import deque
 
         self._inflight = deque()
@@ -318,6 +342,35 @@ class Trainer:
             except Exception:
                 pass  # donated/deleted buffer: the pipeline moved past it
 
+    def _throttle_bytes(self, leaf, held_bytes: int):
+        """Byte-budgeted run-ahead bound for the one-program step.
+
+        depth = budget // held_bytes steps may be in flight (capped by
+        an EXPLICIT user max_inflight_steps).  A host sync
+        (block_until_ready/device_get) costs tens of ms on relayed
+        devices EVEN on completed buffers (measured: ~80 ms, enough to
+        halve ResNet-50 train), so: small-output programs (depth larger
+        than any realistic run-ahead) never sync at all, and big-output
+        programs drain HALF the queue with ONE sync every depth/2 steps
+        instead of paying one sync per step."""
+        self._inflight.append(leaf)
+        depth = max(2, self._max_inflight_bytes // max(int(held_bytes), 1))
+        if self._user_inflight_cap is not None:
+            depth = min(depth, self._user_inflight_cap)
+        if len(self._inflight) >= depth:
+            last = None
+            while len(self._inflight) > depth // 2:
+                last = self._inflight.popleft()
+            try:
+                jax.block_until_ready(last)
+            except Exception:
+                pass
+        elif len(self._inflight) > 64:
+            # no-sync regime: dropping the reference is free and stops
+            # the queue (and its device scalars) growing for the run's
+            # lifetime — the execution is long retired by 64 steps
+            self._inflight.popleft()
+
     def _fused_step(self):
         opt = self._optimizer
         self._sync_states()
@@ -442,8 +495,9 @@ class Trainer:
         # canonical net→loss chain) are held by every in-flight step, so
         # unbounded run-ahead still exhausts HBM.  The sync leaf is a
         # dedicated non-donated scalar — waiting on it never touches the
-        # donated buffers.
-        self._throttle(sync)
+        # donated buffers.  Byte-budgeted: programs with small outputs
+        # never pay the (expensive-on-relays) host sync.
+        self._throttle_bytes(sync, ctx["held_bytes"])
         for nd, nw in zip(ctx["nds"], new_w):
             nd._data = nw
         ctx["states"] = new_s
@@ -474,6 +528,21 @@ class Trainer:
                     self._params[i]._data_nd._data)
         mults = self._mults_key(idx_of)
         fn = self._build_full_step(pending, mults)
+
+        held = sum(_aval_bytes(a) for a in pending.out_avals)
+        held += sum(_aval_bytes(a) for a in pending.aux_raws)  # new_aux outputs
+        if self._keep_grads:
+            held += sum(_aval_bytes(self._params[i]._data_nd._data)
+                        for i in idx_of)
+        if not self._donate:
+            # un-donated programs copy weights+states per step and hold
+            # the batch inputs too
+            held += sum(_aval_bytes(self._params[i]._data_nd._data)
+                        for i in idx_of)
+            held += sum(_aval_bytes(l)
+                        for i in idx_of
+                        for l in jax.tree_util.tree_leaves(self._states[i]))
+            held += sum(_aval_bytes(a) for a in pending.input_raws)
         return {
             "sig": sig,
             "mults": mults,
@@ -481,6 +550,7 @@ class Trainer:
             "nds": [self._params[i]._data_nd for i in idx_of],
             "states": tuple(self._states[i] for i in idx_of),
             "fn": fn,
+            "held_bytes": held,
         }
 
     def _sync_states(self):

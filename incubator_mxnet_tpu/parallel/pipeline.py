@@ -26,6 +26,14 @@ therefore cannot diverge across their group and `skip_inactive`/1F1B
 branching is deadlock-free with them.  A collective spanning `pipe`
 inside a stage remains unsupported (members would sit in different
 branches).
+
+GRADIENT correctness with in-stage collectives is a separate property:
+only `pipeline_train_1f1b` provides it (it runs under vma checking,
+which transposes collectives correctly).  `pipeline_apply` runs
+check_vma=False, where `jax.grad` THROUGH a psum-bearing stage scales
+gradients by the axis size — use it for forward/inference composition
+and collective-free training stages; TRAIN PP×TP pipelines with
+`pipeline_train_1f1b`.
 """
 from __future__ import annotations
 
@@ -120,6 +128,11 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
 
     all_stage_params: pytree whose leaves have leading dim = n_stages.
     x: (B, ...) global batch.
+
+    NOTE: runs check_vma=False — `jax.grad` through a stage containing
+    a psum over another mesh axis mis-scales gradients by that axis
+    size (module docstring).  For PP×TP TRAINING use
+    `pipeline_train_1f1b`.
     """
     from jax import shard_map
 

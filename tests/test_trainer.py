@@ -250,16 +250,19 @@ def test_full_step_throttles_runahead_without_keep_grads():
     net.initialize()
     net.hybridize()
     loss_fn = mx.gluon.loss.L2Loss()
+    # byte-budgeted: with a tiny budget the queue must drain; the sync
+    # is skipped entirely only when held bytes are small vs budget
     tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01},
-                 keep_grads=False, max_inflight_steps=2)
+                 keep_grads=False, max_inflight_bytes=64)
     x = NDArray(onp.random.RandomState(0).randn(4, 8).astype("float32"))
     y = NDArray(onp.zeros((4, 8), "float32"))
     for _ in range(10):
         with autograd.record():
-            L = loss_fn(net(x), y).mean()
+            L = loss_fn(net(x), y)  # canonical: no .mean() — chains
         L.backward()
-        tr.step(1)
-    assert len(tr._inflight) <= tr._max_inflight + 1
+        tr.step(4)
+    assert tr._fullstep_ctx is not None, "full-step path must engage"
+    assert len(tr._inflight) <= 2  # depth=2 at this budget
 
 
 def test_loss_hybridize_opt_out_allows_python_control_flow():
