@@ -357,6 +357,14 @@ class Trainer:
         depth = max(2, self._max_inflight_bytes // max(int(held_bytes), 1))
         if self._user_inflight_cap is not None:
             depth = min(depth, self._user_inflight_cap)
+        if self._user_inflight_cap is None \
+                and int(held_bytes) * 4096 <= self._max_inflight_bytes:
+            # truly-tiny outputs: even absurd run-ahead (4096 steps)
+            # fits the budget — never sync, just stop the ref queue
+            # growing (a dropped reference frees the retired scalar)
+            if len(self._inflight) > 64:
+                self._inflight.popleft()
+            return
         if len(self._inflight) >= depth:
             last = None
             while len(self._inflight) > depth // 2:
@@ -365,11 +373,6 @@ class Trainer:
                 jax.block_until_ready(last)
             except Exception:
                 pass
-        elif len(self._inflight) > 64:
-            # no-sync regime: dropping the reference is free and stops
-            # the queue (and its device scalars) growing for the run's
-            # lifetime — the execution is long retired by 64 steps
-            self._inflight.popleft()
 
     def _fused_step(self):
         opt = self._optimizer

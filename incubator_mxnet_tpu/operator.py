@@ -6,7 +6,8 @@ ops callable from compiled graphs.  On TPU the GIL-managed engine
 callback becomes `jax.pure_callback` — the op's NumPy `forward` runs
 host-side even inside `jax.jit`, and a custom VJP routes cotangents
 through the op's `backward`.  The reference's `MXLoadLib` native-plugin
-ABI maps to XLA custom_call and is out of scope (documented).
+ABI is implemented in `mx.library` (XLA FFI custom_call shared
+libraries — `library.load()`, `native/plugin_example.cc`).
 
 API parity:
     @mx.operator.register("my_op")

@@ -284,3 +284,28 @@ def test_loss_hybridize_opt_out_allows_python_control_flow():
     l = NDArray(onp.zeros((2, 2), "float32"))
     out = loss_fn(p, l)
     assert abs(float(out.asnumpy()) - 6.0) < 1e-5
+
+
+def test_explicit_inflight_step_cap_honored():
+    """Trainer(max_inflight_steps=N) must cap the one-program path's
+    run-ahead even when the byte budget would allow more."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    mx.random.seed(0)
+    net = nn.Dense(8, in_units=8)
+    net.initialize()
+    net.hybridize()
+    loss_fn = mx.gluon.loss.L2Loss()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01},
+                 keep_grads=False, max_inflight_steps=3)
+    x = NDArray(onp.random.RandomState(0).randn(4, 8).astype("float32"))
+    y = NDArray(onp.zeros((4, 8), "float32"))
+    for _ in range(10):
+        with autograd.record():
+            L = loss_fn(net(x), y)
+        L.backward()
+        tr.step(4)
+    assert tr._fullstep_ctx is not None, "full-step path must engage"
+    assert len(tr._inflight) <= 3
