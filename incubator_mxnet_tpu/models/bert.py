@@ -93,11 +93,17 @@ class PositionwiseFFN(HybridBlock):
 
 
 class BERTLayer(HybridBlock):
-    """Post-LN transformer encoder layer (BERT convention)."""
+    """Post-LN transformer encoder layer (BERT convention).
 
-    def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+    use_flash=False selects the XLA attention path — required for ONNX
+    export and for vma-checked shard_map contexts (1F1B pipeline
+    stages), where pallas_call has no mapping."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1,
+                 use_flash=True, **kwargs):
         super().__init__(**kwargs)
-        self.attention = MultiHeadAttention(units, num_heads, dropout)
+        self.attention = MultiHeadAttention(units, num_heads, dropout,
+                                            use_flash=use_flash)
         self.ln1 = nn.LayerNorm(in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout)
         self.ln2 = nn.LayerNorm(in_channels=units)
@@ -112,11 +118,13 @@ class BERTLayer(HybridBlock):
 
 
 class BERTEncoder(HybridBlock):
-    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.1,
+                 use_flash=True, **kwargs):
         super().__init__(**kwargs)
         self._layers = []
         for i in range(num_layers):
-            layer = BERTLayer(units, hidden_size, num_heads, dropout)
+            layer = BERTLayer(units, hidden_size, num_heads, dropout,
+                              use_flash=use_flash)
             setattr(self, f"layer{i}", layer)
             self._layers.append(layer)
 
@@ -129,7 +137,7 @@ class BERTEncoder(HybridBlock):
 class BERTModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512, type_vocab_size=2,
-                 dropout=0.1, **kwargs):
+                 dropout=0.1, use_flash=True, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self.word_embed = nn.Embedding(vocab_size, units)
@@ -137,7 +145,8 @@ class BERTModel(HybridBlock):
         self.position_embed = nn.Embedding(max_length, units)
         self.embed_ln = nn.LayerNorm(in_channels=units)
         self.embed_drop = nn.Dropout(dropout)
-        self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads, dropout)
+        self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
+                                   dropout, use_flash=use_flash)
         self.pooler = nn.Dense(units, activation="tanh", flatten=False, in_units=units)
 
     def forward(self, inputs, token_types=None, valid_length=None):

@@ -161,7 +161,8 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
 # true 1F1B (PipeDream-flush) schedule
 # --------------------------------------------------------------------- #
 def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
-                 n_static, recompute_stage=True):
+                 n_static, recompute_stage=True, loss_params=(),
+                 want_dx=False):
     """One device's 1F1B train step (inside shard_map over `axis_name`).
 
     Tick times (n stages, idx = this stage, m = microbatch):
@@ -181,8 +182,14 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
     recompute_stage=False: full residuals are buffered — standard
     fwd+bwd FLOP budget, O(n·residuals) memory.
 
-    Returns (sum of per-microbatch losses on the last stage, summed
-    param grads for this stage).
+    loss_params: optional replicated pytree of TRAINABLE loss-side
+    parameters (e.g. an LM head applied inside loss_fn(y, t, lp)) —
+    their summed grads are returned alongside the stage grads, enabling
+    full-model pipelines where embedding/head live outside the stages.
+
+    Returns (loss_sum on the last stage, stage param grads,
+    loss_params grads, per-microbatch input cotangents dx (M, mb, ...)
+    valid on stage 0).
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -219,6 +226,14 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
         act_vma = new_vma
     xm = cast_to(xm, act_vma)
     targets = cast_to(targets, act_vma)
+    # loss params must be VARYING over the pipe axis before use inside
+    # the loop: an unvarying operand's cotangent would trigger an
+    # automatic psum over `pipe` INSIDE the cond branches — exactly the
+    # forbidden pipe-spanning collective.  Promote here; the cross-stage
+    # reduction happens outside the loop (caller's psum of the masked
+    # accumulator).
+    loss_params = jax.tree_util.tree_map(
+        lambda p: cast_to(p, act_vma), loss_params)
 
     if recompute_stage:
         # buffer only the stage inputs; bwd re-derives residuals
@@ -236,12 +251,20 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
     dacc0 = jax.tree_util.tree_map(
         lambda p: cast_to(jnp.zeros(p.shape, jnp.float32),
                           _vma(p) | {axis_name}), params)
+    dlp0 = jax.tree_util.tree_map(
+        lambda p: cast_to(jnp.zeros(p.shape, jnp.float32), act_vma),
+        loss_params)
+    # dx collection costs a full-batch buffer + a pipe psum — only pay
+    # for it when the caller asked (want_dx)
+    dx_buf0 = cast_to(jnp.zeros((M,) + mb_shape if want_dx else (1,),
+                                jnp.float32), act_vma)
 
     def pv(z):  # activations/scalars promote to the ring vma
         return cast_to(z, act_vma)
 
     def tick(t, carry):
-        ring_f, ring_b, res_buf, y_buf, dacc, loss_sum = carry
+        (ring_f, ring_b, res_buf, y_buf, dacc, dlp, dx_buf,
+         loss_sum) = carry
         tf = t - idx
         m_f = tf // 2
         do_f = jnp.logical_and(jnp.logical_and(tf >= 0, tf % 2 == 0), m_f < M)
@@ -277,7 +300,7 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
                                          (ring_f, res_buf, y_buf))
 
         def bwd_branch(op):
-            ring_b, dacc, loss_sum = op
+            ring_b, dacc, dlp, dx_buf, loss_sum = op
             mclip = jnp.clip(m_b, 0, M - 1)
             slot = mclip % n
             leaves = [lax.dynamic_index_in_dim(b, slot, 0, keepdims=False)
@@ -288,47 +311,73 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
                 pull = jax.tree_util.tree_unflatten(res_treedef, leaves)
                 y_m = lax.dynamic_index_in_dim(y_buf, slot, 0, keepdims=False)
             tgt = targets[mclip]
-            l_m, pl = jax.vjp(lambda yy: loss_fn(yy, tgt), y_m)
-            (dy_loss,) = pl(jnp.ones_like(l_m))
+            l_m, pl = jax.vjp(lambda yy, lp: loss_fn(yy, tgt, lp),
+                              y_m, loss_params)
+            dy_loss, dlp_m = pl(jnp.ones_like(l_m))
             is_last = idx == n - 1
             cot = jnp.where(is_last, pv(dy_loss).astype(dt), ring_b)
             loss_sum = loss_sum + jnp.where(is_last,
                                             pv(l_m).astype(jnp.float32), 0.0)
+            dlp = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(is_last,
+                                           pv(g).astype(jnp.float32), 0.0),
+                dlp, dlp_m)
             dparams_m, dx_m = pull(cot)
             dacc = jax.tree_util.tree_map(
                 lambda a, g: a + pv(g).astype(jnp.float32), dacc, dparams_m)
-            return pv(dx_m).astype(dt), dacc, loss_sum
+            # stage 0's dx is the pipeline-input cotangent for microbatch
+            # m — recorded here, masked to stage 0 by the final psum
+            if want_dx:
+                dx_buf = lax.dynamic_update_index_in_dim(
+                    dx_buf, pv(dx_m).astype(jnp.float32), mclip, 0)
+            return pv(dx_m).astype(dt), dacc, dlp, dx_buf, loss_sum
 
         def bwd_skip(op):
-            ring_b, dacc, loss_sum = op
-            return pv(jnp.zeros(mb_shape, dt)), dacc, loss_sum
+            ring_b, dacc, dlp, dx_buf, loss_sum = op
+            return (pv(jnp.zeros(mb_shape, dt)), dacc, dlp, dx_buf,
+                    loss_sum)
 
-        dx_out, dacc, loss_sum = lax.cond(do_b, bwd_branch, bwd_skip,
-                                          (ring_b, dacc, loss_sum))
+        dx_out, dacc, dlp, dx_buf, loss_sum = lax.cond(
+            do_b, bwd_branch, bwd_skip,
+            (ring_b, dacc, dlp, dx_buf, loss_sum))
 
         ring_f = lax.ppermute(y_out, axis_name, fwd_perm)
         ring_b = lax.ppermute(dx_out, axis_name, bwd_perm)
-        return ring_f, ring_b, res_buf, y_buf, dacc, loss_sum
+        return (ring_f, ring_b, res_buf, y_buf, dacc, dlp, dx_buf,
+                loss_sum)
 
     carry0 = (pv(jnp.zeros(mb_shape, dt)), pv(jnp.zeros(mb_shape, dt)),
-              res_buf0, y_buf0, dacc0, pv(jnp.float32(0)))
-    *_rest, dacc, loss_sum = lax.fori_loop(0, total, tick, carry0)
-    return loss_sum, dacc
+              res_buf0, y_buf0, dacc0, dlp0, dx_buf0, pv(jnp.float32(0)))
+    out = lax.fori_loop(0, total, tick, carry0)
+    _, _, _, _, dacc, dlp, dx_buf, loss_sum = out
+    # mask the dx rows to stage 0's contributions (other stages wrote
+    # their own dx_m into their local buffer)
+    if want_dx:
+        dx_buf = dx_buf * (idx == 0).astype(jnp.float32)
+    return loss_sum, dacc, dlp, dx_buf
 
 
 def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
                         all_stage_params, x, targets, mesh: Mesh,
                         num_microbatches: int, axis_name: str = "pipe",
-                        recompute_stage: bool = True):
+                        recompute_stage: bool = True,
+                        loss_params=None, return_dx: bool = False):
     """True 1F1B pipeline train step.
 
     stage_fn(params, x) -> y (uniform activation shape across stages;
     in-stage collectives over non-`pipe` axes are allowed — see module
     docstring).  loss_fn(y, target) -> scalar per microbatch, evaluated
-    on the LAST stage.
+    on the LAST stage — or loss_fn(y, target, loss_params) when
+    ``loss_params`` is given (trainable head/readout living OUTSIDE the
+    stages; its grads are returned too).
 
-    Returns ``(mean_loss, grads)`` where grads has the stages' leading
-    dim (like all_stage_params) and corresponds to the MEAN
+    return_dx: also return the cotangent w.r.t. the pipeline INPUT
+    (B, ...) — this is what lets an embedding (or any front-end) live
+    outside the pipeline and still train: run it forward eagerly, feed
+    its output here, then apply its vjp to the returned dx.
+
+    Returns ``(mean_loss, grads[, dloss_params][, dx])`` — grads has
+    the stages' leading dim; all gradients correspond to the MEAN
     per-microbatch loss.
     """
     from jax import shard_map
@@ -344,16 +393,31 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
     tm = targets.reshape((M, mb) + targets.shape[1:])
     n_static = mesh.shape[axis_name]
 
-    def inner(params_stacked, xmb, tmb):
+    lp = () if loss_params is None else loss_params
+    lf = (lambda y, t, _lp: loss_fn(y, t)) if loss_params is None \
+        else loss_fn
+
+    def _deflate(v):
+        # reduce to an unvarying (out_specs P()) value: psum over pipe,
+        # pmean over any leftover TP axes (values replicated there)
+        v = lax.psum(v, axis_name)
+        for ax in sorted(set(getattr(jax.typeof(v), "vma", ()))):
+            v = lax.pmean(v, ax)
+        return v
+
+    def inner(params_stacked, xmb, tmb, lp_in):
         params = jax.tree_util.tree_map(lambda p: p[0], params_stacked)
-        loss_sum, dacc = _1f1b_device(stage_fn, loss_fn, params, xmb, tmb,
-                                      axis_name, n_static,
-                                      recompute_stage=recompute_stage)
-        loss = lax.psum(loss_sum, axis_name) / M  # only last stage non-zero
-        for ax in sorted(set(getattr(jax.typeof(loss), "vma", ()))):
-            loss = lax.pmean(loss, ax)  # value replicated on TP axes
+        loss_sum, dacc, dlp, dx_buf = _1f1b_device(
+            stage_fn, lf, params, xmb, tmb, axis_name, n_static,
+            recompute_stage=recompute_stage, loss_params=lp_in,
+            want_dx=return_dx)
+        loss = _deflate(loss_sum) / M  # only last stage non-zero
         grads = jax.tree_util.tree_map(lambda g: (g / M)[None], dacc)
-        return loss, grads
+        dlp = jax.tree_util.tree_map(lambda g: _deflate(g) / M, dlp)
+        # want_dx=False leaves a (1,) dummy — deflating it is free and
+        # keeps the out_specs P() replication provable
+        dx = _deflate(dx_buf) / M
+        return loss, grads, dlp, dx
 
     param_spec = jax.tree_util.tree_map(lambda _: P(axis_name),
                                         all_stage_params)
@@ -361,7 +425,14 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
     # (see _1f1b_device); TP'd stages compose by calling _1f1b_device
     # under your own shard_map with pipe×model in_specs — the PP×TP test
     # shows the pattern.
+    lp_spec = jax.tree_util.tree_map(lambda _: P(), lp)
     fn = shard_map(inner, mesh=mesh,
-                   in_specs=(param_spec, P(), P()),
-                   out_specs=(P(), param_spec))
-    return fn(all_stage_params, xm, tm)
+                   in_specs=(param_spec, P(), P(), lp_spec),
+                   out_specs=(P(), param_spec, lp_spec, P()))
+    loss, grads, dlp, dx = fn(all_stage_params, xm, tm, lp)
+    out = (loss, grads)
+    if loss_params is not None:
+        out += (dlp,)
+    if return_dx:
+        out += (dx.reshape((B,) + x.shape[1:]),)
+    return out if len(out) > 2 else (loss, grads)

@@ -311,8 +311,9 @@ def test_pipeline_1f1b_composes_with_tp_collectives():
     def run(W1, W2):
         def inner(w1s, w2s, xmb, tmb):
             params = (w1s[0, 0], w2s[0, 0])
-            loss_sum, dacc = pp._1f1b_device(stage_tp, loss_fn, params,
-                                             xmb, tmb, "pipe", n)
+            loss_sum, dacc, _dlp, _dx = pp._1f1b_device(
+                stage_tp, lambda y, t, _lp: loss_fn(y, t), params,
+                xmb, tmb, "pipe", n)
             loss = lax.psum(loss_sum, "pipe") / M
             import jax as _jax
             for ax in sorted(set(getattr(_jax.typeof(loss), "vma", ()))):
@@ -415,3 +416,86 @@ def test_pipeline_1f1b_residual_mode_matches_recompute():
                                     recompute_stage=False)
     onp.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
     onp.testing.assert_allclose(onp.asarray(g1), onp.asarray(g2), rtol=1e-5)
+
+
+def test_gluon_bert_layers_train_through_1f1b_pipeline():
+    """THE Gluon→PP bridge (r2 VERDICT stretch): real Gluon BERTLayer
+    blocks are the pipeline stages (params extracted via functionalize),
+    the word embedding lives OUTSIDE the pipeline and trains through the
+    returned input cotangent, the LM head trains via loss_params.  Full
+    gradient parity (embedding + every stage + head) vs the sequential
+    oracle."""
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.gluon.block import functionalize
+    from incubator_mxnet_tpu.models import bert
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.parallel import create_mesh, pipeline as pp
+
+    n, M, mb, D, V, T = 2, 4, 2, 16, 32, 8
+    B = M * mb
+    mesh = create_mesh(jax.devices()[:n], pipe=n)
+    mx.random.seed(0)
+    layers = []
+    for _ in range(n):
+        layer = bert.BERTLayer(units=D, hidden_size=2 * D, num_heads=2,
+                               dropout=0.0, use_flash=False)
+        layer.initialize()
+        layers.append(layer)
+    x_dummy = NDArray(jnp.ones((mb, T, D), jnp.float32))
+    fns, raws = [], []
+    for layer in layers:
+        f, tr, aux = functionalize(layer, x_dummy)
+        assert not aux
+        fns.append(f)
+        raws.append(tr)
+    # identical architectures: layer 0's pure fn + layer i's raws ≡ layer i
+    stacked = tuple(jnp.stack([raws[i][j] for i in range(n)])
+                    for j in range(len(raws[0])))
+    rng = jax.random.PRNGKey(0)
+    apply0 = fns[0]
+
+    def stage_fn(params, a):
+        out, _ = apply0(params, (), rng, a, training=False)
+        return out
+
+    k = jax.random.PRNGKey(5)
+    embW = jax.random.normal(k, (V, D)) * 0.5
+    headW = jax.random.normal(jax.random.fold_in(k, 1), (D, V)) * 0.5
+    tokens = jax.random.randint(jax.random.fold_in(k, 2), (B, T), 0, V)
+    tgt = jax.random.randint(jax.random.fold_in(k, 3), (B, T), 0, V)
+
+    def loss_fn(y, t, headw):
+        logp = jax.nn.log_softmax(y @ headw)
+        return -jnp.mean(jnp.take_along_axis(logp, t[..., None], -1))
+
+    xemb = embW[tokens]  # embedding fwd OUTSIDE the pipeline
+    loss, grads, dhead, dx = pp.pipeline_train_1f1b(
+        stage_fn, loss_fn, stacked, xemb, tgt, mesh, M,
+        loss_params=headW, return_dx=True)
+    # embedding vjp applied to the returned input cotangent
+    demb = jnp.zeros_like(embW).at[tokens.reshape(-1)].add(
+        dx.reshape(-1, D))
+
+    def oracle(embW, stacked, headW):
+        a = embW[tokens]
+        tot = 0.0
+        for m in range(M):
+            h = a[m * mb:(m + 1) * mb]
+            for i in range(n):
+                h = stage_fn(tuple(s[i] for s in stacked), h)
+            tot = tot + loss_fn(h, tgt[m * mb:(m + 1) * mb], headW)
+        return tot / M
+
+    want_loss = oracle(embW, stacked, headW)
+    want_demb, want_dstages, want_dhead = jax.grad(
+        oracle, argnums=(0, 1, 2))(embW, stacked, headW)
+    onp.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(dhead), onp.asarray(want_dhead),
+                                rtol=1e-4, atol=1e-6)
+    onp.testing.assert_allclose(onp.asarray(demb), onp.asarray(want_demb),
+                                rtol=1e-4, atol=1e-6)
+    for g, w in zip(grads, want_dstages):
+        onp.testing.assert_allclose(onp.asarray(g), onp.asarray(w),
+                                    rtol=1e-4, atol=1e-6)
