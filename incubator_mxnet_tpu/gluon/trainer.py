@@ -157,8 +157,14 @@ class Trainer:
         """Place uncommitted/unsharded batch inputs on the data axis.
 
         Inputs the user already NamedSharded (seq-parallel splits, ...)
-        are left untouched; anything fresh from host whose leading dim
-        divides the data axis gets P(data, None, ...)."""
+        are left untouched.  Auto-placement applies ONLY to inputs whose
+        leading dim equals the batch size (the leading dim of the FIRST
+        array input, sharded or not — MXNet's data-first convention): lookup
+        tables or (T, ...)-layout masks whose leading dim merely happens
+        to divide the data axis are NOT batch-sharded, which would make
+        GSPMD insert a reshard collective every step.  Pre-shard such
+        inputs yourself (jax.device_put with a NamedSharding) to opt in
+        to any other layout."""
         mesh = self._get_mesh()
         if mesh is None or self._data_axis not in mesh.axis_names:
             return input_raws
@@ -167,11 +173,30 @@ class Trainer:
         n = mesh.shape[self._data_axis]
         if n <= 1:
             return input_raws
+        batch = None  # leading dim of the first array input (data-first)
+        for r in input_raws:
+            if hasattr(r, "shape") and r.ndim >= 1:
+                batch = r.shape[0]
+                break
+        if batch is None or batch % n != 0:
+            if batch is not None \
+                    and not getattr(self, "_warned_noshard", False):
+                import warnings
+
+                self._warned_noshard = True
+                warnings.warn(
+                    f"Trainer: first input's leading dim {batch} is not "
+                    f"divisible by the data axis ({n}) — auto data-"
+                    f"sharding of inputs is OFF for this step shape. If "
+                    f"the first argument is not the batch (data-first "
+                    f"convention), pre-shard inputs with jax.device_put.",
+                    stacklevel=3)
+            return input_raws
         out = []
         for r in input_raws:
             sh = getattr(r, "sharding", None)
             if (not isinstance(sh, NamedSharding) and hasattr(r, "shape")
-                    and r.ndim >= 1 and r.shape[0] % n == 0):
+                    and r.ndim >= 1 and r.shape[0] == batch):
                 spec = P(self._data_axis, *([None] * (r.ndim - 1)))
                 r = jax.device_put(r, NamedSharding(mesh, spec))
             out.append(r)
@@ -233,6 +258,21 @@ class Trainer:
             return False  # custom optimizer without a pure rule
         return True
 
+    def _iter_active_param_raws(self):
+        """Raw arrays of every committed, grad-carrying param (the set
+        both the SPMD-readiness probes and the kvstore bypass agree on)."""
+        for p in self._params:
+            if p.grad_req == "null" or p._data_nd is None \
+                    or p._data_nd._lazy is not None:
+                continue
+            yield p._data_nd._raw
+
+    def _has_global_params(self) -> bool:
+        """Any managed param placed as a multi-process global array."""
+        return any(
+            hasattr(r, "is_fully_addressable") and not r.is_fully_addressable
+            for r in self._iter_active_param_raws())
+
     def _dist_spmd_ready(self) -> bool:
         """True iff the training state is multi-process global: EVERY
         managed param's array spans beyond this process's devices (the
@@ -241,11 +281,7 @@ class Trainer:
         the local params' grads would silently skip the cross-process
         reduction — and warns once."""
         n_global = n_local = 0
-        for p in self._params:
-            if p.grad_req == "null" or p._data_nd is None \
-                    or p._data_nd._lazy is not None:
-                continue
-            r = p._data_nd._raw
+        for r in self._iter_active_param_raws():
             if hasattr(r, "is_fully_addressable") and not r.is_fully_addressable:
                 n_global += 1
             else:
@@ -256,9 +292,10 @@ class Trainer:
             self._warned_mixed = True
             warnings.warn(
                 f"Trainer: {n_global} params are multi-process global but "
-                f"{n_local} are process-local — falling back to the per-key "
-                f"kvstore reduction. Apply shard_params to the WHOLE block "
-                f"for the fused SPMD dist step.", stacklevel=3)
+                f"{n_local} are process-local — no reduction path serves "
+                f"both (step() refuses this state when a kvstore is "
+                f"attached). Apply shard_params to the WHOLE block.",
+                stacklevel=3)
         return n_global > 0 and n_local == 0
 
     def _can_fuse_packed_compression(self) -> bool:
@@ -274,6 +311,13 @@ class Trainer:
         if not (kv._is_dist and jax.process_count() > 1):
             return False  # single-process: per-key path is cheap, keep
             # the kvstore-store-visible semantics
+        # Global (GSPMD-placed) params are already cross-process reduced
+        # inside the SPMD step — packing and summing one decompressed
+        # copy per process would scale grads by process_count (or fail
+        # on non-addressable arrays).  step() skips the kvstore exchange
+        # entirely for global state (see the bypass there).
+        if self._has_global_params():
+            return False
         return type(self._optimizer).pure_update \
             is not opt_mod.Optimizer.pure_update
 
@@ -639,6 +683,41 @@ class Trainer:
 
     def _allreduce_grads(self):
         if self._kvstore is None:
+            return
+        if self._has_global_params():
+            # Grads of global (shard_params) arrays are already reduced
+            # in-step by GSPMD; the per-key kvstore exchange would crash
+            # on non-addressable arrays (and double-reduce otherwise) —
+            # skip it.  Guarded HERE (not in step()) so the public
+            # gradient-accumulation pattern allreduce_grads()+update()
+            # gets the same protection.
+            if not self._dist_spmd_ready():
+                # mixed global/local: the local params' grads DO need
+                # the kvstore exchange, which global arrays cannot ride
+                # — refuse loudly rather than silently diverge replicas
+                raise RuntimeError(
+                    "Trainer: params are a MIX of multi-process "
+                    "global (shard_params) and process-local arrays — "
+                    "global grads reduce in-step but local ones need "
+                    "the kvstore exchange, and no single path serves "
+                    "both. Apply shard_params to the WHOLE block.")
+            skipped = [
+                s for s, active in (
+                    ("gradient compression",
+                     self._kvstore._compression is not None),
+                    ("the kvstore server-side optimizer (set_optimizer)",
+                     self._kvstore._updater is not None),
+                ) if active]
+            if skipped and not getattr(self, "_warned_global_nocomp", False):
+                import warnings
+
+                self._warned_global_nocomp = True
+                warnings.warn(
+                    f"Trainer: {' and '.join(skipped)} inactive for "
+                    "multi-process global (shard_params) arrays — the "
+                    "reduction happens inside the SPMD step and the "
+                    "Trainer's own optimizer applies the update.",
+                    stacklevel=2)
             return
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data_nd is not None:

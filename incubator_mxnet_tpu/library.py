@@ -28,6 +28,10 @@ from typing import Dict, List, Optional
 __all__ = ["load", "loaded_ops", "build_example_plugin"]
 
 _LOADED: Dict[str, object] = {}
+# op name -> abspath of the plugin that registered it; a second plugin
+# exporting the same op name raises instead of silently overwriting the
+# first registration (same-library reload stays idempotent)
+_OP_SOURCE: Dict[str, str] = {}
 
 
 def loaded_ops() -> List[str]:
@@ -74,14 +78,28 @@ def load(path: str, verbose: bool = True):
         lib.mxtpu_plugin_op_grad_of.argtypes = [ctypes.c_int]
 
     n = lib.mxtpu_plugin_op_count()
+    # FFI targets are namespaced by a hash of the library path so two
+    # plugins exporting the same op name cannot silently alias each
+    # other's custom_call registration (advisor r3)
+    import hashlib
+
+    libpath = os.path.realpath(path)  # symlink-stable identity
+    libtag = hashlib.sha1(libpath.encode()).hexdigest()[:8]
+    # validate ALL names before registering ANY target, so a conflicting
+    # plugin leaves the FFI registry untouched (atomic load)
+    names = [lib.mxtpu_plugin_op_name(i).decode() for i in range(n)]
+    for name in names:
+        if _OP_SOURCE.get(name, libpath) != libpath:
+            raise ValueError(
+                f"library.load: op '{name}' already registered by "
+                f"{_OP_SOURCE[name]}; refusing to overwrite from {path}")
     entries = []
-    for i in range(n):
-        name = lib.mxtpu_plugin_op_name(i).decode()
+    for i, name in enumerate(names):
         grad_of = None
         if has_grad_of:
             g = lib.mxtpu_plugin_op_grad_of(i)
             grad_of = g.decode() if g else None
-        target = f"mxtpu_plugin_{name}"
+        target = f"mxtpu_plugin_{libtag}_{name}"
         jax.ffi.register_ffi_target(target, _capsule(lib.mxtpu_plugin_op_handler(i)),
                                     platform="cpu")
         entries.append((name, grad_of, target))
@@ -94,12 +112,13 @@ def load(path: str, verbose: bool = True):
         fn = _make_op(name, target, grads.get(name))
         setattr(nd_mod, name, fn)
         _LOADED[name] = fn
+        _OP_SOURCE[name] = libpath
         installed.append(name)
         if verbose:
             print(f"library.load: registered op mx.nd.{name}"
                   + (" (+custom grad)" if grads.get(name) else ""))
     # keep the CDLL alive (registered pointers reference its code)
-    _LOADED[f"__lib__{os.path.abspath(path)}"] = lib
+    _LOADED[f"__lib__{libpath}"] = lib
     return installed
 
 

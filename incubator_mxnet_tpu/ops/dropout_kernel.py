@@ -35,8 +35,11 @@ def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate):
     from jax.experimental.pallas import tpu as pltpu
 
     # distinct stream per grid program: same (seed, pid) in fwd and bwd
-    # regenerates the identical mask
-    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    # regenerates the identical mask.  Seeded with TWO words — layer
+    # seeds that differ by less than the grid size would otherwise draw
+    # identical bits on overlapping tiles (correlated masks across
+    # layers).
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
     # raw bits come back int32 — bitcast before the unsigned compare
     bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
     # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)

@@ -379,6 +379,14 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
     Returns ``(mean_loss, grads[, dloss_params][, dx])`` — grads has
     the stages' leading dim; all gradients correspond to the MEAN
     per-microbatch loss.
+
+    Memory note: ``x`` and ``targets`` enter the shard_map replicated
+    (in_specs P()) — every pipe device holds the full global batch even
+    though only stage 0 consumes x and the last stage consumes targets.
+    Activations stay O(n_stages)-bounded, but for very large inputs the
+    replicated batch itself can dominate per-device memory; feed the
+    pipeline microbatch-by-microbatch (or pre-shard x along a data axis
+    composed with pipe) if that bites.
     """
     from jax import shard_map
 
