@@ -1,0 +1,191 @@
+"""ResNet-50 train-step ablation (real TPU, scan-chained timing).
+
+The r4 conv probe showed XLA's conv emitter runs at 90-128 TF/s fwd+bwd
+on every ResNet-50 layer shape when measured without dispatch/compile
+artifacts — so the ~31%-MFU train step is NOT conv-emitter-bound and
+the r3 profile's conclusion was a timing artifact.  This script finds
+where the step time actually goes by toggling components of a
+hand-rolled ResNet-50:
+
+    python benchmark/resnet_ablate.py full nobn norelu nomom nhwc ...
+
+Variants: full (NCHW, BN, relu, momentum+fp32 masters)
+          nhwc      same but NHWC layout end-to-end
+          nobn      BatchNorm replaced by per-channel scale/shift (no
+                    batch stats — isolates the reduction cost)
+          norelu    no activations
+          nomom     plain SGD, no momentum, no fp32 masters
+          convonly  convs + residual adds only
+All variants: BS128 bf16, 8 steps chained in one jit via lax.scan.
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B = int(os.environ.get("ABLATE_BS", "128"))
+K = 8
+REPS = 3
+
+# ResNet-50: stages (blocks, mid_channels, out_channels, stride)
+STAGES = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+          (3, 512, 2048, 2)]
+
+
+def conv(x, w, stride, pad, nhwc):
+    dn = ("NHWC", "HWIO", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=[(pad, pad)] * 2,
+        dimension_numbers=dn)
+
+
+def bn(x, gamma, beta, nhwc, use_bn):
+    caxes = (0, 1, 2) if nhwc else (0, 2, 3)
+    shape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+    if not use_bn:
+        return x * gamma.reshape(shape).astype(x.dtype) \
+            + beta.reshape(shape).astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, caxes)
+    var = jnp.mean(jnp.square(xf), caxes) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + 1e-5) * gamma
+    out = xf * inv.reshape(shape) + (beta - mean * inv).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def init_params(nhwc, key):
+    """(convs, gammas, betas) for the full net."""
+    ks = iter(jax.random.split(key, 200))
+
+    def cw(kh, kw, ci, co):
+        w = jax.random.normal(next(ks), (co, ci, kh, kw), jnp.bfloat16) * 0.05
+        return jnp.transpose(w, (2, 3, 1, 0)) if nhwc else w
+
+    convs, gammas, betas = [], [], []
+
+    def add_bn(c):
+        gammas.append(jnp.ones((c,), jnp.float32))
+        betas.append(jnp.zeros((c,), jnp.float32))
+
+    convs.append(cw(7, 7, 3, 64)); add_bn(64)
+    cin = 64
+    for (blocks, mid, cout, stride) in STAGES:
+        for b in range(blocks):
+            s = stride if b == 0 else 1
+            convs.append(cw(1, 1, cin, mid)); add_bn(mid)
+            convs.append(cw(3, 3, mid, mid)); add_bn(mid)
+            convs.append(cw(1, 1, mid, cout)); add_bn(cout)
+            if b == 0:
+                convs.append(cw(1, 1, cin, cout)); add_bn(cout)  # downsample
+            cin = cout
+    return convs, gammas, betas
+
+
+def forward(convs, gammas, betas, x, nhwc, use_bn, use_relu):
+    it = iter(range(len(convs)))
+
+    def cbr(x, stride, pad, relu=True):
+        i = next(it)
+        y = conv(x, convs[i], stride, pad, nhwc)
+        y = bn(y, gammas[i], betas[i], nhwc, use_bn)
+        if use_relu and relu:
+            y = jax.nn.relu(y)
+        return y
+
+    y = cbr(x, 2, 3)
+    # 3x3 s2 maxpool
+    if nhwc:
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)])
+    else:
+        y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 1, 3, 3),
+                              (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)])
+    for (blocks, mid, cout, stride) in STAGES:
+        for b in range(blocks):
+            s = stride if b == 0 else 1
+            r = cbr(y, s, 0)          # 1x1 (stride, mxnet v1 style)
+            r = cbr(r, 1, 1)          # 3x3
+            r = cbr(r, 1, 0, relu=False)  # 1x1 expand
+            sc = cbr(y, s, 0, relu=False) if b == 0 else y  # downsample
+            y = r + sc
+            if use_relu:
+                y = jax.nn.relu(y)
+    y = jnp.mean(y.astype(jnp.float32), (1, 2) if nhwc else (2, 3))
+    return y  # (B, 2048) pooled features; head below
+
+
+def build_step(nhwc, use_bn, use_relu, momentum, head_w):
+    def loss_of(convs, gammas, betas, x, y_lab):
+        feats = forward(convs, gammas, betas, x, nhwc, use_bn, use_relu)
+        logits = feats @ head_w
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y_lab[:, None], 1))
+
+    def step(carry, _):
+        params, moms, x, y_lab = carry
+        (convs_m, gammas, betas) = params
+        convs = tuple(w.astype(jnp.bfloat16) for w in convs_m)
+        L, grads = jax.value_and_grad(
+            lambda c: loss_of(c, gammas, betas, x, y_lab))(convs)
+        if momentum:
+            nmoms = tuple(0.9 * v + g.astype(jnp.float32)
+                          for v, g in zip(moms, grads))
+            nconvs = tuple(m - 0.1 * v for m, v in zip(convs_m, nmoms))
+        else:
+            nmoms = moms
+            nconvs = tuple(m - 0.1 * g.astype(m.dtype)
+                           for m, g in zip(convs_m, grads))
+        return ((nconvs, gammas, betas), nmoms, x, y_lab), L
+
+    return step
+
+
+def run_variant(name):
+    nhwc = name in ("nhwc",)
+    use_bn = name not in ("nobn", "convonly")
+    use_relu = name not in ("norelu", "convonly")
+    momentum = name not in ("nomom",)
+    key = jax.random.PRNGKey(0)
+    convs, gammas, betas = init_params(nhwc, key)
+    convs_m = tuple(w.astype(jnp.float32) for w in convs)
+    moms = tuple(jnp.zeros_like(m) for m in convs_m)
+    head_w = jax.random.normal(key, (2048, 1000), jnp.float32) * 0.01
+    shape = (B, 224, 224, 3) if nhwc else (B, 3, 224, 224)
+    x = jnp.ones(shape, jnp.bfloat16)
+    y_lab = jnp.zeros((B,), jnp.int32)
+
+    step = build_step(nhwc, use_bn, use_relu, momentum, head_w)
+
+    @jax.jit
+    def multi(convs_m, moms, x, y_lab):
+        carry = ((convs_m, gammas, betas), moms, x, y_lab)
+        carry, Ls = lax.scan(step, carry, None, length=K)
+        return carry[0][0][0][0], Ls[-1]
+
+    out = multi(convs_m, moms, x, y_lab)
+    float(jnp.asarray(out[-1]))  # compile+warm
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = multi(convs_m, moms, x, y_lab)
+    float(jnp.asarray(out[-1]))
+    dt = (time.perf_counter() - t0) / (REPS * K)
+    print(f"  {name:9s} {B/dt:7.0f} img/s   ({dt*1e3:.1f} ms/step)",
+          flush=True)
+
+
+def main():
+    which = sys.argv[1:] or ["full", "nhwc", "nobn", "norelu", "nomom",
+                             "convonly"]
+    print(f"devices: {jax.devices()}  BS{B} bf16 scan K={K}")
+    for w in which:
+        run_variant(w)
+
+
+if __name__ == "__main__":
+    main()

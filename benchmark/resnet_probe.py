@@ -119,11 +119,61 @@ def purejax_variant(B):
     return B / time_steps(step_once, lambda L: float(jnp.asarray(L)))
 
 
+def scan_variant(B, K=8, reps=4):
+    """K train steps CHAINED inside ONE jit (lax.scan over the full
+    train state): pure on-chip step time, no per-dispatch relay cost —
+    the difference vs `purejax` isolates the relay overhead per step."""
+    from jax import lax
+
+    from incubator_mxnet_tpu.gluon.block import functionalize
+
+    net = _build_net(B)
+    apply_fn, train_raws, aux_raws = functionalize(net)
+    rng = jax.random.PRNGKey(0)
+    y = jnp.zeros((B,), jnp.int32)
+    x = jnp.ones((B, 3, 224, 224), jnp.bfloat16)
+
+    masters = tuple(w.astype(jnp.float32) for w in train_raws)
+    moms = tuple(jnp.zeros_like(m) for m in masters)
+
+    @jax.jit
+    def multi(masters, moms, aux, xx):
+        def body(carry, _):
+            m, v, a = carry
+            tr = tuple(w.astype(jnp.bfloat16) for w in m)
+
+            def loss_of(t):
+                out, new_aux = apply_fn(t, a, rng, xx, training=True)
+                logp = jax.nn.log_softmax(out.astype(jnp.float32))
+                return (-jnp.mean(jnp.take_along_axis(logp, y[:, None], 1)),
+                        new_aux)
+
+            (L, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tr)
+            nv = tuple(0.9 * vv + g.astype(jnp.float32)
+                       for vv, g in zip(v, grads))
+            nm = tuple(mm - 0.1 * vv for mm, vv in zip(m, nv))
+            return (nm, nv, new_aux), L
+
+        (m, v, a), Ls = lax.scan(body, (masters, moms, aux), None, length=K)
+        return m, v, a, Ls[-1]
+
+    out = multi(masters, moms, aux_raws, x)
+    float(jnp.asarray(out[-1]))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = multi(masters, moms, aux_raws, x)
+    float(jnp.asarray(out[-1]))
+    dt = (time.perf_counter() - t0) / (reps * K)
+    return B / dt
+
+
 def main():
     which = sys.argv[1:] or ["gluon", "purejax"]
     B = int(os.environ.get("RESNET_PROBE_BS", "128"))
     for w in which:
-        fn = {"gluon": gluon_variant, "purejax": purejax_variant}[w]
+        fn = {"gluon": gluon_variant, "purejax": purejax_variant,
+              "scan": scan_variant}[w]
         print(f"{w} bf16 BS{B}: {fn(B):.0f} img/s", flush=True)
 
 

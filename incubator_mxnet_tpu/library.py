@@ -88,7 +88,13 @@ def load(path: str, verbose: bool = True):
     # validate ALL names before registering ANY target, so a conflicting
     # plugin leaves the FFI registry untouched (atomic load)
     names = [lib.mxtpu_plugin_op_name(i).decode() for i in range(n)]
+    seen = set()
     for name in names:
+        if name in seen:
+            raise ValueError(
+                f"library.load: {path} lists op '{name}' twice — "
+                f"ambiguous handler; fix the plugin's enumeration table")
+        seen.add(name)
         if _OP_SOURCE.get(name, libpath) != libpath:
             raise ValueError(
                 f"library.load: op '{name}' already registered by "

@@ -459,12 +459,14 @@ def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: boo
 
     key = _random.next_key()
 
-    from ..ops.dropout_kernel import _use_kernel
-
-    if not axes and _use_kernel():
-        # TPU: in-kernel PRNG mask (ops/dropout_kernel) — no threefry
-        # mask materialized through HBM (the BERT "dropout tax",
-        # BASELINE.md); backward regenerates the mask from the seed.
+    if not axes:
+        # fused path on EVERY backend: on TPU the mask comes from the
+        # in-kernel Mosaic PRNG (no threefry mask materialized through
+        # HBM — the BERT "dropout tax", BASELINE.md) and backward
+        # regenerates it from the seed (zero extra memory); elsewhere a
+        # block-keyed threefry with the same structure.  Both are
+        # GSPMD-partitionable (custom_partitioning row rule), so this
+        # path stays active on multi-device meshes.
         from ..ops.dropout_kernel import fused_dropout
 
         seed_arr = _random.key_to_seed(key)

@@ -1,4 +1,5 @@
-"""Fused dropout — mask generated IN-KERNEL by the TPU core PRNG.
+"""Fused dropout — mask generated IN-KERNEL by the TPU core PRNG,
+GSPMD-partitionable over any device mesh.
 
 Kills the "dropout tax" (BASELINE.md: threefry mask generation cost
 ~16 ms/step ≈ 20 MFU points on BERT-large): instead of materializing a
@@ -8,14 +9,29 @@ seeds the per-core PRNG (`pltpu.prng_seed`) and draws the keep-mask for
 its tile on the fly — the op touches HBM exactly twice (read x, write
 out), the bandwidth floor of any elementwise op.
 
-Backward regenerates the SAME bits from the same (seed, program_id)
-instead of saving the mask — zero extra memory, the recompute trick the
+Backward regenerates the SAME bits from the same seed words instead of
+saving the mask — zero extra memory, the recompute trick the
 reference's fused dropout uses for cuDNN-free paths
 (ref: src/operator/nn/dropout.cc MSHADOW path, SURVEY.md §2.3).
 
-CPU/interpret falls back to the threefry reference (`_dropout_ref`) —
-identical distribution, different stream; tests assert statistics and
-the fwd/bwd mask-consistency property, not bit equality with XLA.
+Mesh compatibility (the r3 gap: the kernel used to demand ONE device).
+The array is viewed as a canonical 2D grid of (block_rows x block_cols)
+tiles whose geometry is fixed by the GLOBAL shape, and every tile's
+mask depends only on ``(seed, global_tile_coordinates)``.  A
+`jax.experimental.custom_partitioning` rule shards the op over rows
+AND columns (so batch/seq-sharded and tensor-parallel model-sharded
+activations both stay sharded — no all-gather): each shard computes
+its global tile offsets from its mesh coordinates and regenerates
+exactly the bits the unpartitioned op would produce.  Because the mask
+is a pure function of global tile coordinates, ANY tile-aligned
+partitioning — including fwd and bwd landing on different shardings —
+yields the identical global mask, which is what keeps the zero-memory
+backward exact under GSPMD.
+
+CPU (and any non-TPU backend) takes a block-keyed threefry reference
+with the same tile-coordinate keying — same partitioning behavior and
+fwd/bwd identity, different bits (documented; tests assert statistics
+and consistency properties, not bit equality across backends).
 """
 from __future__ import annotations
 
@@ -23,23 +39,111 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = ["fused_dropout"]
 
-# one grid row owns (_BLOCK_ROWS, cols) in VMEM; cols padded to lanes
+# upper bound on rows per tile; actual tile geometry is shape-derived
 _BLOCK_ROWS = 1024
+# per-block VMEM budget in elements (x block + out block both live there)
+_BLOCK_BUDGET_BYTES = 2 << 20
 
 
-def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate):
+# shardings up to this many ways (power-of-two meshes) stay sharded;
+# the _pick_* ladders are derived from these
+_MAX_ROW_SHARDS = 64
+_MAX_COL_SHARDS = 8
+
+
+def _shard_ladder(max_shards):
+    s, out = max_shards, []
+    while s >= 1:
+        out.append(s)
+        s //= 2
+    return tuple(out)
+
+
+def _pick_br(R: int, cap: int) -> int:
+    """Largest TILE-LEGAL row block: a multiple of 8 (the TPU sublane
+    constraint whenever the row grid has >1 step) that keeps row
+    sharding alive.  s-way sharding survives iff br divides R/s, so br
+    is drawn from the divisors of R // gcd(R, s) for the most ambitious
+    power-of-two s first (64-way headroom, then 32, ... 1).  Last
+    resorts: br == R when one block fits, else a non-dividing multiple
+    of 8 (the kernel runs a ceil grid with a masked tail block — such
+    shapes lose row sharding via the partition rule's divisibility
+    check, never correctness)."""
+    import math
+
+    def best_mult8_div(n, limit):
+        limit = min(limit, n) - min(limit, n) % 8
+        for d in range(limit, 7, -8):
+            if n % d == 0:
+                return d
+        return None
+
+    for s_pref in _shard_ladder(_MAX_ROW_SHARDS):
+        rs = R // math.gcd(R, s_pref)
+        br = best_mult8_div(rs, cap)
+        if br:
+            return br
+    if R <= cap:
+        return R  # one grid step: any block height is legal
+    return cap - cap % 8 or 8  # ceil grid + masked tail
+
+
+def _pick_bc(Clp: int, budget: int) -> int:
+    """Column block: a multiple of 128 (lane constraint) dividing Clp,
+    preferring blocks that divide Clp/s for power-of-two col-shard
+    counts s (tensor-parallel activations shard the model dim) so the
+    partition rule can keep column shardings sharded too."""
+    import math
+
+    def best_mult128_div(n, limit):
+        limit = min(limit, n) - min(limit, n) % 128
+        for d in range(limit, 127, -128):
+            if n % d == 0:
+                return d
+        return None
+
+    cap = max(128, (budget // 8) - (budget // 8) % 128)
+    for s_pref in _shard_ladder(_MAX_COL_SHARDS):
+        cs = Clp // math.gcd(Clp, s_pref)
+        bc = best_mult128_div(cs, cap)
+        if bc:
+            return bc
+    return best_mult128_div(Clp, cap) or 128
+
+
+def _row_grid(rows: int, br: int) -> int:
+    return -(-rows // br)
+
+
+def _tile_geometry(R: int, Clp: int, itemsize: int):
+    """(block_rows, block_cols) for the GLOBAL (R, Clp) view — static,
+    derived only from the global shape so every shard (and fwd/bwd)
+    agrees.  Clp is a multiple of 128; bc divides Clp (col-shard
+    friendly per _pick_bc); br is tile-legal per _pick_br (multiple of
+    8, or the whole R)."""
+    budget = max(1024, _BLOCK_BUDGET_BYTES // max(1, itemsize))
+    bc = _pick_bc(Clp, budget)
+    cap = max(1, min(_BLOCK_ROWS, budget // bc))
+    return _pick_br(R, cap), bc
+
+
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    # distinct stream per grid program: same (seed, pid) in fwd and bwd
-    # regenerates the identical mask.  Seeded with TWO words — layer
-    # seeds that differ by less than the grid size would otherwise draw
-    # identical bits on overlapping tiles (correlated masks across
-    # layers).
-    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    # distinct stream per global tile: seed words are (user seed,
+    # LINEAR global tile id = (row_block_offset + i) * ncb + j).  Same
+    # words in fwd and bwd regenerate the identical mask; TWO words —
+    # Mosaic on the v5e rejects 3-word prng_seed — and the second word
+    # linearizes (row block, col block) with the STATIC global column
+    # block count, so the id is globally unique and shard-invariant.
+    pltpu.prng_seed(seed_ref[0],
+                    seed_ref[1] + pl.program_id(0) * ncb + pl.program_id(1))
     # raw bits come back int32 — bitcast before the unsigned compare
     bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
     # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)
@@ -51,72 +155,203 @@ def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate):
                            jnp.zeros_like(x))
 
 
-def _run(x, seed, rate, interpret):
-    """Reshape to (rows, 128k) tiles, pad the tail row, run the kernel."""
+def _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
+              interpret):
+    """Run the Pallas kernel over the (rows_local, cols_local) 2D view.
+
+    ``row_blk_off``/``col_blk_off``: this shard's global tile offsets
+    (0 unpartitioned); ``ncb_g``: GLOBAL column-block count — the
+    static stride that linearizes (row block, col block) into the
+    shard-invariant tile id."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    n = x.size
-    cols = 512 if n % 512 == 0 else 128
-    if n % cols != 0:  # ragged tail: pad to a full row
-        pad = cols - n % cols
-        flat = jnp.pad(x.reshape(-1), (0, pad))
-    else:
-        pad = 0
-        flat = x.reshape(-1)
-    x2d = flat.reshape(-1, cols)
-    rows = x2d.shape[0]
-    br = min(_BLOCK_ROWS, rows)
-    out = pl.pallas_call(
-        functools.partial(_dropout_kernel, rate=rate),
-        grid=((rows + br - 1) // br,),
+    rows, cols = x2d.shape
+    lin_off = (jnp.asarray(row_blk_off, jnp.int32) * ncb_g
+               + jnp.asarray(col_blk_off, jnp.int32))
+    seeds = jnp.concatenate([seed.astype(jnp.int32), lin_off.reshape(1)])
+    return pl.pallas_call(
+        functools.partial(_dropout_kernel, rate=rate, ncb=ncb_g),
+        grid=(_row_grid(rows, br), -(-cols // bc)),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # seed scalar
-            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # (2,) seed words
+            pl.BlockSpec((br, bc), lambda i, j: (i, j)),
         ],
-        out_specs=pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((br, bc), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
         interpret=interpret,
-    )(seed, x2d)
-    flat_out = out.reshape(-1)
-    if pad:
-        flat_out = flat_out[:n]
-    return flat_out.reshape(x.shape)
+    )(seeds, x2d)
 
 
-def _dropout_ref(x, seed, rate):
-    """Threefry reference path (CPU / correctness oracle)."""
-    key = jax.random.fold_in(jax.random.PRNGKey(0), seed[0])
-    keep = jax.random.bernoulli(key, 1.0 - rate, shape=x.shape)
-    return jnp.where(keep, x / jnp.asarray(1.0 - rate, x.dtype),
-                     jnp.zeros_like(x)).astype(x.dtype)
+def _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
+    """Threefry reference with the SAME global tile keying (CPU /
+    oracle): one key per (row block, col block) tile, folded from the
+    linear tile id — partition-invariant over rows AND cols."""
+    R, Cl = x2d.shape
+    nbr = _row_grid(R, br)
+    nbc = Cl // bc  # bc divides every (global or shard) col extent
+    rpad = nbr * br - R  # ceil grid: masked tail rows, like the kernel
+    base = jax.random.fold_in(jax.random.PRNGKey(0), seed[0])
+    inv = jnp.asarray(1.0 - rate, x2d.dtype)
+
+    def one(lin_id, xt):
+        k = jax.random.fold_in(base, lin_id)
+        keep = jax.random.bernoulli(k, 1.0 - rate, (br, bc))
+        return jnp.where(keep, xt / inv, jnp.zeros_like(xt))
+
+    xp = jnp.pad(x2d, ((0, rpad), (0, 0))) if rpad else x2d
+    tiles = xp.reshape(nbr, br, nbc, bc).transpose(0, 2, 1, 3) \
+        .reshape(nbr * nbc, br, bc)
+    ids = ((row_blk_off + jnp.arange(nbr, dtype=jnp.int32))[:, None] * ncb_g
+           + (col_blk_off + jnp.arange(nbc, dtype=jnp.int32))[None, :]
+           ).reshape(-1)
+    out = jax.vmap(one)(ids, tiles) \
+        .reshape(nbr, nbc, br, bc).transpose(0, 2, 1, 3) \
+        .reshape(nbr * br, Cl).astype(x2d.dtype)
+    return out[:R] if rpad else out
 
 
-def _use_kernel():
-    # TPU backends only ("axon" = this sandbox's tunneled v5e); CUDA/
-    # Metal/CPU take the threefry reference — pltpu primitives are
-    # Mosaic-TPU-only.  nn_ops.Dropout gates on this same predicate.
-    #
-    # Single-device processes only: a pallas_call is not
-    # GSPMD-partitionable, so inside a sharded (mesh) train step it
-    # would fail to compile / force replication.  Multi-chip runs take
-    # the threefry path until the kernel grows a custom_partitioning
-    # rule (tracked as future work; the single-chip bench keeps the
-    # in-kernel PRNG win).
-    return (jax.default_backend() in ("tpu", "axon")
-            and len(jax.devices()) == 1)
+def _kernel_backend() -> bool:
+    # Mosaic-TPU PRNG primitives only exist on TPU backends ("axon" =
+    # this sandbox's tunneled v5e); every other backend takes the
+    # block-keyed threefry reference.
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
+    if _kernel_backend():
+        return _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
+                         ncb_g, interpret=False)
+    return _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
+                        ncb_g)
+
+
+# ------------------------------------------------------------------ #
+# the partitionable op: canonical 2D view, statics (rate, br, bc, ncb_g)
+# ------------------------------------------------------------------ #
+@functools.partial(custom_partitioning, static_argnums=(2, 3, 4, 5))
+def _dp2d(x2d, seed, rate, br, bc, ncb_g):
+    z = jnp.int32(0)
+    return _blocked(x2d, seed, z, z, rate, br, bc, ncb_g)
+
+
+def _shard_count_and_offset(spec_entry, m, extent, block):
+    """(accepted_spec, traced block offset fn) for one dim: returns the
+    spec to keep (None = replicate) and a thunk computing this shard's
+    global block offset from its mesh coordinates."""
+    if spec_entry is None:
+        return None, (lambda: jnp.int32(0))
+    axes = spec_entry if isinstance(spec_entry, tuple) else (spec_entry,)
+    n = 1
+    for ax in axes:
+        n *= m.shape[ax]
+    if extent % n != 0 or (extent // n) % block != 0:
+        # shard boundary would straddle a tile: replicate this dim
+        # (correct, just not sharded).  _pick_br/_pick_bc prefer blocks
+        # dividing extent/s for power-of-two s, so this triggers only
+        # for shard counts beyond what the extent's factorization
+        # supports
+        return None, (lambda: jnp.int32(0))
+    shard_blocks = (extent // n) // block
+
+    def off():
+        idx = jnp.int32(0)
+        for ax in axes:
+            idx = idx * m.shape[ax] + jax.lax.axis_index(ax)
+        return idx * shard_blocks
+
+    return spec_entry, off
+
+
+def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
+    x_info, seed_info = arg_shapes
+    x_sh = x_info.sharding
+    m = x_sh.mesh
+    R, Clp = x_info.shape
+    spec = tuple(x_sh.spec) + (None,) * (2 - len(x_sh.spec))
+    rows_spec, row_off = _shard_count_and_offset(spec[0], m, R, br)
+    cols_spec, col_off = _shard_count_and_offset(spec[1], m, Clp, bc)
+    canon = NamedSharding(m, P(rows_spec, cols_spec))
+    seed_sh = NamedSharding(m, P(None))
+
+    def lower(xs, seed):
+        return _blocked(xs, seed, row_off(), col_off(), rate, br, bc, ncb_g)
+
+    return mesh, lower, canon, (canon, seed_sh)
+
+
+_dp2d.def_partition(
+    _dp2d_partition,
+    infer_sharding_from_operands=None,
+    # rows (i) AND cols (j) may shard — tile ids are global either way;
+    # only the seed (k) must replicate
+    sharding_rule="i j, k -> i j",
+    need_replication_factors=("k",),
+)
+
+
+def _canonical_2d(x):
+    """(x2d, restore_fn, br, bc, ncb_g) — THE canonical view both `_apply` and
+    `_run` share (the geometry is part of the mask; it is a pure
+    function of the GLOBAL shape+dtype).
+
+    Arrays with a healthy last dim keep it as the column axis (pad to a
+    128 multiple; sharding-friendly: leading dims stay the row axis).
+    Small or badly ragged last dims (< 128, or needing > Cl/8 padding)
+    FLATTEN first — per-row padding there would inflate HBM traffic up
+    to 128x, defeating the bandwidth-floor point of the kernel."""
+    Cl = x.shape[-1] if x.ndim >= 2 else x.size
+    pad = (-Cl) % 128
+    if x.ndim >= 2 and Cl >= 128 and pad * 8 <= Cl:
+        R = x.size // Cl
+        x2 = x.reshape(R, Cl)
+        if pad:
+            x2 = jnp.pad(x2, ((0, 0), (0, pad)))
+        br, bc = _tile_geometry(R, Cl + pad, x.dtype.itemsize)
+        return (x2, (lambda y2: y2[:, :Cl].reshape(x.shape)), br, bc,
+                (Cl + pad) // bc)
+    # flatten path: total tail padding < cols elements
+    n = x.size
+    cols = 512 if n % 512 == 0 else 128
+    R = -(-n // cols)
+    padn = R * cols - n
+    flat = x.reshape(-1)
+    if padn:
+        flat = jnp.pad(flat, (0, padn))
+    x2 = flat.reshape(R, cols)
+    br, bc = _tile_geometry(R, cols, x.dtype.itemsize)
+    return (x2, (lambda y2: y2.reshape(-1)[:n].reshape(x.shape)), br, bc,
+            cols // bc)
+
+
+def _apply(x, seed, rate):
+    """Canonical 2D view -> partitionable blocked dropout -> restore."""
+    x2, restore, br, bc, ncb_g = _canonical_2d(x)
+    y2 = _dp2d(x2, seed, float(rate), int(br), int(bc), int(ncb_g))
+    return restore(y2)
+
+
+def _run(x, seed, rate, interpret):
+    """Direct kernel runner (interpret-mode testing): same canonical
+    view as `_apply`, global row-block offset 0, no partitioning rule."""
+    x2, restore, br, bc, ncb_g = _canonical_2d(x)
+    z = jnp.int32(0)
+    y2 = _kernel2d(x2, seed, z, z, rate, br, bc, ncb_g, interpret)
+    return restore(y2)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def fused_dropout(x, seed, rate: float):
     """Dropout with in-kernel PRNG mask. ``seed``: (1,) int32 array —
     derive it from the step key via `random.key_to_seed`; same seed →
-    same mask (what makes the zero-memory backward exact)."""
+    same mask (what makes the zero-memory backward exact).  Safe under
+    GSPMD: ANY row and/or column sharding aligned to the global tile
+    grid preserves the global mask bit-for-bit."""
     if rate >= 1.0:  # degenerate: drop everything (threefry-path parity)
         return jnp.zeros_like(x)
-    if _use_kernel():
-        return _run(x, seed, rate, interpret=False)
-    return _dropout_ref(x, seed, rate)
+    if x.size == 0:  # empty ragged tail batch: nothing to mask
+        return x
+    return _apply(x, seed, rate)
 
 
 def _fwd(x, seed, rate):

@@ -81,6 +81,121 @@ def test_nd_dropout_routes_and_backprops():
     onp.testing.assert_array_equal(yv != 0, g != 0)
 
 
+def test_partition_rule_keeps_row_sharding():
+    """Pin that the partition rule does NOT fall back to replication
+    for ordinary activation shapes on power-of-two row shardings — the
+    r4 review found the first tile geometry silently replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.ops import dropout_kernel as dk
+    from incubator_mxnet_tpu.parallel import create_mesh
+
+    mesh = create_mesh(data=8)
+    # 4800 = 2^5*3*5^2: br must come from divisors of R/8 (600), not R,
+    # or the rule silently replicates (the r4 review's counterexample)
+    for R, Cl in [(4096, 1024), (64, 256), (128, 384), (512, 1024),
+                  (4800, 512), (33280, 1024)]:
+        br, bc = dk._tile_geometry(R, Cl if Cl % 128 == 0 else Cl + (-Cl) % 128,
+                                   4)
+        x_info = jax.ShapeDtypeStruct(
+            (R, Cl), jnp.float32,
+            sharding=NamedSharding(mesh, P("data", None)))
+        s_info = jax.ShapeDtypeStruct(
+            (1,), jnp.int32, sharding=NamedSharding(mesh, P(None)))
+        ncb = (Cl + (-Cl) % 128) // bc
+        _, _, out_sh, arg_shs = dk._dp2d_partition(
+            0.4, br, bc, ncb, mesh, (x_info, s_info), x_info)
+        assert out_sh.spec[0] == "data", (R, Cl, br, out_sh.spec)
+        assert arg_shs[0].spec[0] == "data", (R, Cl, br)
+
+
+def test_partition_rule_keeps_col_sharding():
+    """Model-dim (tensor-parallel) shardings must stay sharded too —
+    forcing column replication would all-gather every dropout call on
+    TP meshes (r4 review finding)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.ops import dropout_kernel as dk
+    from incubator_mxnet_tpu.parallel import create_mesh
+
+    mesh = create_mesh(data=4, model=2)
+    # (128, 384) CANNOT col-shard 2-way (192 per shard has no 128-lane
+    # tile) — the rule must fall back to col replication there, sharded
+    # rows intact
+    for R, Cl, want in [(4096, 1024, P("data", "model")),
+                        (256, 512, P("data", "model")),
+                        (128, 384, P("data", None))]:
+        br, bc = dk._tile_geometry(R, Cl, 4)
+        x_info = jax.ShapeDtypeStruct(
+            (R, Cl), jnp.float32,
+            sharding=NamedSharding(mesh, P("data", "model")))
+        s_info = jax.ShapeDtypeStruct(
+            (1,), jnp.int32, sharding=NamedSharding(mesh, P(None)))
+        _, _, out_sh, arg_shs = dk._dp2d_partition(
+            0.4, br, bc, Cl // bc, mesh, (x_info, s_info), x_info)
+        assert out_sh.spec == want, (R, Cl, br, bc, out_sh.spec)
+
+
+def test_partitioned_matches_unpartitioned_bitexact():
+    """The GSPMD property: ANY row sharding regenerates the identical
+    global mask (the tile grid is fixed by the GLOBAL shape), so the
+    sharded op equals the single-device op bit-for-bit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel import create_mesh
+
+    mesh = create_mesh(data=8)
+    for shape in [(64, 256), (4096, 1024)]:
+        x = jnp.arange(shape[0] * shape[1], dtype=jnp.float32) \
+            .reshape(shape) * 1e-3 + 1.0
+        ref = onp.asarray(jax.jit(lambda x: fused_dropout(x, SEED, 0.4))(x))
+        for spec in [P("data", None), P(None, "data"), P(None, None)]:
+            xs = jax.device_put(x, NamedSharding(mesh, spec))
+            y = jax.jit(lambda x: fused_dropout(x, SEED, 0.4))(xs)
+            onp.testing.assert_array_equal(onp.asarray(y), ref,
+                                           err_msg=f"{shape} {spec}")
+
+
+def test_partitioned_grad_mask_identity():
+    """fwd/bwd mask identity must survive sharding — the zero-memory
+    backward regenerates per-shard bits from global tile coords."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel import create_mesh
+
+    mesh = create_mesh(data=4, model=2)
+    x = jnp.full((32, 256), 2.0, jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P(("data", "model"), None)))
+    y = jax.jit(lambda x: fused_dropout(x, SEED, 0.3))(xs)
+    g = jax.jit(jax.grad(lambda x: fused_dropout(x, SEED, 0.3).sum()))(xs)
+    y, g = onp.asarray(y), onp.asarray(g)
+    onp.testing.assert_array_equal(y != 0, g != 0)
+    onp.testing.assert_allclose(g[g != 0], 1.0 / 0.7, rtol=1e-6)
+
+    # unsharded oracle agrees bit-for-bit
+    ref = onp.asarray(jax.jit(lambda x: fused_dropout(x, SEED, 0.3))(x))
+    onp.testing.assert_array_equal(y, ref)
+
+
+def test_partitioned_3d_activation_shape():
+    """(B, T, D) transformer activations: batch+seq sharded rows, model
+    dim replicated by the rule — the flagship BERT layout."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from incubator_mxnet_tpu.parallel import create_mesh
+
+    mesh = create_mesh(data=4, model=2)
+    x = jnp.ones((8, 16, 384), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data", None, "model")))
+    y = jax.jit(lambda x: fused_dropout(x, SEED, 0.25))(xs)
+    yv = onp.asarray(y)
+    assert yv.shape == x.shape
+    keep = (yv != 0).mean()
+    assert abs(keep - 0.75) < 0.03
+    ref = onp.asarray(jax.jit(lambda x: fused_dropout(x, SEED, 0.25))(x))
+    onp.testing.assert_array_equal(yv, ref)
+
+
 def test_pallas_interpret_matches_contract():
     """Run the actual kernel body in interpret mode on CPU (skip cleanly
     if this jax build can't interpret the TPU PRNG primitives)."""
