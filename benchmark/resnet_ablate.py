@@ -45,12 +45,38 @@ def conv(x, w, stride, pad, nhwc):
         dimension_numbers=dn)
 
 
+BN_MODE = "f32"  # f32 | prod | 2stage — set per variant
+
+
 def bn(x, gamma, beta, nhwc, use_bn):
     caxes = (0, 1, 2) if nhwc else (0, 2, 3)
     shape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
     if not use_bn:
         return x * gamma.reshape(shape).astype(x.dtype) \
             + beta.reshape(shape).astype(x.dtype)
+    if BN_MODE == "2stage":
+        if nhwc:
+            xr = x.reshape(-1, x.shape[-1])
+            s = jnp.sum(xr, 0, dtype=jnp.float32)
+            q = jnp.sum(xr * xr, 0, dtype=jnp.float32)
+        else:
+            xr = x.reshape(x.shape[0], x.shape[1], -1)
+            s = jnp.sum(jnp.sum(xr, 2, dtype=jnp.float32), 0)
+            q = jnp.sum(jnp.sum(xr * xr, 2, dtype=jnp.float32), 0)
+        cnt = x.size // gamma.size
+        mean = s / cnt
+        var = jnp.maximum(q / cnt - jnp.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + 1e-5) * gamma
+        shift = beta - mean * inv
+        return x * inv.astype(x.dtype).reshape(shape) \
+            + shift.astype(x.dtype).reshape(shape)
+    if BN_MODE == "prod":  # r3 product formulation (bf16 stats)
+        mean = jnp.mean(x, caxes)
+        var = jnp.mean(jnp.square(x), caxes) - jnp.square(mean)
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + 1e-5).astype(x.dtype)
+        return (x - mean.reshape(shape)) \
+            * (gamma.astype(x.dtype) * inv).reshape(shape) \
+            + beta.astype(x.dtype).reshape(shape)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, caxes)
     var = jnp.mean(jnp.square(xf), caxes) - jnp.square(mean)
@@ -147,10 +173,13 @@ def build_step(nhwc, use_bn, use_relu, momentum, head_w):
 
 
 def run_variant(name):
-    nhwc = name in ("nhwc",)
+    global BN_MODE
+    nhwc = name in ("nhwc", "nhwc2stage")
     use_bn = name not in ("nobn", "convonly")
     use_relu = name not in ("norelu", "convonly")
     momentum = name not in ("nomom",)
+    BN_MODE = "2stage" if "2stage" in name else (
+        "prod" if name == "bnprod" else "f32")
     key = jax.random.PRNGKey(0)
     convs, gammas, betas = init_params(nhwc, key)
     convs_m = tuple(w.astype(jnp.float32) for w in convs)

@@ -1,0 +1,92 @@
+"""BERT language model trained through GluonPipeline (1F1B pipeline
+parallelism) — the public Gluon doorway to PP.
+
+Mirrors the reference's pipelined-transformer training examples
+(ref concept: SURVEY.md §2.4 PP row): stage blocks are plain Gluon
+BERTLayers, the embedding trains outside the pipe through its input
+cotangent, the LM head trains as loss_params — all wired by
+`parallel.GluonPipeline`, updated by the unchanged `gluon.Trainer`.
+
+Run (CPU mesh): XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+                JAX_PLATFORMS=cpu python examples/nlp/pipeline_bert.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--pipe", type=int, default=2)
+    p.add_argument("--units", type=int, default=32)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=16)
+    p.add_argument("--microbatch", type=int, default=4)
+    p.add_argument("--num-microbatches", type=int, default=4)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--lr", type=float, default=1e-2)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.models import bert
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.parallel import GluonPipeline, create_mesh
+
+    n, D, V, T = args.pipe, args.units, args.vocab, args.seq_len
+    mb, M = args.microbatch, args.num_microbatches
+    B = mb * M
+    mesh = create_mesh(jax.devices()[:n], pipe=n)
+    mx.random.seed(0)
+
+    stages = []
+    for _ in range(n):
+        layer = bert.BERTLayer(units=D, hidden_size=2 * D, num_heads=2,
+                               dropout=0.0, use_flash=False)
+        layer.initialize()
+        layer(NDArray(jnp.ones((mb, T, D), jnp.float32)))
+        stages.append(layer)
+    emb = gluon.nn.Embedding(V, D)
+    emb.initialize()
+    emb(NDArray(jnp.zeros((mb, T), jnp.int32)))
+    head = gluon.nn.Dense(V, flatten=False)
+    head.initialize()
+    head(NDArray(jnp.ones((mb, T, D), jnp.float32)))
+
+    def ce_loss(logits, t):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, t[..., None], -1))
+
+    pipe = GluonPipeline(stages, mesh, ce_loss, num_microbatches=M,
+                         embedding=emb, head=head)
+    trainer = gluon.Trainer(pipe.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    # copy task: predict the input token (memorizable by the head alone,
+    # but gradients must flow through every stage to converge fast)
+    k = jax.random.PRNGKey(1)
+    tokens = NDArray(jax.random.randint(k, (B, T), 0, V))
+    first = last = None
+    for step in range(args.steps):
+        loss = float(pipe.train_step(tokens, tokens).asnumpy())
+        trainer.step(B)
+        if step == 0:
+            first = loss
+        last = loss
+        if step % 5 == 0:
+            print(f"step {step:3d} loss {loss:.4f}", flush=True)
+    print(f"first {first:.4f} -> last {last:.4f}")
+    return first, last
+
+
+if __name__ == "__main__":
+    main()

@@ -351,11 +351,38 @@ def smooth_l1(data, scalar: float = 1.0):
 # ---------------------------------------------------------------------- #
 # normalization
 # ---------------------------------------------------------------------- #
-def batch_norm_stats(x, axis: int = 1):
-    axes = tuple(i for i in range(x.ndim) if i != axis)
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+def _bn_stats_f32(x, axis: int = 1):
+    """Per-channel (mean, var) in f32 via a TWO-STAGE reduction.
+
+    Measured on the v5e (r4, docs/performance.md): XLA lowers a direct
+    bf16 `jnp.mean(x, (0, 2, 3))` to a reduce running ~6x off the HBM
+    roofline on ResNet-sized activations; reshaping to (N, C, S) and
+    reducing S then N with f32 accumulation is 3-6x faster end-to-end
+    (fwd+bwd) and is the difference between BN costing 13.8 ms and
+    ~4 ms of a BS128 ResNet-50 train step.  The square stays in x's
+    dtype (f32 accumulate) so autodiff never saves an upcast f32 copy
+    of the activation."""
+    cnt = x.size // x.shape[axis]
+    if x.ndim >= 3 and axis == 1:
+        xr = x.reshape(x.shape[0], x.shape[1], -1)
+        s = jnp.sum(jnp.sum(xr, 2, dtype=jnp.float32), 0)
+        q = jnp.sum(jnp.sum(xr * xr, 2, dtype=jnp.float32), 0)
+    elif axis in (x.ndim - 1, -1):
+        xr = x.reshape(-1, x.shape[-1])
+        s = jnp.sum(xr, 0, dtype=jnp.float32)
+        q = jnp.sum(xr * xr, 0, dtype=jnp.float32)
+    else:
+        axes = tuple(i for i in range(x.ndim) if i != axis)
+        s = jnp.sum(x, axes, dtype=jnp.float32)
+        q = jnp.sum(jnp.square(x), axes, dtype=jnp.float32)
+    mean = s / cnt
+    var = jnp.maximum(q / cnt - jnp.square(mean), 0.0)
     return mean, var
+
+
+def batch_norm_stats(x, axis: int = 1):
+    mean, var = _bn_stats_f32(x, axis)
+    return mean.astype(x.dtype), var.astype(x.dtype)
 
 
 def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps: float = 1e-5,
@@ -373,17 +400,20 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps: float = 1e-5,
         if fix_gamma:
             g = jnp.ones_like(g)
         if use_batch_stats:
-            mean, var = batch_norm_stats(x, axis)
-            new_mm = momentum * mm + (1 - momentum) * mean
-            new_mv = momentum * mv + (1 - momentum) * var
+            mean32, var32 = _bn_stats_f32(x, axis)
+            new_mm = momentum * mm + (1 - momentum) * mean32.astype(mm.dtype)
+            new_mv = momentum * mv + (1 - momentum) * var32.astype(mv.dtype)
         else:
-            mean, var = mm, mv
+            mean32, var32 = mm.astype(jnp.float32), mv.astype(jnp.float32)
             new_mm, new_mv = mm, mv
         shape = [1] * x.ndim
         shape[axis] = -1
-        inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
-        out = (x - mean.reshape(shape).astype(x.dtype)) * (g * inv).reshape(shape).astype(x.dtype) \
-            + b.reshape(shape).astype(x.dtype)
+        # normalize as ONE fused multiply-add: inv/shift precomputed in
+        # f32 at (C,) size, cast once (see _bn_stats_f32 perf note)
+        inv = lax.rsqrt(var32 + eps) * g.astype(jnp.float32)
+        shift = b.astype(jnp.float32) - mean32 * inv
+        out = x * inv.astype(x.dtype).reshape(shape) \
+            + shift.astype(x.dtype).reshape(shape)
         return out, new_mm, new_mv
 
     out = apply_op(f, data, gamma, beta, moving_mean, moving_var, n_out=3)

@@ -113,7 +113,9 @@ def _pick_bc(Clp: int, budget: int) -> int:
         bc = best_mult128_div(cs, cap)
         if bc:
             return bc
-    return best_mult128_div(Clp, cap) or 128
+    raise AssertionError(
+        f"unreachable: Clp={Clp} is a 128-multiple, so the s=1 rung "
+        f"always finds a divisor")
 
 
 def _row_grid(rows: int, br: int) -> int:

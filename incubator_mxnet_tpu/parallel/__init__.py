@@ -22,10 +22,16 @@ __all__ = ["Mesh", "PartitionSpec", "create_mesh", "current_mesh", "use_mesh",
 
 def __getattr__(name):
     # lazy imports: heavy submodules load on first touch
-    if name in ("ring", "ulysses", "pipeline", "moe", "sharding"):
+    if name in ("ring", "ulysses", "pipeline", "moe", "sharding",
+                "gluon_pipeline"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name == "GluonPipeline":
+        from .gluon_pipeline import GluonPipeline
+
+        globals()[name] = GluonPipeline
+        return GluonPipeline
     raise AttributeError(name)

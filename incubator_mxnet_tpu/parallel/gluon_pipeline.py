@@ -1,0 +1,238 @@
+"""GluonPipeline — the PUBLIC doorway from Gluon Blocks to 1F1B
+pipeline parallelism (ref concept: SURVEY.md §2.4 PP row; the r3
+VERDICT's "productize the Gluon→PP bridge").
+
+The r3 bridge existed only inside a test: stages were functionalized by
+hand, the embedding's cotangent was applied manually, grads never
+reached Parameter objects.  This class packages that exact machinery
+behind the three-line Gluon idiom:
+
+    stages = [bert.BERTLayer(...) for _ in range(n_pipe)]   # initialized
+    pipe = parallel.GluonPipeline(stages, mesh, loss_fn, num_microbatches=8,
+                                  embedding=emb_block, head=head_block)
+    trainer = gluon.Trainer(pipe.collect_params(), "adam", {...})
+    for x, y in data:
+        loss = pipe.train_step(x, y)     # 1F1B fwd/bwd, fills .grad()
+        trainer.step(batch_size)          # unchanged public update path
+
+Design (all reuse of `parallel.pipeline`):
+- `stages`: one Gluon Block per pipe rank, IDENTICAL architectures
+  (1F1B stacks their params on a leading stage dim and runs ONE traced
+  stage program — the reference's interleaved schedule does the same).
+- `embedding` runs OUTSIDE the pipe eagerly; its grads flow through the
+  returned input cotangent via the normal autograd tape
+  (`out.backward(dx)`), so arbitrary front-ends train.
+- `head` (optional) becomes `loss_params`: it is evaluated on the LAST
+  stage's output inside the pipeline loss, and its grads come back with
+  the stage grads.
+- After `train_step`, every Parameter's `.grad()` holds the 1F1B
+  gradient (respecting grad_req='add' accumulation), so the standard
+  Trainer — fused step, schedulers, compression — applies unchanged.
+
+Limitations (v1, documented): stage blocks may not carry aux (BN
+running-stat) parameters; in train_mode the SAME rng key feeds every
+stage/microbatch within a step (dropout masks correlate across
+microbatches — use dropout=0.0 or accept the correlation; the per-step
+key still advances).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GluonPipeline"]
+
+
+def _trainable_params(block):
+    params = block.collect_params()
+    return [p for p in params.values()
+            if p.grad_req != "null" and p._data_nd is not None]
+
+
+def _set_grad(p, raw):
+    g = p._data_nd._grad
+    if g is None:
+        p._data_nd.attach_grad(p.grad_req)
+        g = p._data_nd._grad
+    raw = jnp.asarray(raw, g._data.dtype).reshape(g._data.shape)
+    if p.grad_req == "add":
+        g._data = g._data + raw
+    else:
+        g._data = raw
+
+
+class GluonPipeline:
+    def __init__(self, stages: Sequence, mesh, loss_fn: Callable,
+                 num_microbatches: int, *, embedding=None, head=None,
+                 recompute_stage: bool = True, axis_name: str = "pipe",
+                 train_mode: bool = False):
+        from ..gluon.block import Block, functionalize
+
+        if axis_name not in mesh.axis_names:
+            raise ValueError(
+                f"GluonPipeline: mesh has no '{axis_name}' axis "
+                f"(axes: {mesh.axis_names}); build it with "
+                f"parallel.create_mesh({axis_name}=n)")
+        n = mesh.shape[axis_name]
+        if len(stages) != n:
+            raise ValueError(
+                f"GluonPipeline: {len(stages)} stage blocks for a "
+                f"{axis_name}={n} mesh — need exactly one per rank")
+        if len({id(s) for s in stages}) != len(stages):
+            raise ValueError(
+                "GluonPipeline: the same Block instance appears more "
+                "than once in `stages` — each pipe rank needs its OWN "
+                "block (same architecture, separate Parameters); "
+                "stage grads would otherwise overwrite each other")
+        self._mesh = mesh
+        self._axis = axis_name
+        self._M = num_microbatches
+        self._recompute = recompute_stage
+        self._train_mode = train_mode
+        self._stages = list(stages)
+        self._embedding = embedding
+        self._head = head
+
+        # functionalize stage 0 ONCE; identical architectures mean its
+        # pure fn + stage i's raws ≡ stage i (checked below)
+        fns, plists = [], []
+        for s in self._stages:
+            fn, raws, aux = functionalize(s)
+            if aux:
+                raise ValueError(
+                    "GluonPipeline: stage blocks with aux (running-stat) "
+                    "parameters are not supported in the 1F1B schedule — "
+                    "use LayerNorm-style stages or freeze the stats "
+                    f"(offender: {self._stages.index(s)})")
+            fns.append(fn)
+            plists.append(_trainable_params(s))
+        shapes0 = [tuple(p._data_nd._data.shape) for p in plists[0]]
+        for i, pl in enumerate(plists[1:], 1):
+            si = [tuple(p._data_nd._data.shape) for p in pl]
+            if si != shapes0:
+                raise ValueError(
+                    f"GluonPipeline: stage {i} parameter shapes {si} differ "
+                    f"from stage 0's {shapes0} — 1F1B requires identical "
+                    f"stage architectures")
+        self._stage_fn_raw = fns[0]
+        self._stage_plists = plists
+
+        self._head_params: List = []
+        self._head_fn = None
+        if head is not None:
+            hfn, hraws, haux = functionalize(head)
+            if haux:
+                raise ValueError("GluonPipeline: head has aux parameters")
+            self._head_fn = hfn
+            self._head_params = _trainable_params(head)
+        self._loss_fn = loss_fn
+        self._jit_step = self._build_step()
+
+    def _build_step(self):
+        """ONE jitted 1F1B step, built once: rng and all params enter as
+        ARGUMENTS, so every train_step is a trace-cache hit (a closure
+        rebuilt per call would retrace the whole shard_map program each
+        step — r4 review finding)."""
+        from . import pipeline as pp
+
+        stage_fn_raw = self._stage_fn_raw
+        head_fn = self._head_fn
+        user_loss = self._loss_fn
+        has_head = head_fn is not None
+        want_dx = self._embedding is not None
+        mesh, M, axis = self._mesh, self._M, self._axis
+        recompute = self._recompute
+        train_mode = self._train_mode
+
+        def step(stacked, head_params, x_raw, t_raw, rng):
+            def stage_fn(params, a):
+                out, _ = stage_fn_raw(params, (), rng, a,
+                                      training=train_mode)
+                return out
+
+            if has_head:
+                def lf(y, t, hp):
+                    out, _ = head_fn(hp, (), rng, y, training=train_mode)
+                    return user_loss(out, t)
+
+                return pp.pipeline_train_1f1b(
+                    stage_fn, lf, stacked, x_raw, t_raw, mesh, M,
+                    axis_name=axis, recompute_stage=recompute,
+                    loss_params=head_params, return_dx=want_dx)
+            return pp.pipeline_train_1f1b(
+                stage_fn, user_loss, stacked, x_raw, t_raw, mesh, M,
+                axis_name=axis, recompute_stage=recompute,
+                return_dx=want_dx)
+
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------ #
+    def collect_params(self):
+        """All trainable Parameters (stages + embedding + head) as one
+        ParameterDict — feed straight into gluon.Trainer."""
+        from ..gluon.parameter import ParameterDict
+
+        pd = ParameterDict()
+        seen = set()
+        groups = list(self._stage_plists) + [self._head_params]
+        if self._embedding is not None:
+            groups.append(_trainable_params(self._embedding))
+        for gi, group in enumerate(groups):
+            for p in group:
+                name = p.name if p.name not in seen else f"{p.name}#{gi}"
+                seen.add(name)
+                pd._params[name] = p
+        return pd
+
+    # ------------------------------------------------------------------ #
+    def train_step(self, x, targets):
+        """One 1F1B step: fwd+bwd over num_microbatches, grads written
+        into every Parameter's .grad().  Returns the mean loss as an
+        NDArray — fetch it (`float(loss.asnumpy())`) only when you need
+        the value; an unconditional per-step host sync would serialize
+        the device queue (docs/performance.md)."""
+        from .. import random as _random
+        from ..ndarray.ndarray import NDArray, wrap
+
+        rng = _random.next_key()
+
+        stacked = tuple(
+            jnp.stack([pl[j]._data_nd._data
+                       for pl in self._stage_plists])
+            for j in range(len(self._stage_plists[0])))
+        hp = tuple(p._data_nd._data for p in self._head_params)
+
+        t_raw = targets._data if isinstance(targets, NDArray) \
+            else jnp.asarray(targets)
+
+        # embedding fwd OUTSIDE the pipe, on the tape
+        if self._embedding is not None:
+            from .. import autograd
+
+            x_nd = wrap(x)
+            with autograd.record():
+                emb_out = self._embedding(x_nd)
+            x_raw = emb_out._data
+        else:
+            emb_out = None
+            x_raw = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+        out = self._jit_step(stacked, hp, x_raw, t_raw, rng)
+
+        loss, grads = out[0], out[1]
+        k = 2
+        if self._head_fn is not None:
+            dhead = out[k]; k += 1
+            for p, g in zip(self._head_params, dhead):
+                _set_grad(p, g)
+        if self._embedding is not None:
+            dx = out[k]
+            # embedding bwd: apply the input cotangent through the tape
+            emb_out.backward(out_grad=NDArray(dx.astype(x_raw.dtype)))
+        # stage grads: unstack the leading stage dim
+        for j, g in enumerate(grads):
+            for i, pl in enumerate(self._stage_plists):
+                _set_grad(pl[j], g[i])
+        return NDArray(loss)

@@ -46,6 +46,15 @@ def test_benchmark_score_smoke():
     assert results and results[0][1] > 0
 
 
+def test_pipeline_bert_example_gate():
+    """GluonPipeline example: loss must drop on the copy task."""
+    import importlib
+
+    mod = importlib.import_module("pipeline_bert")
+    first, last = mod.main(["--steps", "12"])
+    assert last < first * 0.7, (first, last)
+
+
 def test_transformer_learns_copy_task():
     import importlib
 

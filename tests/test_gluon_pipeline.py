@@ -1,0 +1,133 @@
+"""GluonPipeline — the public Gluon→1F1B doorway (r3 VERDICT item 3).
+
+Reproduces tests/test_parallel_units.py's hand-built Gluon-BERT 1F1B
+bridge THROUGH the public API: same architecture, same parity oracle,
+but stages/embedding/head enter as plain Gluon Blocks and gradients
+come back through Parameter.grad() so the unchanged gluon.Trainer
+applies the update.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon.block import functionalize
+from incubator_mxnet_tpu.models import bert
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.parallel import GluonPipeline, create_mesh
+
+
+def _build(n, D, V, T, mb, seed=0):
+    mx.random.seed(seed)
+    stages = []
+    for _ in range(n):
+        layer = bert.BERTLayer(units=D, hidden_size=2 * D, num_heads=2,
+                               dropout=0.0, use_flash=False)
+        layer.initialize()
+        layer(NDArray(jnp.ones((mb, T, D), jnp.float32)))
+        stages.append(layer)
+    emb = gluon.nn.Embedding(V, D)
+    emb.initialize()
+    emb(NDArray(jnp.zeros((mb, T), jnp.int32)))
+    head = gluon.nn.Dense(V, flatten=False)
+    head.initialize()
+    head(NDArray(jnp.ones((mb, T, D), jnp.float32)))
+    return stages, emb, head
+
+
+def _ce_loss(logits, t):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, t[..., None], -1))
+
+
+def test_public_api_full_grad_parity():
+    """Loss + every gradient (stages, embedding, head) matches the
+    sequential oracle — through GluonPipeline, not hand-wiring."""
+    n, M, mb, D, V, T = 2, 4, 2, 16, 32, 8
+    B = M * mb
+    mesh = create_mesh(jax.devices()[:n], pipe=n)
+    stages, emb, head = _build(n, D, V, T, mb)
+
+    pipe = GluonPipeline(stages, mesh, _ce_loss, num_microbatches=M,
+                         embedding=emb, head=head)
+
+    k = jax.random.PRNGKey(5)
+    tokens = jax.random.randint(jax.random.fold_in(k, 2), (B, T), 0, V)
+    tgt = jax.random.randint(jax.random.fold_in(k, 3), (B, T), 0, V)
+
+    loss = float(pipe.train_step(NDArray(tokens), NDArray(tgt)).asnumpy())
+
+    # ---- sequential oracle over the SAME functionalized blocks ----
+    sfn, sraws0, _ = functionalize(stages[0])
+    rng = jax.random.PRNGKey(0)
+    stage_raws = [tuple(p._data_nd._data
+                        for p in pipe._stage_plists[i]) for i in range(n)]
+    efn, eraws, _ = functionalize(emb)
+    hfn, hraws, _ = functionalize(head)
+
+    def oracle(stage_params, eparams, hparams):
+        a, _ = efn(eparams, (), rng, tokens)
+        tot = 0.0
+        for m in range(M):
+            h = a[m * mb:(m + 1) * mb]
+            for i in range(n):
+                h, _ = sfn(stage_params[i], (), rng, h, training=False)
+            out, _ = hfn(hparams, (), rng, h)
+            tot = tot + _ce_loss(out, tgt[m * mb:(m + 1) * mb])
+        return tot / M
+
+    want_loss = oracle(tuple(stage_raws), eraws, hraws)
+    want_dstages, want_demb, want_dhead = jax.grad(
+        oracle, argnums=(0, 1, 2))(tuple(stage_raws), eraws, hraws)
+
+    onp.testing.assert_allclose(loss, float(want_loss), rtol=1e-5)
+    for i in range(n):
+        for p, w in zip(pipe._stage_plists[i], want_dstages[i]):
+            onp.testing.assert_allclose(
+                onp.asarray(p.grad()._data), onp.asarray(w),
+                rtol=1e-4, atol=1e-6, err_msg=f"stage {i} {p.name}")
+    for p, w in zip(pipe._head_params, want_dhead):
+        onp.testing.assert_allclose(onp.asarray(p.grad()._data),
+                                    onp.asarray(w), rtol=1e-4, atol=1e-6,
+                                    err_msg=f"head {p.name}")
+    emb_params = [p for p in emb.collect_params().values()
+                  if p.grad_req != "null"]
+    for p, w in zip(emb_params, want_demb):
+        onp.testing.assert_allclose(onp.asarray(p.grad()._data),
+                                    onp.asarray(w), rtol=1e-4, atol=1e-6,
+                                    err_msg=f"embedding {p.name}")
+
+
+def test_trainer_loop_loss_decreases():
+    """The three-line idiom end-to-end: GluonPipeline + gluon.Trainer,
+    loss decreases over steps (grads reach the update path)."""
+    n, M, mb, D, V, T = 2, 4, 4, 16, 32, 8
+    B = M * mb
+    mesh = create_mesh(jax.devices()[:n], pipe=n)
+    stages, emb, head = _build(n, D, V, T, mb, seed=1)
+
+    pipe = GluonPipeline(stages, mesh, _ce_loss, num_microbatches=M,
+                         embedding=emb, head=head)
+    trainer = gluon.Trainer(pipe.collect_params(), "adam",
+                            {"learning_rate": 2e-2})
+    k = jax.random.PRNGKey(7)
+    tokens = NDArray(jax.random.randint(k, (B, T), 0, V))
+    tgt = NDArray(jax.random.randint(jax.random.fold_in(k, 1), (B, T), 0, V))
+    losses = []
+    for _ in range(12):
+        losses.append(float(pipe.train_step(tokens, tgt).asnumpy()))
+        trainer.step(B)
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_stage_shape_mismatch_raises():
+    mesh = create_mesh(jax.devices()[:2], pipe=2)
+    a = gluon.nn.Dense(8); a.initialize(); a(NDArray(jnp.ones((2, 8))))
+    b = gluon.nn.Dense(4); b.initialize(); b(NDArray(jnp.ones((2, 8))))
+    try:
+        GluonPipeline([a, b], mesh, _ce_loss, num_microbatches=2)
+    except ValueError as e:
+        assert "identical stage architectures" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
