@@ -1,18 +1,22 @@
-"""Long-context causal LM with ring-attention sequence parallelism.
+"""Long-context causal LM with ring-attention sequence parallelism —
+THROUGH THE GLUON FRONTEND (r4: the SP doorway).
 
 The marquee TPU capability (SURVEY.md §5.7 — ABSENT in the reference,
-built first-class here): a decoder-only transformer whose sequence
-dimension is sharded over the `seq` mesh axis.  Each device holds
-T/seq tokens; KV blocks rotate around the ICI ring
+built first-class here): a decoder-only `models.TransformerLM` whose
+sequence dimension is sharded over the `seq` mesh axis.  Each device
+holds T/seq tokens; KV blocks rotate around the ICI ring
 (`parallel.ring.ring_attention`, double-buffered `lax.ppermute` with
 online-softmax accumulation), so NO device ever materializes the full
 (T, T) score matrix or the full sequence — context length scales
 linearly with the ring size.
 
-The whole train step (fwd + bwd + SGD) runs under one `shard_map` over
-a {data × seq} mesh: grads are `psum`-ed over both axes, the loss over
-the global batch.  Runs on the 8-virtual-CPU mesh in CI (tiny dims)
-and unchanged on a real slice.
+r3 drove this with a hand-written shard_map program; r4 needs three
+Gluon lines: `shard_params(net, mesh)` flips every causal attention to
+the ring (`MultiHeadAttention.set_seq_parallel`), inputs are placed
+P(data, seq), and the UNCHANGED Trainer loop trains the model.
+
+Runs on the 8-virtual-CPU mesh in CI (tiny dims) and unchanged on a
+real slice.
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
        python examples/nlp/long_context_lm.py --seq-len 2048 --steps 30
@@ -20,7 +24,6 @@ Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
 from __future__ import annotations
 
 import argparse
-import math
 import os
 import sys
 import time
@@ -45,98 +48,8 @@ def build_parser():
     return p
 
 
-def init_params(key, args):
-    import jax
-    import jax.numpy as jnp
-
-    V, D, H, F, L = (args.vocab, args.d_model, args.n_heads, args.d_ff,
-                     args.n_layers)
-    Dh = D // H
-    ks = jax.random.split(key, 6)
-    layer = lambda k, shape, scale: \
-        jax.random.normal(k, (L,) + shape, jnp.float32) * scale
-    return {
-        "embed": jax.random.normal(ks[0], (V, D), jnp.float32) * 0.02,
-        "pos": jax.random.normal(ks[1], (args.seq_len, D), jnp.float32) * 0.02,
-        "wqkv": layer(ks[2], (D, H, 3 * Dh), D ** -0.5),
-        "wo": layer(ks[3], (H, Dh, D), D ** -0.5),
-        "w1": layer(ks[4], (D, F), D ** -0.5),
-        "w2": layer(ks[5], (F, D), F ** -0.5),
-        "ln1": jnp.ones((L, D)), "ln2": jnp.ones((L, D)),
-        "lnf": jnp.ones((D,)),
-    }
-
-
-def make_train_step(mesh, args):
-    """One shard_map program: local fwd → ring attention → bwd → psum."""
-    import functools
-
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax import shard_map
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from incubator_mxnet_tpu.parallel.ring import ring_attention
-
-    H = args.n_heads
-    Dh = args.d_model // H
-    L = args.n_layers
-
-    def ln(x, g):
-        m = x.mean(-1, keepdims=True)
-        v = ((x - m) ** 2).mean(-1, keepdims=True)
-        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g
-
-    def local_loss(params, x, y):
-        # x, y: (B_local, T_local); positions are GLOBAL: offset by the
-        # seq-shard index so every ring rank embeds its own slice
-        Bl, Tl = x.shape
-        off = lax.axis_index("seq") * Tl
-        h = jnp.take(params["embed"], x, axis=0) \
-            + lax.dynamic_slice_in_dim(params["pos"], off, Tl, axis=0)[None]
-        for i in range(L):
-            a = ln(h, params["ln1"][i])
-            qkv = jnp.einsum("btd,dhx->bhtx", a, params["wqkv"][i])
-            q, k, v = jnp.split(qkv, 3, axis=-1)  # (B, H, T_local, Dh)
-            o = ring_attention(q, k, v, axis_name="seq", causal=True,
-                               scale=1.0 / math.sqrt(Dh))
-            h = h + jnp.einsum("bhtx,hxd->btd", o, params["wo"][i])
-            a = ln(h, params["ln2"][i])
-            h = h + jax.nn.gelu(a @ params["w1"][i]) @ params["w2"][i]
-        h = ln(h, params["lnf"])
-        logits = h @ params["embed"].T  # tied unembedding
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
-        return nll
-
-    def step(params, m, v, t, x, y):
-        loss, grads = jax.value_and_grad(local_loss)(params, x, y)
-        # params replicated over (data, seq): average grads over both
-        grads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(g, ("data", "seq")), grads)
-        loss = lax.pmean(loss, ("data", "seq"))
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        m = jax.tree_util.tree_map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
-        v = jax.tree_util.tree_map(lambda a, g: b2 * a + (1 - b2) * g * g,
-                                   v, grads)
-        corr = jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-        new_params = jax.tree_util.tree_map(
-            lambda p, mi, vi: p - args.lr * corr * mi / (jnp.sqrt(vi) + eps),
-            params, m, v)
-        return new_params, m, v, loss
-
-    pspec = P()               # replicated params/optimizer state
-    xspec = P("data", "seq")  # batch over data, sequence over the ring
-    fn = shard_map(step, mesh=mesh,
-                   in_specs=(pspec, pspec, pspec, P(), xspec, xspec),
-                   out_specs=(pspec, pspec, pspec, P()), check_vma=False)
-    return jax.jit(fn, donate_argnums=(0, 1, 2))
-
-
 def synthetic_batch(key, args, vocab):
-    """Induction task: each sample repeats a random pattern with period
-    STRIDE > T/seq_parallel, so predicting token t requires attending
+    """Periodic induction task: token t is predictable only by attending
     to t−STRIDE — across ring-shard boundaries."""
     import jax
     import jax.numpy as jnp
@@ -154,8 +67,14 @@ def main(argv=None):
     args = build_parser().parse_args(argv)
 
     import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from incubator_mxnet_tpu import parallel
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, gluon, parallel
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.parallel.sharding import shard_params
 
     n_needed = args.data_parallel * args.seq_parallel
     if len(jax.devices()) < n_needed:
@@ -166,22 +85,37 @@ def main(argv=None):
     assert args.seq_len % args.seq_parallel == 0
     assert args.batch_size % args.data_parallel == 0
 
-    import jax.numpy as jnp
+    mx.random.seed(0)
+    net = TransformerLM(vocab=args.vocab, units=args.d_model,
+                        hidden_size=args.d_ff, num_layers=args.n_layers,
+                        num_heads=args.n_heads, max_len=args.seq_len,
+                        dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.zeros((args.batch_size, args.seq_len), jnp.int32)))
+    # THE Gluon doorway: seq>1 mesh → every causal attention goes ring
+    report = shard_params(net, mesh, warn=False)
+    assert report.seq_parallel == args.n_layers, report.seq_parallel
+    net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    in_sh = NamedSharding(mesh, P("data", "seq"))
 
     key = jax.random.PRNGKey(0)
-    params = init_params(key, args)
-    m = jax.tree_util.tree_map(jnp.zeros_like, params)
-    v = jax.tree_util.tree_map(jnp.zeros_like, params)
-    step = make_train_step(mesh, args)
-
     losses = []
     t0 = time.time()
     for i in range(args.steps):
         key, kb = jax.random.split(key)
         x, y = synthetic_batch(kb, args, args.vocab)
-        params, m, v, loss = step(params, m, v, jnp.float32(i + 1), x, y)
+        x = NDArray(jax.device_put(x, in_sh))
+        y = NDArray(jax.device_put(y, in_sh))
+        with autograd.record():
+            L = loss_fn(net(x), y)
+        L.backward()
+        trainer.step(args.batch_size)
         if i % args.log_interval == 0 or i == args.steps - 1:
-            l = float(loss)
+            l = float(L.asnumpy().mean())
             losses.append(l)
             tok_s = args.batch_size * args.seq_len * (i + 1) / (time.time() - t0)
             print(f"step {i:4d} loss {l:.4f}  ({tok_s:,.0f} tok/s, "

@@ -15,7 +15,7 @@ from ...ndarray.ndarray import wrap
 from ... import ndarray as nd
 
 __all__ = ["SyncBatchNorm", "SparseEmbedding", "HybridConcurrent", "Concurrent",
-           "Identity"]
+           "Identity", "MoEFFN"]
 
 
 class SyncBatchNorm(_nn.BatchNorm):
@@ -53,3 +53,102 @@ class HybridConcurrent(_nn.HybridSequential):
 class Identity(HybridBlock):
     def forward(self, x):
         return wrap(x)
+
+
+class MoEFFN(HybridBlock):
+    """Mixture-of-Experts FFN — the Gluon doorway to expert parallelism
+    (r3 VERDICT item 5; EP machinery: `parallel.moe`, SURVEY.md §2.4).
+
+    Top-1/top-2 capacity routing (Switch/GShard) over ``num_experts``
+    expert FFNs.  Single-device: all experts run locally (the parity
+    oracle).  After ``set_expert_parallel(mesh)`` — called automatically
+    by ``parallel.sharding.shard_params`` when the mesh has an
+    ``expert`` axis > 1 — expert weights shard over that axis and
+    tokens ride `lax.all_to_all` dispatch/return inside the traced
+    step, trained by the unchanged Trainer.
+
+    ``forward(x)`` with x (B, T, D) returns ``(out, aux_loss)``: add
+    ``aux_weight * aux_loss`` to your loss (the Switch load-balancing
+    term) or routing collapses to one expert.
+    """
+
+    def __init__(self, units, hidden_size, num_experts,
+                 capacity_factor: float = 1.25, second_expert: bool = True,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._units = units
+        self._hidden = hidden_size
+        self._E = num_experts
+        self._cf = capacity_factor
+        self._second = second_expert
+        self._ep_mesh = None
+        self._ep_axis = "expert"
+        self.router_weight = self.params.get(
+            "router_weight", shape=(units, num_experts), dtype=dtype,
+            init="xavier")
+        self.expert_win = self.params.get(
+            "expert_win", shape=(num_experts, units, hidden_size),
+            dtype=dtype, init="xavier")
+        self.expert_wout = self.params.get(
+            "expert_wout", shape=(num_experts, hidden_size, units),
+            dtype=dtype, init="xavier")
+
+    def set_expert_parallel(self, mesh, axis_name: str = "expert"):
+        """Shard expert weights over ``axis_name`` and route tokens via
+        all_to_all.  ``mesh=None`` restores the local path."""
+        if mesh is not None:
+            if axis_name not in mesh.axis_names:
+                raise ValueError(
+                    f"set_expert_parallel: mesh has no '{axis_name}' axis "
+                    f"(axes: {mesh.axis_names})")
+            if self._E % mesh.shape[axis_name] != 0:
+                raise ValueError(
+                    f"set_expert_parallel: {self._E} experts not divisible "
+                    f"by {axis_name}={mesh.shape[axis_name]}")
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            for p in (self.expert_win, self.expert_wout):
+                if p._data_nd is not None:
+                    spec = P(axis_name, *([None] * (len(p.shape) - 1)))
+                    p.sharding = spec
+                    sh = NamedSharding(mesh, spec)
+                    p._data_nd._set_data(jax.device_put(p._data_nd._data, sh))
+                    if p._data_nd._grad is not None:
+                        p._data_nd._grad._data = jax.device_put(
+                            p._data_nd._grad._data, sh)
+        self._ep_mesh = mesh
+        self._ep_axis = axis_name
+        self._invalidate_cached_program()
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        from ...ndarray.ndarray import apply_op
+        from ...parallel import moe as _moe
+
+        x = wrap(x)
+        B, T, D = x.shape
+        mesh, axis = self._ep_mesh, self._ep_axis
+        E, cf, second = self._E, self._cf, self._second
+
+        def run(xr, rw, wi, wo):
+            if mesh is not None:
+                return _moe.moe_layer_sharded(
+                    xr, rw, (wi, wo), mesh, capacity_factor=cf,
+                    second_expert=second, axis_name=axis)
+            # local oracle: same routing math, all experts resident
+            x2 = xr.reshape(B * T, D)
+            capacity = max(1, int(cf * (B * T) / E))
+            dispatch, combine, aux = _moe.top2_gating(
+                x2 @ rw, capacity, second)
+            slots = jnp.einsum("tec,td->ecd", dispatch, x2)
+            h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", slots, wi))
+            y = jnp.einsum("ecf,efd->ecd", h, wo)
+            out = jnp.einsum("tec,ecd->td", combine, y)
+            return out.reshape(B, T, D), aux
+
+        return apply_op(run, x, self.router_weight.data(),
+                        self.expert_win.data(), self.expert_wout.data(),
+                        n_out=2)

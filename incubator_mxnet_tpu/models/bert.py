@@ -27,6 +27,8 @@ __all__ = ["BERTModel", "BERTEncoder", "BERTLayer", "MultiHeadAttention",
 
 
 class MultiHeadAttention(HybridBlock):
+    _causal_attn = False  # _CausalSelfAttention flips this
+
     def __init__(self, units, num_heads, dropout=0.0, use_flash=True, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
@@ -34,8 +36,30 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._dropout = dropout
         self._use_flash = use_flash
+        self._sp_mesh = None  # set via set_seq_parallel (shard_params)
+        self._sp_axis = "seq"
+        self._sp_data_axis = "data"
+        self._sp_impl = "flash"
         self.qkv = nn.Dense(3 * units, flatten=False, in_units=units)
         self.proj = nn.Dense(units, flatten=False, in_units=units)
+
+    def set_seq_parallel(self, mesh, axis_name: str = "seq",
+                         data_axis: str = "data", impl: str = "flash"):
+        """Route attention through ring sequence parallelism (SURVEY.md
+        §5.7).  Called automatically by `parallel.sharding.shard_params`
+        when the mesh has a >1 `seq` axis; callable directly too.  The
+        sequence dim of activations shards over ``axis_name`` and KV
+        blocks rotate the ICI ring — no device ever holds the full
+        sequence.  Pass ``mesh=None`` to restore dense attention."""
+        if mesh is not None and axis_name not in mesh.axis_names:
+            raise ValueError(f"set_seq_parallel: mesh has no '{axis_name}'"
+                             f" axis (axes: {mesh.axis_names})")
+        self._sp_mesh = mesh
+        self._sp_axis = axis_name
+        self._sp_data_axis = data_axis
+        self._sp_impl = impl
+        # a different attention program: drop compiled caches
+        self._invalidate_cached_program()
 
     def forward(self, x, mask=None):
         from ..ops.flash_attention import flash_attention
@@ -45,6 +69,32 @@ class MultiHeadAttention(HybridBlock):
         H = self._num_heads
         D = C // H
         qkv = self.qkv(x)  # (B, T, 3C)
+
+        if self._sp_mesh is not None:
+            if mask is not None:
+                raise NotImplementedError(
+                    "seq-parallel attention does not take a padding "
+                    "mask (shard-local masks are not wired yet) — pad "
+                    "sequences to the full length or disable SP")
+            from ..parallel import ring as _ring
+
+            mesh, axis = self._sp_mesh, self._sp_axis
+            daxis, impl = self._sp_data_axis, self._sp_impl
+            causal = self._causal_attn
+
+            def attend_sp(qkv_raw):
+                q, k, v = jnp.split(qkv_raw, 3, axis=-1)
+                q = q.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+                k = k.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+                v = v.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+                out = _ring.ring_attention_sharded(
+                    q, k, v, mesh, causal=causal, axis_name=axis,
+                    impl=impl, data_axis=daxis)
+                return out.transpose(0, 2, 1, 3).reshape(B, T, C)
+
+            from ..ndarray.ndarray import apply_op
+
+            return self.proj(apply_op(attend_sp, qkv))
 
         def attend(qkv_raw, *mask_raw):
             import jax

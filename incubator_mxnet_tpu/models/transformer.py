@@ -19,7 +19,8 @@ from ..ndarray.ndarray import NDArray, apply_op, wrap
 from .bert import MultiHeadAttention, PositionwiseFFN
 
 __all__ = ["Transformer", "TransformerEncoder", "TransformerDecoder",
-           "transformer_base", "transformer_big", "LabelSmoothedCELoss"]
+           "TransformerLM", "transformer_base", "transformer_big",
+           "LabelSmoothedCELoss"]
 
 
 def positional_encoding(T, C, dtype=jnp.float32):
@@ -33,9 +34,15 @@ def positional_encoding(T, C, dtype=jnp.float32):
 
 
 class _CausalSelfAttention(MultiHeadAttention):
+    _causal_attn = True
+
     def forward(self, x, mask=None):
         from ..ops.flash_attention import flash_attention
 
+        if self._sp_mesh is not None:
+            # ring-attention SP routing lives in the base class (the
+            # causal flag rides on _causal_attn)
+            return super().forward(x, mask)
         x = wrap(x)
         B, T, C = x.shape
         H, D = self._num_heads, C // self._num_heads
@@ -155,6 +162,60 @@ class TransformerDecoder(HybridBlock):
         for l in self._layers:
             x = l(x, mem, mem_mask)
         return self.ln(x)
+
+
+class _LMLayer(HybridBlock):
+    """Decoder-only layer: pre-LN causal self-attention + FFN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.attn = _CausalSelfAttention(units, num_heads, dropout)
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                   activation="gelu")
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        x = wrap(x)
+        x = x + self.drop(self.attn(self.ln1(x)))
+        return x + self.drop(self.ffn(self.ln2(x)))
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only (GPT-style) language model — the long-context
+    workhorse: on a mesh with seq>1 (`parallel.shard_params`), every
+    causal attention routes through ring sequence parallelism, so
+    context length scales linearly with the ring size (SURVEY.md §5.7).
+    """
+
+    def __init__(self, vocab=32000, units=512, hidden_size=2048,
+                 num_layers=6, num_heads=8, max_len=4096, dropout=0.1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_len = max_len
+        self.embed = nn.Embedding(vocab, units)
+        self._layers = []
+        for i in range(num_layers):
+            l = _LMLayer(units, hidden_size, num_heads, dropout)
+            setattr(self, f"layer{i}", l)
+            self._layers.append(l)
+        self.ln = nn.LayerNorm(in_channels=units)
+        self.head = nn.Dense(vocab, flatten=False, in_units=units)
+
+    def forward(self, tokens):
+        tokens = wrap(tokens)
+        T = tokens.shape[1]
+        if T > self._max_len:
+            raise ValueError(f"sequence {T} exceeds max_len {self._max_len}")
+        h = self.embed(tokens) * math.sqrt(self._units)
+        pe = positional_encoding(self._max_len, self._units)
+
+        h = apply_op(lambda r: r + pe[:T].astype(r.dtype), h)
+        for l in self._layers:
+            h = l(h)
+        return self.head(self.ln(h))
 
 
 class Transformer(HybridBlock):

@@ -20,10 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-from .serde import FLOAT, INT32, INT64, Graph, Model, Node, encode_model
+from .serde import (BFLOAT16, BOOL, FLOAT, INT32, INT64, Graph, Model,
+                    Node, encode_model)
 
 _NP2ONNX = {"float32": FLOAT, "int64": INT64, "int32": INT32,
-            "bool": INT32}
+            "bool": INT32, "bfloat16": BFLOAT16}
 
 
 class _Ctx:
@@ -46,9 +47,12 @@ class _Ctx:
         self.counter += 1
         return f"{hint}{self.counter}"
 
-    def add_const(self, arr: onp.ndarray, name=None) -> str:
+    def add_const(self, arr: onp.ndarray, name=None,
+                  keep_bool: bool = False) -> str:
         name = name or self.fresh("const")
-        if arr.dtype == onp.bool_:
+        if arr.dtype == onp.bool_ and not keep_bool:
+            # convention: booleans travel as INT32 through the graph —
+            # except where ONNX demands BOOL (Loop conditions)
             arr = arr.astype("int32")
         if arr.dtype == onp.float64:
             arr = arr.astype("float32")
@@ -293,6 +297,49 @@ def _translate_eqn(ctx: _Ctx, eqn):
         arr = arr.reshape(shape) * onp.ones(aval.shape, dtype=str(aval.dtype))
         ctx.names[outs[0]] = ctx.add_const(arr)
         return
+    if prim == "dynamic_slice":
+        from jax._src.core import Literal
+
+        operand = ins[0]
+        starts = ins[1:]
+        sizes = [int(s) for s in p["slice_sizes"]]
+        nd_ = len(sizes)
+        if all(isinstance(s, Literal) for s in starts):
+            st = [int(s.val) for s in starts]
+            starts_c = ctx.add_const(onp.asarray(st, "int64"))
+            ends_c = ctx.add_const(onp.asarray(
+                [a + b for a, b in zip(st, sizes)], "int64"))
+        else:
+            # runtime starts: Concat scalar tensors; ends = starts+sizes
+            parts = []
+            for s in starts:
+                nm = ctx.name_of(s)
+                parts.append(ctx.node(
+                    "Reshape", [nm, ctx.add_const(onp.asarray([1], "int64"))]))
+            starts_c = ctx.node("Concat", parts, attrs={"axis": 0}) \
+                if len(parts) > 1 else parts[0]
+            starts_c = ctx.node("Cast", [starts_c], attrs={"to": INT64})
+            ends_c = ctx.node(
+                "Add", [starts_c, ctx.add_const(onp.asarray(sizes, "int64"))])
+            axes_c = ctx.add_const(onp.arange(nd_, dtype="int64"))
+            # mx_slice_sizes: static sizes for shape-static import under
+            # jit (real ONNX consumers use the tensor inputs and may
+            # ignore the extra attribute)
+            ctx.node("Slice", [I(0), starts_c, ends_c, axes_c],
+                     attrs={"mx_slice_sizes": sizes}, outputs=[O()])
+            return
+        axes_c = ctx.add_const(onp.arange(nd_, dtype="int64"))
+        ctx.node("Slice", [I(0), starts_c, ends_c, axes_c], outputs=[O()])
+        return
+    if prim == "scan":
+        _translate_scan(ctx, eqn)
+        return
+    if prim == "while":
+        _translate_while(ctx, eqn)
+        return
+    if prim == "cond":
+        _translate_cond(ctx, eqn)
+        return
     if prim in ("pjit", "jit", "closed_call", "core_call", "custom_jvp_call",
                 "custom_vjp_call", "custom_jvp_call_jaxpr", "remat",
                 "checkpoint", "custom_vjp_call_jaxpr"):
@@ -330,6 +377,176 @@ def _translate_eqn(ctx: _Ctx, eqn):
         return
     raise NotImplementedError(
         f"ONNX export: no mapping for jax primitive {prim!r}")
+
+
+def _aval_onnx_dtype(aval) -> int:
+    return _NP2ONNX.get(str(aval.dtype), FLOAT)
+
+
+def _inline_jaxpr(ctx, inner, consts, arg_names):
+    """Translate `inner`'s equations into the CURRENT graph with invars
+    bound to `arg_names` (a fresh name scope, like the pjit branch).
+    Returns the output tensor names."""
+    from jax._src.core import Literal
+
+    saved = ctx.names
+    ctx.names = {}
+    for iv, nm in zip(inner.invars, arg_names):
+        ctx.names[iv] = nm
+    for cv, c in zip(inner.constvars, consts):
+        ctx.names[cv] = ctx.add_const(onp.asarray(c))
+    live_out = [v for v in inner.outvars if not isinstance(v, Literal)]
+    for sub_eqn in _live_eqns(inner, live_out):
+        _translate_eqn(ctx, sub_eqn)
+    outs = [ctx.name_of(ov) for ov in inner.outvars]
+    ctx.names = saved
+    return outs
+
+
+def _translate_scan(ctx, eqn):
+    """`lax.scan` → ONNX Loop: consts captured lexically, xs gathered at
+    the iteration index inside the body, ys become Loop scan-outputs
+    (reversed afterwards for reverse=True)."""
+    p = eqn.params
+    closed = p["jaxpr"]
+    inner = closed.jaxpr
+    nc, ncar = p["num_consts"], p["num_carry"]
+    length, reverse = int(p["length"]), bool(p["reverse"])
+    ins, outs = eqn.invars, eqn.outvars
+    const_names = [ctx.name_of(v) for v in ins[:nc]]
+    carry_names = [ctx.name_of(v) for v in ins[nc:nc + ncar]]
+    xs_names = [ctx.name_of(v) for v in ins[nc + ncar:]]
+
+    body = Graph(ctx.fresh("scan_body"))
+    saved_g, saved_names = ctx.g, ctx.names
+    ctx.g, ctx.names = body, {}
+    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond")
+    body.inputs.append((iter_nm, (), INT64))
+    body.inputs.append((cond_nm, (), BOOL))
+    carry_nms = []
+    for v in inner.invars[nc:nc + ncar]:
+        nm = ctx.fresh("carry")
+        body.inputs.append((nm, tuple(v.aval.shape), _aval_onnx_dtype(v.aval)))
+        carry_nms.append(nm)
+    idx = iter_nm
+    if reverse:
+        last = ctx.add_const(onp.asarray(length - 1, "int64"))
+        idx = ctx.node("Sub", [last, iter_nm])
+    x_nms = [ctx.node("Gather", [xs_nm, idx], attrs={"axis": 0})
+             for xs_nm in xs_names]
+    # const invars capture the OUTER names lexically; carries/xs bind to
+    # the body-local names built above — one shared inlining contract
+    out_names = _inline_jaxpr(ctx, inner, closed.consts,
+                              const_names + carry_nms + x_nms)
+    cond_out = ctx.node("Identity", [cond_nm])
+    body.outputs.append((cond_out, (), BOOL))
+    for nm, ov in zip(out_names[:ncar], inner.outvars[:ncar]):
+        body.outputs.append((nm, tuple(ov.aval.shape),
+                             _aval_onnx_dtype(ov.aval)))
+    for nm, ov in zip(out_names[ncar:], inner.outvars[ncar:]):
+        body.outputs.append((nm, tuple(ov.aval.shape),
+                             _aval_onnx_dtype(ov.aval)))
+    ctx.g, ctx.names = saved_g, saved_names
+
+    trip = ctx.add_const(onp.asarray(length, "int64"))
+    cond0 = ctx.add_const(onp.asarray(True), keep_bool=True)
+    loop_outs = [ctx.names.setdefault(o, ctx.fresh("scan"))
+                 for o in outs]
+    raw_y_outs = loop_outs[ncar:]
+    if reverse and raw_y_outs:
+        # ONNX stacks scan-outputs in ITERATION order; jax stacks ys at
+        # their xs positions — un-reverse after the Loop
+        raw_y_outs = [ctx.fresh("yrev") for _ in raw_y_outs]
+    ctx.g.nodes.append(Node("Loop", [trip, cond0] + carry_names,
+                            loop_outs[:ncar] + raw_y_outs,
+                            attrs={"body": body}))
+    if reverse and loop_outs[ncar:]:
+        ridx = ctx.add_const(onp.arange(length - 1, -1, -1, dtype="int64"))
+        for rev_nm, final_nm in zip(raw_y_outs, loop_outs[ncar:]):
+            ctx.node("Gather", [rev_nm, ridx], attrs={"axis": 0},
+                     outputs=[final_nm])
+
+
+def _translate_while(ctx, eqn):
+    """`lax.while_loop` → ONNX Loop: the initial condition is evaluated
+    in the outer graph; the body re-evaluates it on the NEW carry (exact
+    check-before-iterate semantics)."""
+    p = eqn.params
+    cond_closed, body_closed = p["cond_jaxpr"], p["body_jaxpr"]
+    ncc, nbc = p["cond_nconsts"], p["body_nconsts"]
+    ins, outs = eqn.invars, eqn.outvars
+    cconst = [ctx.name_of(v) for v in ins[:ncc]]
+    bconst = [ctx.name_of(v) for v in ins[ncc:ncc + nbc]]
+    init = [ctx.name_of(v) for v in ins[ncc + nbc:]]
+    carry_vars = ins[ncc + nbc:]
+
+    c0 = _inline_jaxpr(ctx, cond_closed.jaxpr, cond_closed.consts,
+                       cconst + init)[0]
+    c0 = ctx.node("Cast", [c0], attrs={"to": BOOL})
+
+    body = Graph(ctx.fresh("while_body"))
+    saved_g, saved_names = ctx.g, ctx.names
+    ctx.g, ctx.names = body, {}
+    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond")
+    body.inputs.append((iter_nm, (), INT64))
+    body.inputs.append((cond_nm, (), BOOL))
+    carry_nms = []
+    for v in carry_vars:
+        nm = ctx.fresh("carry")
+        body.inputs.append((nm, tuple(v.aval.shape), _aval_onnx_dtype(v.aval)))
+        carry_nms.append(nm)
+    new_carry = _inline_jaxpr(ctx, body_closed.jaxpr, body_closed.consts,
+                              bconst + carry_nms)
+    c_next = _inline_jaxpr(ctx, cond_closed.jaxpr, cond_closed.consts,
+                           cconst + new_carry)[0]
+    c_next = ctx.node("Cast", [c_next], attrs={"to": BOOL})
+    body.outputs.append((c_next, (), BOOL))
+    for nm, v in zip(new_carry, carry_vars):
+        body.outputs.append((nm, tuple(v.aval.shape),
+                             _aval_onnx_dtype(v.aval)))
+    ctx.g, ctx.names = saved_g, saved_names
+
+    loop_outs = [ctx.names.setdefault(o, ctx.fresh("while")) for o in outs]
+    ctx.g.nodes.append(Node("Loop", ["", c0] + init, loop_outs,
+                            attrs={"body": body}))
+
+
+def _translate_cond(ctx, eqn):
+    """`lax.cond`/`lax.switch` with two branches → ONNX If; branch
+    subgraphs capture the operands lexically (no subgraph inputs)."""
+    p = eqn.params
+    branches = p["branches"]
+    if len(branches) != 2:
+        raise NotImplementedError(
+            f"ONNX export: lax.switch with {len(branches)} branches has "
+            f"no If mapping (only 2-way cond is supported)")
+    ins, outs = eqn.invars, eqn.outvars
+    pred = ctx.name_of(ins[0])
+    op_names = [ctx.name_of(v) for v in ins[1:]]
+    pred_b = ctx.node("Cast", [pred], attrs={"to": BOOL})
+
+    def branch_graph(closed, tag):
+        g = Graph(ctx.fresh(tag))
+        saved_g, saved_names = ctx.g, ctx.names
+        ctx.g, ctx.names = g, {}
+        out_nms = _inline_jaxpr(ctx, closed.jaxpr, closed.consts, op_names)
+        # If-branch outputs may be captured outer tensors directly —
+        # ONNX requires branch outputs be produced IN the branch
+        final = []
+        for nm, ov in zip(out_nms, closed.jaxpr.outvars):
+            inner_nm = ctx.node("Identity", [nm])
+            g.outputs.append((inner_nm, tuple(ov.aval.shape),
+                              _aval_onnx_dtype(ov.aval)))
+            final.append(inner_nm)
+        ctx.g, ctx.names = saved_g, saved_names
+        return g
+
+    else_g = branch_graph(branches[0], "else_branch")
+    then_g = branch_graph(branches[1], "then_branch")
+    if_outs = [ctx.names.setdefault(o, ctx.fresh("if")) for o in outs]
+    ctx.g.nodes.append(Node("If", [pred_b], if_outs,
+                            attrs={"then_branch": then_g,
+                                   "else_branch": else_g}))
 
 
 def _live_eqns(jx, live_out):

@@ -17,7 +17,8 @@ from .serde import decode_model
 
 __all__ = ["import_model", "ONNXModel"]
 
-_ONNX2NP = {1: "float32", 6: "int32", 7: "int64", 9: "bool"}
+_ONNX2NP = {1: "float32", 6: "int32", 7: "int64", 9: "bool",
+            16: "bfloat16"}
 
 
 def _run_node(node, env):
@@ -79,6 +80,15 @@ def _run_node(node, env):
     elif op == "Concat":
         out(jnp.concatenate(x, axis=a["axis"]))
     elif op == "Slice":
+        import jax.core as _jcore
+
+        if isinstance(x[1], _jcore.Tracer) and "mx_slice_sizes" in a:
+            # runtime starts (dynamic_slice export): sizes ride a static
+            # attribute so the import stays shape-static under jit
+            sizes = [int(s) for s in a["mx_slice_sizes"]]
+            starts = [x[1][i] for i in range(len(sizes))]
+            out(jax.lax.dynamic_slice(x[0], starts, sizes))
+            return
         starts = onp.asarray(x[1]).tolist()
         ends = onp.asarray(x[2]).tolist()
         axes = onp.asarray(x[3]).tolist() if len(x) > 3 else list(range(len(starts)))
@@ -151,8 +161,103 @@ def _run_node(node, env):
         for name, piece in zip(node.outputs, pieces):
             env[name] = piece
         return
+    elif op == "Loop":
+        _run_loop(node, env)
+        return
+    elif op == "If":
+        _run_if(node, env)
+        return
     else:
         raise NotImplementedError(f"ONNX import: unsupported op {op!r}")
+
+
+def _run_subgraph(g, env, bindings):
+    """Execute a subgraph with ONNX lexical scoping: outer `env` is
+    visible; subgraph initializers and `bindings` shadow it."""
+    benv = dict(env)
+    for k, v in g.initializers.items():
+        # keep initializers as NUMPY: jnp.asarray of an int64 const
+        # INSIDE an active trace (x64 off) inserts a convert op and the
+        # "constant" becomes a tracer — breaking static extraction of
+        # axes/shape operands
+        benv[k] = onp.asarray(v)
+    benv.update(bindings)
+    for nd_ in g.nodes:
+        _run_node(nd_, benv)
+    return benv
+
+
+def _run_loop(node, env):
+    """ONNX Loop (as the exporter emits it): a trip-count Loop with a
+    constant-true condition (lax.scan) runs as lax.scan; a dynamic-
+    condition Loop with no scan outputs (lax.while_loop) runs as
+    lax.while_loop."""
+    from jax import lax
+
+    body = node.attrs["body"]
+    in_names = node.inputs
+    M = env[in_names[0]] if in_names[0] else None
+    cond0 = env[in_names[1]].astype(bool).reshape(()) if in_names[1] \
+        else jnp.asarray(True)
+    carried = [env[nm] for nm in in_names[2:]]
+    n_carry = len(carried)
+    b_in = [n for n, _s, _d in body.inputs]
+    b_out = [n for n, _s, _d in body.outputs]
+    n_scan = len(b_out) - 1 - n_carry
+
+    def step(i, cond, carry):
+        benv = _run_subgraph(
+            body, env,
+            {b_in[0]: i.astype(jnp.int64), b_in[1]: cond,
+             **dict(zip(b_in[2:], carry))})
+        return (benv[b_out[0]].astype(bool).reshape(()),
+                [benv[n] for n in b_out[1:1 + n_carry]],
+                [benv[n] for n in b_out[1 + n_carry:]])
+
+    if n_scan == 0 and M is None:
+        # while-style: dynamic condition, no scan outputs
+        def cond_fn(state):
+            return state[0]
+
+        def body_fn(state):
+            _c, i, carry = state
+            c2, carry2, _ = step(i, _c, list(carry))
+            return (c2, i + 1, tuple(carry2))
+
+        _c, _i, final = lax.while_loop(
+            cond_fn, body_fn, (cond0, jnp.int64(0), tuple(carried)))
+        for nm, v in zip(node.outputs, final):
+            env[nm] = v
+        return
+    # trip-count style (lax.scan export): condition is constant-true
+    trip = int(onp.asarray(M).reshape(-1)[0])
+
+    def scan_body(carry, i):
+        _c, carry2, ys = step(i, jnp.asarray(True), list(carry))
+        return tuple(carry2), tuple(ys)
+
+    final, ys = lax.scan(scan_body, tuple(carried),
+                         jnp.arange(trip, dtype=jnp.int64))
+    for nm, v in zip(node.outputs, list(final) + list(ys)):
+        env[nm] = v
+
+
+def _run_if(node, env):
+    from jax import lax
+
+    then_g = node.attrs["then_branch"]
+    else_g = node.attrs["else_branch"]
+    pred = env[node.inputs[0]].astype(bool).reshape(())
+
+    def rung(g):
+        def f(_):
+            benv = _run_subgraph(g, env, {})
+            return tuple(benv[n] for n, _s, _d in g.outputs)
+        return f
+
+    outs = lax.cond(pred, rung(then_g), rung(else_g), 0)
+    for nm, v in zip(node.outputs, outs):
+        env[nm] = v
 
 
 class ONNXModel:

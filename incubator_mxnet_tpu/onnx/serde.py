@@ -9,7 +9,11 @@ the exporter emits (SURVEY.md §2.6 "ONNX", ref `python/mxnet/onnx/`
   OperatorSetId: domain=1, version=2
   GraphProto:    node=1, name=2, initializer=5, input=11, output=12
   NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5
-  AttributeProto:name=1, f=2, i=3, s=4, floats=6, ints=7, type=20
+  AttributeProto:name=1, f=2, i=3, s=4, t=5, g=6, floats=7, ints=8,
+                 type=20  (NOTE: the repeated floats/ints FIELDS are 7/8;
+                 the AttributeType ENUM values are FLOATS=6/INTS=7 — r3
+                 conflated them, emitting floats/ints at fields 6/7,
+                 which real ONNX consumers would misread as g/floats)
   TensorProto:   dims=1, data_type=2, name=8, raw_data=9
   ValueInfoProto:name=1, type=2 / TypeProto.tensor_type=1 /
   Tensor.elem_type=1, shape=2 / TensorShapeProto.dim=1 / Dim.dim_value=1
@@ -28,16 +32,21 @@ import numpy as onp
 FLOAT = 1
 INT64 = 7
 INT32 = 6
+BOOL = 9
+BFLOAT16 = 16
 
 # AttributeProto.AttributeType
 ATTR_FLOAT = 1
 ATTR_INT = 2
 ATTR_STRING = 3
+ATTR_GRAPH = 5
 ATTR_FLOATS = 6
 ATTR_INTS = 7
 
-_NP_TO_ONNX = {"float32": FLOAT, "int64": INT64, "int32": INT32}
-_ONNX_TO_NP = {FLOAT: "float32", INT64: "int64", INT32: "int32"}
+_NP_TO_ONNX = {"float32": FLOAT, "int64": INT64, "int32": INT32,
+               "bool": BOOL, "bfloat16": BFLOAT16}
+_ONNX_TO_NP = {FLOAT: "float32", INT64: "int64", INT32: "int32",
+               BOOL: "bool", BFLOAT16: "bfloat16"}
 
 
 # ---------------------------------------------------------------------- #
@@ -152,7 +161,9 @@ class Model:
 # encoding
 # ---------------------------------------------------------------------- #
 def _encode_tensor(name: str, arr: onp.ndarray) -> bytes:
-    arr = onp.ascontiguousarray(arr)
+    # NB: ascontiguousarray promotes 0-d to 1-d — restore the true rank
+    # or scalar initializers silently export as shape (1,)
+    arr = onp.ascontiguousarray(arr).reshape(onp.shape(arr))
     dt = _NP_TO_ONNX.get(str(arr.dtype))
     if dt is None:
         arr = arr.astype("float32")
@@ -183,13 +194,16 @@ def _encode_attr(name: str, value) -> bytes:
         out += _float_field(2, value) + _int_field(20, ATTR_FLOAT)
     elif isinstance(value, str):
         out += _len_delim(4, value.encode()) + _int_field(20, ATTR_STRING)
+    elif isinstance(value, Graph):
+        out += _len_delim(6, _encode_graph(value)) \
+            + _int_field(20, ATTR_GRAPH)
     elif isinstance(value, (list, tuple)) and value and isinstance(value[0], float):
         for v in value:
-            out += _float_field(6, float(v))
+            out += _float_field(7, float(v))
         out += _int_field(20, ATTR_FLOATS)
     elif isinstance(value, (list, tuple)):
         for v in value:
-            out += _int_field(7, int(v))
+            out += _int_field(8, int(v))
         out += _int_field(20, ATTR_INTS)
     else:
         raise TypeError(f"unsupported attribute {name}={value!r}")
@@ -209,8 +223,7 @@ def _encode_node(n: Node) -> bytes:
     return out
 
 
-def encode_model(model: Model) -> bytes:
-    g = model.graph
+def _encode_graph(g: Graph) -> bytes:
     gb = b""
     for n in g.nodes:
         gb += _len_delim(1, _encode_node(n))
@@ -221,6 +234,11 @@ def encode_model(model: Model) -> bytes:
         gb += _len_delim(11, _encode_value_info(name, shape, dt))
     for name, shape, dt in g.outputs:
         gb += _len_delim(12, _encode_value_info(name, shape, dt))
+    return gb
+
+
+def encode_model(model: Model) -> bytes:
+    gb = _encode_graph(model.graph)
     opset = _str_field(1, "") + _int_field(2, model.opset)
     out = _int_field(1, 8)  # ir_version 8
     out += _str_field(2, model.producer)
@@ -282,6 +300,7 @@ def _decode_value_info(buf: bytes):
 def _decode_attr(buf: bytes):
     r = _Reader(buf)
     name, val, typ = "", None, None
+    graph_val = None
     floats, ints = [], []
     while not r.eof():
         f, v = r.field()
@@ -294,8 +313,10 @@ def _decode_attr(buf: bytes):
         elif f == 4:
             val = v.decode()
         elif f == 6:
-            floats.append(v)
+            graph_val = _decode_graph(v)
         elif f == 7:
+            floats.append(v)
+        elif f == 8:
             ints.append(v)
         elif f == 20:
             typ = v
@@ -303,7 +324,28 @@ def _decode_attr(buf: bytes):
         val = floats
     elif typ == ATTR_INTS:
         val = ints
+    elif typ == ATTR_GRAPH:
+        val = graph_val
     return name, val
+
+
+def _decode_graph(buf: bytes) -> Graph:
+    graph = Graph()
+    gr = _Reader(buf)
+    while not gr.eof():
+        gf, gv = gr.field()
+        if gf == 1:
+            graph.nodes.append(_decode_node(gv))
+        elif gf == 2:
+            graph.name = gv.decode()
+        elif gf == 5:
+            name, arr = _decode_tensor(gv)
+            graph.initializers[name] = arr
+        elif gf == 11:
+            graph.inputs.append(_decode_value_info(gv))
+        elif gf == 12:
+            graph.outputs.append(_decode_value_info(gv))
+    return graph
 
 
 def _decode_node(buf: bytes) -> Node:
@@ -335,20 +377,7 @@ def decode_model(buf: bytes) -> Model:
         if f == 2:
             producer = v.decode()
         elif f == 7:
-            gr = _Reader(v)
-            while not gr.eof():
-                gf, gv = gr.field()
-                if gf == 1:
-                    graph.nodes.append(_decode_node(gv))
-                elif gf == 2:
-                    graph.name = gv.decode()
-                elif gf == 5:
-                    name, arr = _decode_tensor(gv)
-                    graph.initializers[name] = arr
-                elif gf == 11:
-                    graph.inputs.append(_decode_value_info(gv))
-                elif gf == 12:
-                    graph.outputs.append(_decode_value_info(gv))
+            graph = _decode_graph(v)
         elif f == 8:
             orr = _Reader(v)
             while not orr.eof():

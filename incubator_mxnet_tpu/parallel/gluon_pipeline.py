@@ -117,7 +117,9 @@ class GluonPipeline:
                     f"from stage 0's {shapes0} — 1F1B requires identical "
                     f"stage architectures")
         self._stage_fn_raw = fns[0]
+        self._stage_fns = fns
         self._stage_plists = plists
+        self._programs_checked = False
 
         self._head_params: List = []
         self._head_fn = None
@@ -146,7 +148,14 @@ class GluonPipeline:
         recompute = self._recompute
         train_mode = self._train_mode
 
-        def step(stacked, head_params, x_raw, t_raw, rng):
+        def step(per_stage, head_params, x_raw, t_raw, rng):
+            # stack INSIDE the jit: XLA fuses it into the program; an
+            # eager stack would pay per-step dispatches and a duplicate
+            # copy of all stage weights (r4 review)
+            stacked = tuple(
+                jnp.stack([ps[j] for ps in per_stage])
+                for j in range(len(per_stage[0])))
+
             def stage_fn(params, a):
                 out, _ = stage_fn_raw(params, (), rng, a,
                                       training=train_mode)
@@ -187,6 +196,34 @@ class GluonPipeline:
         return pd
 
     # ------------------------------------------------------------------ #
+    def _check_stage_programs(self, per_stage, x_raw, rng):
+        """Same parameter SHAPES do not imply the same PROGRAM (e.g.
+        num_heads or activation differ without changing any shape) —
+        1F1B runs stage 0's traced program with every stage's weights,
+        so verify each stage functionalizes to the identical jaxpr
+        (once, at the first step)."""
+        if self._programs_checked:
+            return
+        mb_shape = (x_raw.shape[0] // self._M,) + tuple(x_raw.shape[1:])
+        x_s = jax.ShapeDtypeStruct(mb_shape, x_raw.dtype)
+        train = self._train_mode
+        ref = None
+        for i, (fn, raws) in enumerate(zip(self._stage_fns, per_stage)):
+            jxp = str(jax.make_jaxpr(
+                lambda p, a, fn=fn: fn(p, (), rng, a, training=train))(
+                    raws, x_s))
+            if ref is None:
+                ref = jxp
+            elif jxp != ref:
+                raise ValueError(
+                    f"GluonPipeline: stage {i} traces to a DIFFERENT "
+                    f"program than stage 0 despite identical parameter "
+                    f"shapes (e.g. num_heads/activation mismatch) — "
+                    f"1F1B would silently run stage 0's program with "
+                    f"stage {i}'s weights. Make the architectures "
+                    f"identical.")
+        self._programs_checked = True
+
     def train_step(self, x, targets):
         """One 1F1B step: fwd+bwd over num_microbatches, grads written
         into every Parameter's .grad().  Returns the mean loss as an
@@ -198,10 +235,8 @@ class GluonPipeline:
 
         rng = _random.next_key()
 
-        stacked = tuple(
-            jnp.stack([pl[j]._data_nd._data
-                       for pl in self._stage_plists])
-            for j in range(len(self._stage_plists[0])))
+        per_stage = tuple(tuple(p._data_nd._data for p in pl)
+                          for pl in self._stage_plists)
         hp = tuple(p._data_nd._data for p in self._head_params)
 
         t_raw = targets._data if isinstance(targets, NDArray) \
@@ -219,7 +254,8 @@ class GluonPipeline:
             emb_out = None
             x_raw = x._data if isinstance(x, NDArray) else jnp.asarray(x)
 
-        out = self._jit_step(stacked, hp, x_raw, t_raw, rng)
+        self._check_stage_programs(per_stage, x_raw, rng)
+        out = self._jit_step(per_stage, hp, x_raw, t_raw, rng)
 
         loss, grads = out[0], out[1]
         k = 2

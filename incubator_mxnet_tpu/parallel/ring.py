@@ -143,12 +143,20 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = False,
                            scale: Optional[float] = None, axis_name: str = "seq",
-                           impl: str = "flash"):
+                           impl: str = "flash", data_axis: Optional[str] = None):
     """Top-level entry: q,k,v are (B, H, T, D) global arrays; shards T
-    over `axis_name` and runs the ring under shard_map."""
+    over `axis_name` and runs the ring under shard_map.
+
+    ``data_axis``: also shard the batch dim over this mesh axis (pass
+    "data" when composing SP with DP — otherwise the batch would
+    replicate across the data axis inside the attention region).  The
+    ring collectives only span `axis_name`, so the data axis rides
+    along for free."""
     from jax import shard_map
 
-    spec = P(None, None, axis_name, None)
+    b = data_axis if data_axis and data_axis in mesh.axis_names \
+        and mesh.shape[data_axis] > 1 else None
+    spec = P(b, None, axis_name, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                           scale=scale, impl=impl),

@@ -75,6 +75,8 @@ class ShardingReport(dict):
         self.replicated: Dict[str, str] = {}
         self.fallbacks: Dict[str, Tuple[P, str]] = {}
         self.unmatched: List[str] = []
+        self.seq_parallel = 0  # attention blocks routed to ring SP
+        self.expert_parallel = 0  # MoE blocks routed to all_to_all EP
         self._elems_sharded = 0
         self._elems_matrix = 0
 
@@ -202,8 +204,46 @@ def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None,
             warnings.warn("shard_params: TP axes requested but NO parameter "
                           "was sharded (model would train fully replicated) —\n"
                           + report.summary(), stacklevel=2)
+    # sequence parallelism: a >1 `seq` axis routes every attention block
+    # with a set_seq_parallel hook through ring attention (SURVEY.md
+    # §5.7 — the Gluon doorway to SP)
+    if "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+        report.seq_parallel = _enable_hook(block, "set_seq_parallel", mesh)
+        log.info("shard_params: seq=%d — ring attention enabled on %d "
+                 "attention block(s)", mesh.shape["seq"],
+                 report.seq_parallel)
+    # expert parallelism: a >1 `expert` axis shards MoE expert weights
+    # and routes tokens via all_to_all (gluon.contrib.MoEFFN)
+    if "expert" in mesh.axis_names and mesh.shape["expert"] > 1:
+        report.expert_parallel = _enable_hook(
+            block, "set_expert_parallel", mesh)
+        log.info("shard_params: expert=%d — all_to_all dispatch enabled "
+                 "on %d MoE block(s)", mesh.shape["expert"],
+                 report.expert_parallel)
     log.info(report.summary())
     return report
+
+
+def _enable_hook(block, method: str, mesh: Mesh) -> int:
+    """Walk the Block tree calling ``method(mesh)`` on every child that
+    exposes it (e.g. MultiHeadAttention.set_seq_parallel,
+    MoEFFN.set_expert_parallel).  Returns the count."""
+    n = 0
+    seen = set()
+
+    def walk(b):
+        nonlocal n
+        if id(b) in seen:
+            return
+        seen.add(id(b))
+        if hasattr(b, method):
+            getattr(b, method)(mesh)
+            n += 1
+        for child in getattr(b, "_children", {}).values():
+            walk(child)
+
+    walk(block)
+    return n
 
 
 def _nelems(shape) -> int:

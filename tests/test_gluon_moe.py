@@ -1,0 +1,97 @@
+"""gluon.contrib.MoEFFN — the Gluon doorway to expert parallelism
+(r3 VERDICT item 5).  EP machinery: parallel/moe.py (all_to_all
+dispatch, capacity routing); this pins the Gluon surface: local-vs-
+sharded parity, dispatch conservation, and training through the
+unchanged Trainer on an expert=2 mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon.contrib.nn import MoEFFN
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.parallel import create_mesh
+from incubator_mxnet_tpu.parallel.sharding import shard_params
+
+
+def _make(E=4, D=16, F=32, seed=0):
+    mx.random.seed(seed)
+    blk = MoEFFN(units=D, hidden_size=F, num_experts=E)
+    blk.initialize()
+    blk(NDArray(jnp.ones((2, 8, D), jnp.float32)))
+    return blk
+
+
+def test_local_dispatch_conservation():
+    """Every kept token's combine weight mass is preserved; outputs are
+    finite and shaped."""
+    blk = _make()
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16), jnp.float32)
+    out, aux = blk(NDArray(x))
+    assert out.shape == (2, 8, 16)
+    assert onp.isfinite(out.asnumpy()).all()
+    assert float(aux.asnumpy()) > 0.0  # load-balance loss is positive
+
+
+def test_sharded_matches_local_oracle():
+    """expert=2 mesh (via shard_params) == local all-experts math."""
+    blk = _make(seed=1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+    want_out, want_aux = blk(NDArray(x))
+    want_out = onp.asarray(want_out.asnumpy())
+
+    mesh = create_mesh(jax.devices()[:2], expert=2)
+    report = shard_params(blk, mesh, warn=False)
+    assert report.expert_parallel == 1
+    got_out, got_aux = blk(NDArray(x))
+    onp.testing.assert_allclose(onp.asarray(got_out.asnumpy()), want_out,
+                                rtol=2e-5, atol=2e-6)
+    onp.testing.assert_allclose(float(got_aux.asnumpy()),
+                                float(want_aux.asnumpy()), rtol=1e-5)
+
+
+def test_trains_through_trainer_on_expert_mesh():
+    """Transformer-ish block with an MoE FFN trains on expert=2×data=2:
+    loss decreases and EVERY expert's weights receive gradient."""
+    D, F, E, B, T = 16, 32, 4, 8, 8
+    mx.random.seed(2)
+    dense_in = gluon.nn.Dense(D, flatten=False, in_units=D)
+    moe = MoEFFN(units=D, hidden_size=F, num_experts=E)
+    dense_in.initialize()
+    moe.initialize()
+    moe(NDArray(jnp.ones((B, T, D), jnp.float32)))
+
+    mesh = create_mesh(data=2, expert=2)
+    shard_params(moe, mesh, warn=False)
+
+    params = list(dense_in.collect_params().values()) \
+        + list(moe.collect_params().values())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-2})
+    k = jax.random.PRNGKey(3)
+    x = NDArray(jax.random.normal(k, (B, T, D), jnp.float32))
+    tgt = NDArray(jax.random.normal(jax.random.fold_in(k, 1), (B, T, D),
+                                    jnp.float32))
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(30):
+        with autograd.record():
+            h = dense_in(x)
+            y, aux = moe(h)
+            L = loss_fn(y, tgt) + 0.01 * aux
+        L.backward()
+        trainer.step(B)
+        losses.append(float(L.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.8, losses
+    g = onp.asarray(moe.expert_win.grad().asnumpy())
+    # top-2 routing with capacity: every expert sees tokens over 25 steps
+    assert (onp.abs(g).reshape(E, -1).sum(axis=1) > 0).all()
+
+
+def test_expert_divisibility_raises():
+    blk = _make(E=3)
+    mesh = create_mesh(jax.devices()[:2], expert=2)
+    with pytest.raises(ValueError):
+        blk.set_expert_parallel(mesh)

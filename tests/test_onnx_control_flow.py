@@ -1,0 +1,159 @@
+"""ONNX control-flow export (r3 VERDICT item 6): lax.scan/while/cond →
+ONNX Loop/If, so the lax.scan-based RNN zoo exports; plus BFLOAT16
+initializers and the serde attribute-field fix (floats/ints live at
+proto fields 7/8 — r3 emitted them at 6/7, colliding with the graph
+attr field every real consumer reads).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.onnx.export_model import export_block, export_jaxpr
+from incubator_mxnet_tpu.onnx.import_model import ONNXModel
+from incubator_mxnet_tpu.onnx.serde import (
+    ATTR_GRAPH, BFLOAT16, decode_model, encode_model)
+from jax import lax
+
+
+def _roundtrip(f, *args, names=None):
+    names = names or [f"x{i}" for i in range(len(args))]
+    jx = jax.make_jaxpr(f)(*args)
+    m = export_jaxpr(jx, names, ["y"])
+    om = ONNXModel(decode_model(encode_model(m)))
+    got = om._jit(*args)
+    want = f(*args)
+    gl = got if isinstance(got, tuple) else (got,)
+    wl = want if isinstance(want, tuple) else (want,)
+    for g, w in zip(gl, wl):
+        onp.testing.assert_allclose(onp.asarray(g), onp.asarray(w),
+                                    rtol=1e-5, atol=1e-6)
+    return m
+
+
+def test_scan_exports_as_loop():
+    w = jax.random.normal(jax.random.PRNGKey(1), (4, 4)) * 0.3
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 4))
+
+    def f(x, w):
+        def body(c, xt):
+            c = jnp.tanh(c @ w + xt)
+            return c, c * 2.0
+        c, ys = lax.scan(body, jnp.zeros((4,)), x)
+        return c + ys.sum(0)
+
+    m = _roundtrip(f, x, w)
+    loops = [n for n in m.graph.nodes if n.op_type == "Loop"]
+    assert len(loops) == 1 and "body" in loops[0].attrs
+
+
+def test_scan_reverse_ys_order():
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 4))
+
+    def f(x):
+        def body(c, xt):
+            c = c * 0.5 + xt
+            return c, c
+        _, ys = lax.scan(body, jnp.zeros((4,)), x, reverse=True)
+        return ys
+
+    _roundtrip(f, x)
+
+
+def test_while_loop_exports_as_loop():
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 4))
+
+    def f(x):
+        def cond(s):
+            return s[0] < 10.0
+
+        def body(s):
+            return (s[0] + s[1].sum(), s[1] * 0.9)
+
+        return lax.while_loop(cond, body, (jnp.float32(0.0), x))[1]
+
+    _roundtrip(f, x)
+
+
+def test_cond_exports_as_if():
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 4))
+
+    def f(x):
+        return lax.cond(x.sum() > 0, lambda v: v * 2.0,
+                        lambda v: v - 1.0, x)
+
+    m = _roundtrip(f, x)
+    ifs = [n for n in m.graph.nodes if n.op_type == "If"]
+    assert len(ifs) == 1
+    assert "then_branch" in ifs[0].attrs and "else_branch" in ifs[0].attrs
+
+
+@pytest.mark.parametrize("cls", [gluon.rnn.LSTM, gluon.rnn.GRU,
+                                 gluon.rnn.RNN])
+def test_rnn_layer_roundtrips(cls, tmp_path):
+    """THE r3 gap: the lax.scan-based RNN zoo now exports (reference
+    parity: python/mxnet/onnx exported RNN models)."""
+    mx.random.seed(0)
+    net = cls(hidden_size=8, num_layers=1)
+    net.initialize()
+    x = NDArray(jnp.asarray(
+        jax.random.normal(jax.random.PRNGKey(2), (6, 2, 4))))  # (T, B, C)
+    want = net(x).asnumpy()
+    path = str(tmp_path / "rnn.onnx")
+    export_block(net, [x], path)
+    from incubator_mxnet_tpu.onnx import import_model as _imp_fn
+    om, _arg, _aux = _imp_fn(path)
+    got = om(x).asnumpy()
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_initializer_roundtrip():
+    """bf16 weights export as BFLOAT16 tensors (r3 silently upcast to
+    fp32) and survive the byte round-trip."""
+    w = jnp.asarray([[1.5, -2.25], [0.125, 3.0]], jnp.bfloat16)
+
+    def f(x):
+        return (x @ w).astype(jnp.float32)
+
+    x = jnp.ones((3, 2), jnp.bfloat16)
+    jx = jax.make_jaxpr(f)(x)
+    m = export_jaxpr(jx, ["x"], ["y"])
+    m2 = decode_model(encode_model(m))
+    bf16_inits = [k for k, v in m2.graph.initializers.items()
+                  if str(v.dtype) == "bfloat16"]
+    assert bf16_inits, "no BFLOAT16 initializer survived"
+    om = ONNXModel(m2)
+    onp.testing.assert_allclose(onp.asarray(om._jit(x)),
+                                onp.asarray(f(x)), rtol=1e-2)
+
+
+def test_attr_field_numbers_match_onnx_proto():
+    """Byte-level pin of AttributeProto encoding: ints at FIELD 8 with
+    type INTS(7), floats at FIELD 7 with type FLOATS(6), subgraphs at
+    FIELD 6 with type GRAPH(5) — r3 wrote ints/floats at 6/7, which a
+    real ONNX parser reads as a graph/floats."""
+    from incubator_mxnet_tpu.onnx.serde import _encode_attr
+
+    b = _encode_attr("axes", [0, 2])
+    # name: tag 0x0A len 4 'axes'; ints: tag 0x40 (field 8, varint) x2;
+    # type: tag 0xA0 0x01 (field 20) value 7
+    assert b.startswith(b"\x0a\x04axes")
+    assert b"\x40\x00" in b and b"\x40\x02" in b
+    assert b.endswith(b"\xa0\x01\x07")
+
+    bf = _encode_attr("alpha_list", [1.0, 2.0])
+    # floats: tag 0x3D (field 7, wire 5 fixed32)
+    assert b"\x3d" in bf and bf.endswith(b"\xa0\x01\x06")
+
+
+def test_scalar_initializer_stays_scalar():
+    """ascontiguousarray promotes 0-d to 1-d; the encoder must restore
+    the true rank (reverse-scan Gather indices depend on it)."""
+    from incubator_mxnet_tpu.onnx.serde import _decode_tensor, _encode_tensor
+
+    name, arr = _decode_tensor(_encode_tensor("s", onp.asarray(7, "int64")))
+    assert arr.shape == ()
