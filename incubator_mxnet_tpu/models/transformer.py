@@ -203,6 +203,9 @@ class TransformerLM(HybridBlock):
             self._layers.append(l)
         self.ln = nn.LayerNorm(in_channels=units)
         self.head = nn.Dense(vocab, flatten=False, in_units=units)
+        # built once: rebuilding the (max_len, units) table per forward
+        # would pay an 8 MB host->device transfer every eager step
+        self._pe = positional_encoding(max_len, units)
 
     def forward(self, tokens):
         tokens = wrap(tokens)
@@ -210,7 +213,7 @@ class TransformerLM(HybridBlock):
         if T > self._max_len:
             raise ValueError(f"sequence {T} exceeds max_len {self._max_len}")
         h = self.embed(tokens) * math.sqrt(self._units)
-        pe = positional_encoding(self._max_len, self._units)
+        pe = self._pe
 
         h = apply_op(lambda r: r + pe[:T].astype(r.dtype), h)
         for l in self._layers:

@@ -303,14 +303,19 @@ def _translate_eqn(ctx: _Ctx, eqn):
         operand = ins[0]
         starts = ins[1:]
         sizes = [int(s) for s in p["slice_sizes"]]
+        dims = [int(d) for d in operand.aval.shape]
+        # jax CLAMPS out-of-bounds starts into [0, dim - size]; ONNX
+        # Slice truncates instead — reproduce the clamp
+        hi = [d - s for d, s in zip(dims, sizes)]
         nd_ = len(sizes)
         if all(isinstance(s, Literal) for s in starts):
-            st = [int(s.val) for s in starts]
+            st = [min(max(int(s.val), 0), h)
+                  for s, h in zip(starts, hi)]
             starts_c = ctx.add_const(onp.asarray(st, "int64"))
             ends_c = ctx.add_const(onp.asarray(
                 [a + b for a, b in zip(st, sizes)], "int64"))
         else:
-            # runtime starts: Concat scalar tensors; ends = starts+sizes
+            # runtime starts: Concat scalar tensors; clamp; ends = +sizes
             parts = []
             for s in starts:
                 nm = ctx.name_of(s)
@@ -319,6 +324,10 @@ def _translate_eqn(ctx: _Ctx, eqn):
             starts_c = ctx.node("Concat", parts, attrs={"axis": 0}) \
                 if len(parts) > 1 else parts[0]
             starts_c = ctx.node("Cast", [starts_c], attrs={"to": INT64})
+            starts_c = ctx.node(
+                "Max", [starts_c, ctx.add_const(onp.zeros(nd_, "int64"))])
+            starts_c = ctx.node(
+                "Min", [starts_c, ctx.add_const(onp.asarray(hi, "int64"))])
             ends_c = ctx.node(
                 "Add", [starts_c, ctx.add_const(onp.asarray(sizes, "int64"))])
             axes_c = ctx.add_const(onp.arange(nd_, dtype="int64"))
@@ -403,6 +412,30 @@ def _inline_jaxpr(ctx, inner, consts, arg_names):
     return outs
 
 
+def _open_loop_body(ctx, carry_vars, tag):
+    """Start an ONNX Loop body graph: swap ctx into a fresh Graph with
+    the (iter, cond, *carries) inputs declared.  Returns
+    (body, iter_nm, cond_nm, carry_nms, saved) — callers finish the
+    outputs and restore ctx with `_close_subgraph(ctx, saved)`."""
+    body = Graph(ctx.fresh(tag))
+    saved = (ctx.g, ctx.names)
+    ctx.g, ctx.names = body, {}
+    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond")
+    body.inputs.append((iter_nm, (), INT64))
+    body.inputs.append((cond_nm, (), BOOL))
+    carry_nms = []
+    for v in carry_vars:
+        nm = ctx.fresh("carry")
+        body.inputs.append((nm, tuple(v.aval.shape),
+                            _aval_onnx_dtype(v.aval)))
+        carry_nms.append(nm)
+    return body, iter_nm, cond_nm, carry_nms, saved
+
+
+def _close_subgraph(ctx, saved):
+    ctx.g, ctx.names = saved
+
+
 def _translate_scan(ctx, eqn):
     """`lax.scan` → ONNX Loop: consts captured lexically, xs gathered at
     the iteration index inside the body, ys become Loop scan-outputs
@@ -417,17 +450,8 @@ def _translate_scan(ctx, eqn):
     carry_names = [ctx.name_of(v) for v in ins[nc:nc + ncar]]
     xs_names = [ctx.name_of(v) for v in ins[nc + ncar:]]
 
-    body = Graph(ctx.fresh("scan_body"))
-    saved_g, saved_names = ctx.g, ctx.names
-    ctx.g, ctx.names = body, {}
-    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond")
-    body.inputs.append((iter_nm, (), INT64))
-    body.inputs.append((cond_nm, (), BOOL))
-    carry_nms = []
-    for v in inner.invars[nc:nc + ncar]:
-        nm = ctx.fresh("carry")
-        body.inputs.append((nm, tuple(v.aval.shape), _aval_onnx_dtype(v.aval)))
-        carry_nms.append(nm)
+    body, iter_nm, cond_nm, carry_nms, saved = _open_loop_body(
+        ctx, inner.invars[nc:nc + ncar], "scan_body")
     idx = iter_nm
     if reverse:
         last = ctx.add_const(onp.asarray(length - 1, "int64"))
@@ -446,7 +470,7 @@ def _translate_scan(ctx, eqn):
     for nm, ov in zip(out_names[ncar:], inner.outvars[ncar:]):
         body.outputs.append((nm, tuple(ov.aval.shape),
                              _aval_onnx_dtype(ov.aval)))
-    ctx.g, ctx.names = saved_g, saved_names
+    _close_subgraph(ctx, saved)
 
     trip = ctx.add_const(onp.asarray(length, "int64"))
     cond0 = ctx.add_const(onp.asarray(True), keep_bool=True)
@@ -484,17 +508,8 @@ def _translate_while(ctx, eqn):
                        cconst + init)[0]
     c0 = ctx.node("Cast", [c0], attrs={"to": BOOL})
 
-    body = Graph(ctx.fresh("while_body"))
-    saved_g, saved_names = ctx.g, ctx.names
-    ctx.g, ctx.names = body, {}
-    iter_nm, cond_nm = ctx.fresh("iter"), ctx.fresh("cond")
-    body.inputs.append((iter_nm, (), INT64))
-    body.inputs.append((cond_nm, (), BOOL))
-    carry_nms = []
-    for v in carry_vars:
-        nm = ctx.fresh("carry")
-        body.inputs.append((nm, tuple(v.aval.shape), _aval_onnx_dtype(v.aval)))
-        carry_nms.append(nm)
+    body, iter_nm, cond_nm, carry_nms, saved = _open_loop_body(
+        ctx, carry_vars, "while_body")
     new_carry = _inline_jaxpr(ctx, body_closed.jaxpr, body_closed.consts,
                               bconst + carry_nms)
     c_next = _inline_jaxpr(ctx, cond_closed.jaxpr, cond_closed.consts,
@@ -504,7 +519,7 @@ def _translate_while(ctx, eqn):
     for nm, v in zip(new_carry, carry_vars):
         body.outputs.append((nm, tuple(v.aval.shape),
                              _aval_onnx_dtype(v.aval)))
-    ctx.g, ctx.names = saved_g, saved_names
+    _close_subgraph(ctx, saved)
 
     loop_outs = [ctx.names.setdefault(o, ctx.fresh("while")) for o in outs]
     ctx.g.nodes.append(Node("Loop", ["", c0] + init, loop_outs,

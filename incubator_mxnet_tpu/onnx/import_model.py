@@ -197,8 +197,8 @@ def _run_loop(node, env):
     body = node.attrs["body"]
     in_names = node.inputs
     M = env[in_names[0]] if in_names[0] else None
-    cond0 = env[in_names[1]].astype(bool).reshape(()) if in_names[1] \
-        else jnp.asarray(True)
+    cond0_raw = env[in_names[1]] if in_names[1] else onp.asarray(True)
+    cond0 = jnp.asarray(cond0_raw).astype(bool).reshape(())
     carried = [env[nm] for nm in in_names[2:]]
     n_carry = len(carried)
     b_in = [n for n, _s, _d in body.inputs]
@@ -208,7 +208,7 @@ def _run_loop(node, env):
     def step(i, cond, carry):
         benv = _run_subgraph(
             body, env,
-            {b_in[0]: i.astype(jnp.int64), b_in[1]: cond,
+            {b_in[0]: i.astype(jnp.int32), b_in[1]: cond,
              **dict(zip(b_in[2:], carry))})
         return (benv[b_out[0]].astype(bool).reshape(()),
                 [benv[n] for n in b_out[1:1 + n_carry]],
@@ -225,11 +225,26 @@ def _run_loop(node, env):
             return (c2, i + 1, tuple(carry2))
 
         _c, _i, final = lax.while_loop(
-            cond_fn, body_fn, (cond0, jnp.int64(0), tuple(carried)))
+            cond_fn, body_fn, (cond0, jnp.int32(0), tuple(carried)))
         for nm, v in zip(node.outputs, final):
             env[nm] = v
         return
-    # trip-count style (lax.scan export): condition is constant-true
+    # trip-count style (lax.scan export): condition is constant-true —
+    # a data-dependent condition on a trip-count Loop (valid ONNX from
+    # other producers) would be silently ignored here, so refuse loudly.
+    # Check the RAW env value: graph-node-computed conditions are
+    # tracers; initializer constants (np or closed-over jnp) are not.
+    import jax.core as _jcore
+
+    if isinstance(cond0_raw, _jcore.Tracer):
+        raise NotImplementedError(
+            "ONNX import: trip-count Loop with a data-dependent initial "
+            "condition is not supported (this importer executes the "
+            "exporter's scan/while contracts)")
+    if not bool(onp.asarray(cond0_raw).reshape(-1)[0]):
+        for nm, v in zip(node.outputs, carried):
+            env[nm] = v
+        return
     trip = int(onp.asarray(M).reshape(-1)[0])
 
     def scan_body(carry, i):
@@ -237,7 +252,7 @@ def _run_loop(node, env):
         return tuple(carry2), tuple(ys)
 
     final, ys = lax.scan(scan_body, tuple(carried),
-                         jnp.arange(trip, dtype=jnp.int64))
+                         jnp.arange(trip, dtype=jnp.int32))
     for nm, v in zip(node.outputs, list(final) + list(ys)):
         env[nm] = v
 

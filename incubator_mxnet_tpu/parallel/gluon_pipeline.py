@@ -204,24 +204,32 @@ class GluonPipeline:
         (once, at the first step)."""
         if self._programs_checked:
             return
+        import numpy as onp
+
         mb_shape = (x_raw.shape[0] // self._M,) + tuple(x_raw.shape[1:])
         x_s = jax.ShapeDtypeStruct(mb_shape, x_raw.dtype)
         train = self._train_mode
-        ref = None
+        ref = ref_consts = None
         for i, (fn, raws) in enumerate(zip(self._stage_fns, per_stage)):
-            jxp = str(jax.make_jaxpr(
+            closed = jax.make_jaxpr(
                 lambda p, a, fn=fn: fn(p, (), rng, a, training=train))(
-                    raws, x_s))
+                    raws, x_s)
+            jxp, consts = str(closed), closed.consts
             if ref is None:
-                ref = jxp
-            elif jxp != ref:
+                ref, ref_consts = jxp, consts
+                continue
+            same_consts = (len(consts) == len(ref_consts) and all(
+                onp.array_equal(onp.asarray(a), onp.asarray(b))
+                for a, b in zip(consts, ref_consts)))
+            if jxp != ref or not same_consts:
+                what = "PROGRAM" if jxp != ref else                     "closure constants (non-Parameter buffers)"
                 raise ValueError(
                     f"GluonPipeline: stage {i} traces to a DIFFERENT "
-                    f"program than stage 0 despite identical parameter "
-                    f"shapes (e.g. num_heads/activation mismatch) — "
-                    f"1F1B would silently run stage 0's program with "
-                    f"stage {i}'s weights. Make the architectures "
-                    f"identical.")
+                    f"{what} than stage 0 despite identical parameter "
+                    f"shapes (e.g. num_heads/activation/buffer "
+                    f"mismatch) — 1F1B would silently run stage 0's "
+                    f"program with stage {i}'s weights. Make the "
+                    f"architectures identical.")
         self._programs_checked = True
 
     def train_step(self, x, targets):

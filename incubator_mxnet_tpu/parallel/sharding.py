@@ -225,25 +225,21 @@ def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None,
 
 
 def _enable_hook(block, method: str, mesh: Mesh) -> int:
-    """Walk the Block tree calling ``method(mesh)`` on every child that
-    exposes it (e.g. MultiHeadAttention.set_seq_parallel,
-    MoEFFN.set_expert_parallel).  Returns the count."""
-    n = 0
+    """Call ``method(mesh)`` on every block in the tree that exposes it
+    (e.g. MultiHeadAttention.set_seq_parallel,
+    MoEFFN.set_expert_parallel) via Block.apply.  Returns the count of
+    DISTINCT blocks flipped — Block.apply visits a shared sub-Block once
+    per parent, so dedup by identity or weight-shared attention would
+    double-count."""
     seen = set()
 
-    def walk(b):
-        nonlocal n
-        if id(b) in seen:
-            return
-        seen.add(id(b))
-        if hasattr(b, method):
+    def visit(b):
+        if hasattr(b, method) and id(b) not in seen:
+            seen.add(id(b))
             getattr(b, method)(mesh)
-            n += 1
-        for child in getattr(b, "_children", {}).values():
-            walk(child)
 
-    walk(block)
-    return n
+    block.apply(visit)
+    return len(seen)
 
 
 def _nelems(shape) -> int:

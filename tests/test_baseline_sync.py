@@ -1,0 +1,16 @@
+"""BASELINE.md's Measured table must match the committed bench
+artifacts byte-for-byte (r3 VERDICT item 8: one source of perf truth).
+"""
+import os
+import subprocess
+import sys
+
+
+def test_baseline_measured_table_in_sync():
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_baseline.py"),
+         "--check"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr + proc.stdout

@@ -1,0 +1,309 @@
+"""Operator-matrix extension (r3 VERDICT item 7): the linalg family,
+contrib control flow, and INTEGER dtype sweeps — plus degenerate shapes
+beyond the unary family.  Density model: the reference's
+`tests/python/unittest/test_operator.py` matrices (SURVEY.md §4).
+
+Every exported `mx.nd.linalg.*` function appears at >=2 shapes
+(unbatched + batched); fp32 against float64 NumPy oracles, bf16 at the
+loose tier where the decomposition is numerically meaningful.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ndarray import linalg as L
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.test_utils import (assert_almost_equal,
+                                            check_numeric_gradient)
+
+RS = onp.random.RandomState(11)
+
+
+def _mat(shape, dtype="float32"):
+    return RS.uniform(-1.0, 1.0, size=shape).astype(dtype)
+
+
+def _spd(n, batch=(), dtype="float32"):
+    m = RS.uniform(-1.0, 1.0, size=batch + (n, n)).astype("float64")
+    a = m @ onp.swapaxes(m, -1, -2) + n * onp.eye(n)
+    return a.astype(dtype)
+
+
+def _tril(n, batch=(), dtype="float32"):
+    a = onp.linalg.cholesky(_spd(n, batch, "float64"))
+    return a.astype(dtype)
+
+
+# square-input shapes: (n, batch-dims)
+SQ_CASES = [(3, ()), (4, (2,))]
+DTYPES = ["float32", "bfloat16"]
+
+
+# name, builder(inputs per case), oracle(np float64), differentiable,
+# bf16-meaningful
+LINALG = [
+    ("gemm",
+     lambda n, b: (_mat(b + (n, n)), _mat(b + (n, n)), _mat(b + (n, n))),
+     lambda a, x, c: 1.0 * (a @ x) + 1.0 * c, True, True),
+    ("gemm2",
+     lambda n, b: (_mat(b + (n, n)), _mat(b + (n, n))),
+     lambda a, x: a @ x, True, True),
+    ("potrf",
+     lambda n, b: (_spd(n, b),),
+     lambda a: onp.linalg.cholesky(a), True, False),
+    ("potri",
+     lambda n, b: (_tril(n, b),),
+     lambda l: onp.linalg.inv(l @ onp.swapaxes(l, -1, -2)), True, False),
+    ("trsm",
+     lambda n, b: (_tril(n, b) + 0.5 * onp.eye(n, dtype="float32"),
+                   _mat(b + (n, n))),
+     lambda a, x: onp.linalg.solve(onp.tril(a), x), True, False),
+    ("trmm",
+     lambda n, b: (_mat(b + (n, n)), _mat(b + (n, n))),
+     lambda a, x: onp.tril(a) @ x, True, True),
+    ("syrk",
+     lambda n, b: (_mat(b + (n, n)),),
+     lambda a: a @ onp.swapaxes(a, -1, -2), True, True),
+    ("det",
+     lambda n, b: (_spd(n, b),),
+     lambda a: onp.linalg.det(a), True, False),
+    ("inverse",
+     lambda n, b: (_spd(n, b),),
+     lambda a: onp.linalg.inv(a), True, False),
+    ("solve",
+     lambda n, b: (_spd(n, b), _mat(b + (n, n))),
+     lambda a, x: onp.linalg.solve(a, x), True, False),
+    ("tensordot",
+     lambda n, b: (_mat((n, n)), _mat((n, n))),
+     lambda a, x: onp.tensordot(a, x, axes=2), True, True),
+    ("norm",
+     lambda n, b: (_mat(b + (n, n)),),
+     lambda a: onp.linalg.norm(a.ravel()), True, True),
+    ("extractdiag",
+     lambda n, b: (_mat(b + (n, n)),),
+     lambda a: onp.diagonal(a, axis1=-2, axis2=-1), True, True),
+    ("pinv",
+     lambda n, b: (_spd(n, b),),
+     lambda a: onp.linalg.pinv(a), False, False),
+]
+
+
+@pytest.mark.parametrize("n,batch", SQ_CASES)
+def test_linalg_matrix_fp32(n, batch):
+    for name, build, oracle, _diff, _bf in LINALG:
+        args = build(n, batch)
+        fn = getattr(L, name)
+        got = fn(*[NDArray(a) for a in args])
+        got = got.asnumpy() if isinstance(got, NDArray) else got[0].asnumpy()
+        want = oracle(*[a.astype("float64") for a in args])
+        assert_almost_equal(onp.asarray(got), want.astype("float32"),
+                            rtol=2e-4, atol=2e-4, names=(name, "numpy"))
+
+
+@pytest.mark.parametrize("n,batch", SQ_CASES)
+def test_linalg_matrix_bf16(n, batch):
+    for name, build, oracle, _diff, bf16_ok in LINALG:
+        if not bf16_ok:
+            continue
+        args = [a.astype("bfloat16") for a in build(n, batch)]
+        fn = getattr(L, name)
+        got = fn(*[NDArray(a) for a in args])
+        got = got.asnumpy() if isinstance(got, NDArray) else got[0].asnumpy()
+        want = oracle(*[onp.asarray(a, "float64") for a in args])
+        assert_almost_equal(onp.asarray(got, "float32"),
+                            want.astype("float32"),
+                            rtol=5e-2, atol=5e-2, names=(name, "numpy"))
+
+
+def test_linalg_factorizations_reconstruct():
+    """qr/gelqf/svd/syevd/eigh/slogdet: pin the DEFINING property (the
+    factor reconstructs the input) — factor signs/order are
+    implementation choices no oracle should fix."""
+    for n, batch in SQ_CASES:
+        a = _mat(batch + (n, n))
+        q, r = L.qr(NDArray(a))
+        assert_almost_equal(q.asnumpy() @ r.asnumpy(), a, rtol=1e-4,
+                            atol=1e-4, names=("qr", "a"))
+        lf, qf = L.gelqf(NDArray(a))
+        assert_almost_equal(lf.asnumpy() @ qf.asnumpy(), a, rtol=1e-4,
+                            atol=1e-4, names=("gelqf", "a"))
+        u, s, vt = L.svd(NDArray(a))
+        rec = (onp.asarray(u.asnumpy()) *
+               onp.asarray(s.asnumpy())[..., None, :]) \
+            @ onp.asarray(vt.asnumpy())
+        assert_almost_equal(rec, a, rtol=1e-4, atol=1e-4,
+                            names=("svd", "a"))
+        spd = _spd(n, batch)
+        vt2, w = L.syevd(NDArray(spd))
+        v = onp.swapaxes(onp.asarray(vt2.asnumpy()), -1, -2)
+        rec = v @ (onp.asarray(w.asnumpy())[..., :, None] *
+                   onp.swapaxes(v, -1, -2))
+        assert_almost_equal(rec, spd, rtol=1e-3, atol=1e-3,
+                            names=("syevd", "a"))
+        sign, logdet = L.slogdet(NDArray(spd))
+        want_s, want_l = onp.linalg.slogdet(spd.astype("float64"))
+        assert_almost_equal(onp.asarray(sign.asnumpy()),
+                            want_s.astype("float32"), names=("slogdet.s", "np"))
+        assert_almost_equal(onp.asarray(logdet.asnumpy()),
+                            want_l.astype("float32"), rtol=1e-4, atol=1e-4,
+                            names=("slogdet.l", "np"))
+
+
+def test_linalg_pack_unpack_roundtrip():
+    for n in (3, 5):
+        a = _mat((n, n))
+        packed = L.extracttrian(NDArray(a))
+        back = L.maketrian(packed)
+        assert_almost_equal(back.asnumpy(), onp.tril(a), names=("tri", "np"))
+        d = _mat((n,))
+        dm = L.makediag(NDArray(d))
+        assert_almost_equal(L.extractdiag(dm).asnumpy(), d,
+                            names=("diag", "np"))
+
+
+def test_linalg_gradients_fp32():
+    diffable = [(nm, b, o) for nm, b, o, d, _bf in LINALG if d]
+    n, batch = 3, ()
+    for name, build, _oracle in diffable:
+        args = build(n, batch)
+        fn = getattr(L, name)
+
+        def f(*xs, fn=fn, name=name):
+            out = fn(*xs)
+            return out if isinstance(out, NDArray) else out[0]
+
+        check_numeric_gradient(f, [NDArray(a) for a in args],
+                               eps=1e-3, rtol=5e-2, atol=5e-2)
+
+
+# ---------------------------------------------------------------- #
+# contrib control flow: foreach / while_loop / cond
+# ---------------------------------------------------------------- #
+def test_foreach_matrix():
+    from incubator_mxnet_tpu.ndarray import contrib
+
+    for shape in [(5, 3), (4, 2, 2)]:
+        for dtype in ("float32", "int32"):
+            data = (RS.uniform(-2, 2, size=shape) * 4).astype(dtype)
+            init = onp.zeros(shape[1:], dtype)
+
+            def body(x, state):
+                s = x + state
+                return s, s
+
+            outs, final = contrib.foreach(body, NDArray(data),
+                                          NDArray(init))
+            want = onp.cumsum(data, axis=0)
+            assert_almost_equal(outs.asnumpy().astype("float32"),
+                                want.astype("float32"),
+                                names=(f"foreach-{dtype}", "np"))
+            assert_almost_equal(final.asnumpy().astype("float32"),
+                                want[-1].astype("float32"),
+                                names=("foreach-final", "np"))
+
+
+def test_while_loop_matrix():
+    from incubator_mxnet_tpu.ndarray import contrib
+
+    for limit in (5.0, 17.0):
+        def cond_fn(i, s):
+            return i < limit
+
+        def body(i, s):
+            return (i + 1, s * 2.0)
+
+        out = contrib.while_loop(cond_fn, body,
+                                 [NDArray(onp.asarray(0.0, "float32")),
+                                  NDArray(onp.ones((2, 2), "float32"))],
+                                 max_iterations=100)
+        final = out[-1] if isinstance(out, (list, tuple)) else out
+        i_f, s_f = final if isinstance(final, (list, tuple)) else out
+        assert float(i_f.asnumpy()) == limit
+        onp.testing.assert_allclose(onp.asarray(s_f.asnumpy()),
+                                    onp.ones((2, 2)) * 2.0 ** limit)
+
+
+def test_cond_matrix():
+    from incubator_mxnet_tpu.ndarray import contrib
+
+    for shape in [(3,), (2, 4)]:
+        x = _mat(shape)
+        for flag, want_fn in [(1.0, lambda v: v * 3.0),
+                              (0.0, lambda v: v - 1.0)]:
+            got = contrib.cond(
+                NDArray(onp.asarray(flag, "float32")),
+                lambda v: v * 3.0,
+                lambda v: v - 1.0,
+                inputs=(NDArray(x),))
+            assert_almost_equal(got.asnumpy(), want_fn(x),
+                                names=("cond", "np"))
+
+
+# ---------------------------------------------------------------- #
+# integer dtype sweeps (r3 gap: DTYPES were fp32/bf16 only)
+# ---------------------------------------------------------------- #
+INT_BINARY = ["add", "subtract", "multiply", "maximum", "minimum",
+              "mod", "floor_divide"]
+INT_UNARY = ["abs", "negative", "sign", "square"]
+
+
+@pytest.mark.parametrize("dtype", ["int32", "int8"])
+@pytest.mark.parametrize("shape", [(3, 4), (6,), (2, 3, 4)])
+def test_integer_binary_matrix(shape, dtype):
+    a = RS.randint(-5 if dtype == "int8" else -50,
+                   6 if dtype == "int8" else 50, size=shape).astype(dtype)
+    b = RS.randint(1, 6 if dtype == "int8" else 50, size=shape).astype(dtype)
+    for name in INT_BINARY:
+        fn = getattr(mx.nd, name, None)
+        oracle = getattr(onp, name)
+        if fn is None:
+            continue
+        got = fn(NDArray(a), NDArray(b)).asnumpy()
+        onp.testing.assert_array_equal(
+            onp.asarray(got).astype("int64"),
+            oracle(a.astype("int64"), b.astype("int64")).astype("int64")
+            if name not in ("mod", "floor_divide")
+            else oracle(a, b).astype("int64"), err_msg=f"{name}-{dtype}")
+
+
+@pytest.mark.parametrize("dtype", ["int32", "int8"])
+def test_integer_unary_matrix(dtype):
+    for shape in [(3, 4), (5,)]:
+        x = RS.randint(-5, 6, size=shape).astype(dtype)
+        for name in INT_UNARY:
+            fn = getattr(mx.nd, name, None)
+            if fn is None:
+                continue
+            got = onp.asarray(fn(NDArray(x)).asnumpy())
+            want = getattr(onp, name if name != "square" else "square")(x)
+            onp.testing.assert_array_equal(got.astype("int64"),
+                                           want.astype("int64"),
+                                           err_msg=f"{name}-{dtype}")
+
+
+# ---------------------------------------------------------------- #
+# degenerate shapes BEYOND the unary family (r3 gap)
+# ---------------------------------------------------------------- #
+@pytest.mark.parametrize("shape", [(0, 3), (2, 0), (1, 1, 1)])
+def test_binary_degenerate_shapes(shape):
+    a = _mat(shape)
+    b = _mat(shape)
+    for name in ("add", "multiply", "maximum"):
+        got = getattr(mx.nd, name)(NDArray(a), NDArray(b)).asnumpy()
+        want = getattr(onp, name)(a, b)
+        assert onp.asarray(got).shape == want.shape
+        onp.testing.assert_allclose(onp.asarray(got), want)
+
+
+@pytest.mark.parametrize("shape,axis", [((0, 3), 0), ((2, 0), 1),
+                                        ((1, 1, 1), None)])
+def test_reduction_degenerate_shapes(shape, axis):
+    a = _mat(shape)
+    got = mx.nd.sum(NDArray(a), axis=axis).asnumpy()
+    want = onp.sum(a, axis=axis)
+    onp.testing.assert_allclose(onp.asarray(got), want, rtol=1e-6)
+    # empty-axis mean: NaN poison matches numpy semantics
+    with onp.errstate(invalid="ignore", divide="ignore"):
+        want_m = onp.mean(a, axis=axis)
+    got_m = onp.asarray(mx.nd.mean(NDArray(a), axis=axis).asnumpy())
+    onp.testing.assert_allclose(got_m, want_m, rtol=1e-6, equal_nan=True)
