@@ -16,6 +16,10 @@ Variants: full (NCHW, BN, relu, momentum+fp32 masters)
           norelu    no activations
           nomom     plain SGD, no momentum, no fp32 masters
           convonly  convs + residual adds only
+          bnprod    r3 product BN formulation (bf16 stats)
+          bn2stage / nhwc2stage  two-stage f32-acc stats
+          bndot     BN stats as MXU dots (measured: much WORSE)
+          s2d / s2dbndot  space-to-depth stem (nn_ops._stem_conv_s2d)
 All variants: BS128 bf16, 8 steps chained in one jit via lax.scan.
 """
 import functools
@@ -45,7 +49,7 @@ def conv(x, w, stride, pad, nhwc):
         dimension_numbers=dn)
 
 
-BN_MODE = "f32"  # f32 | prod | 2stage — set per variant
+BN_MODE = "f32"  # f32 | prod | 2stage | dot — set per variant
 
 
 def bn(x, gamma, beta, nhwc, use_bn):
@@ -63,6 +67,30 @@ def bn(x, gamma, beta, nhwc, use_bn):
             xr = x.reshape(x.shape[0], x.shape[1], -1)
             s = jnp.sum(jnp.sum(xr, 2, dtype=jnp.float32), 0)
             q = jnp.sum(jnp.sum(xr * xr, 2, dtype=jnp.float32), 0)
+        cnt = x.size // gamma.size
+        mean = s / cnt
+        var = jnp.maximum(q / cnt - jnp.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + 1e-5) * gamma
+        shift = beta - mean * inv
+        return x * inv.astype(x.dtype).reshape(shape) \
+            + shift.astype(x.dtype).reshape(shape)
+    if BN_MODE == "dot":
+        # stats as MXU dots: row-sums consume the conv's layout (probe
+        # for the layout-copy overhead seen in the compiled HLO)
+        if nhwc:
+            xr = x.reshape(-1, x.shape[-1])
+            ones = jnp.ones((xr.shape[0],), x.dtype)
+            s = jnp.einsum("rc,r->c", xr, ones,
+                           preferred_element_type=jnp.float32)
+            q = jnp.einsum("rc,rc,r->c", xr, xr, ones,
+                           preferred_element_type=jnp.float32)
+        else:
+            xr = x.reshape(x.shape[0], x.shape[1], -1)
+            ones = jnp.ones((xr.shape[2],), x.dtype)
+            s = jnp.sum(jnp.einsum("ncs,s->nc", xr, ones,
+                                   preferred_element_type=jnp.float32), 0)
+            q = jnp.sum(jnp.einsum("ncs,ncs,s->nc", xr, xr, ones,
+                                   preferred_element_type=jnp.float32), 0)
         cnt = x.size // gamma.size
         mean = s / cnt
         var = jnp.maximum(q / cnt - jnp.square(mean), 0.0)
@@ -113,12 +141,21 @@ def init_params(nhwc, key):
     return convs, gammas, betas
 
 
+USE_S2D = False  # space-to-depth stem (MLPerf trick): 7x7 s2 -> 4x4 s1
+
+# the PRODUCT transform — one source of the (ky, r) -> dy mapping
+from incubator_mxnet_tpu.ndarray.nn_ops import _stem_conv_s2d as stem_s2d  # noqa: E402
+
+
 def forward(convs, gammas, betas, x, nhwc, use_bn, use_relu):
     it = iter(range(len(convs)))
 
     def cbr(x, stride, pad, relu=True):
         i = next(it)
-        y = conv(x, convs[i], stride, pad, nhwc)
+        if i == 0 and USE_S2D and not nhwc:
+            y = stem_s2d(x, convs[i])
+        else:
+            y = conv(x, convs[i], stride, pad, nhwc)
         y = bn(y, gammas[i], betas[i], nhwc, use_bn)
         if use_relu and relu:
             y = jax.nn.relu(y)
@@ -173,13 +210,15 @@ def build_step(nhwc, use_bn, use_relu, momentum, head_w):
 
 
 def run_variant(name):
-    global BN_MODE
+    global BN_MODE, USE_S2D
     nhwc = name in ("nhwc", "nhwc2stage")
     use_bn = name not in ("nobn", "convonly")
     use_relu = name not in ("norelu", "convonly")
     momentum = name not in ("nomom",)
+    USE_S2D = "s2d" in name
     BN_MODE = "2stage" if "2stage" in name else (
-        "prod" if name == "bnprod" else "f32")
+        "prod" if name == "bnprod" else
+        "dot" if "bndot" in name else "f32")
     key = jax.random.PRNGKey(0)
     convs, gammas, betas = init_params(nhwc, key)
     convs_m = tuple(w.astype(jnp.float32) for w in convs)

@@ -87,6 +87,54 @@ def _acc_type(dt):
     return dt
 
 
+def _stem_s2d_applicable(x, w, nd, stride, dilate, pad, groups) -> bool:
+    """The classic TPU stem rewrite (MLPerf ResNet): a 7x7 stride-2
+    pad-3 conv on a thin-channel input (the ImageNet stem) runs ~1.5x
+    faster expressed as a 4x4 stride-1 conv on 2x2 space-to-depth input
+    — exact same math (measured r4, docs/resnet_train_profile.md).
+    TPU-only (other backends keep the canonical conv); opt out with
+    MXTPU_NO_S2D_STEM=1."""
+    import os
+
+    import jax
+
+    return (nd == 2 and groups == 1
+            and tuple(stride) == (2, 2) and tuple(dilate) == (1, 1)
+            and tuple(pad) == (3, 3)
+            and w.ndim == 4 and w.shape[2:] == (7, 7) and w.shape[1] <= 4
+            and x.ndim == 4 and x.shape[2] % 2 == 0 and x.shape[3] % 2 == 0
+            and jax.default_backend() in ("tpu", "axon")
+            and not os.environ.get("MXTPU_NO_S2D_STEM"))
+
+
+def _stem_conv_s2d(x, w):
+    """y = conv7x7_s2_p3(x, w) computed as conv4x4_s1 on space-to-depth
+    input.  Derivation: with xs[(c,r,q)][i'] = x[c][2i'+r], the 7x7 tap
+    dy maps to (ky, r) via dy = 2*ky - 1 + r, giving a 4x4 kernel and
+    asymmetric padding (2, 1).  The kernel transform is differentiable
+    (pure gather), so training through it is exact."""
+    N, C, H, W = x.shape
+    O = w.shape[0]
+    xs = x.reshape(N, C, H // 2, 2, W // 2, 2) \
+        .transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, H // 2, W // 2)
+    w4 = jnp.zeros((O, C, 2, 2, 4, 4), w.dtype)
+    for ky in range(4):
+        for r in range(2):
+            dy = 2 * ky - 1 + r
+            if not 0 <= dy < 7:
+                continue
+            for kx in range(4):
+                for q in range(2):
+                    dx = 2 * kx - 1 + q
+                    if not 0 <= dx < 7:
+                        continue
+                    w4 = w4.at[:, :, r, q, ky, kx].set(w[:, :, dy, dx])
+    w4 = w4.reshape(O, C * 4, 4, 4)
+    return lax.conv_general_dilated(
+        xs, w4, (1, 1), [(2, 1), (2, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
 def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter: int = 0, num_group: int = 1, no_bias: bool = False,
                 layout: str = "NCHW", **kwargs):
@@ -112,14 +160,17 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         # TRANSPOSE rule feeds the fp32 accumulator cotangent back into a
         # bf16 conv and type-errors; the TPU MXU accumulates conv in fp32
         # in hardware regardless of the HLO output dtype
-        y = lax.conv_general_dilated(
-            x, w,
-            window_strides=stride,
-            padding=[(p, p) for p in pad],
-            rhs_dilation=dilate,
-            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
-            feature_group_count=num_group,
-        )
+        if _stem_s2d_applicable(x, w, nd, stride, dilate, pad, num_group):
+            y = _stem_conv_s2d(x, w)
+        else:
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=stride,
+                padding=[(p, p) for p in pad],
+                rhs_dilation=dilate,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                feature_group_count=num_group,
+            )
         if rest:
             b = rest[0].reshape((1, -1) + (1,) * nd)
             y = y + b.astype(y.dtype)
