@@ -1,0 +1,181 @@
+"""BERT-large flagship-step ablation (real TPU, product Gluon path).
+
+Finds where the non-ideal ~40% of the flagship step goes, with the same
+methodology as resnet_ablate.py: the EXACT bench.py configuration and
+code path (hybridized net+loss -> backward -> fused Trainer step), one
+component toggled per variant, 30 timed steps fetched once.
+
+    python benchmark/bert_ablate.py full nodrop noxent nohead noln ...
+
+Variants:
+  full     bench.py flagship: dropout=0.1, fp32 xent over V=30522
+  nodrop   dropout=0.0 (bench.py's secondary number)
+  bf16xent log_softmax in bf16 (no fp32 upcast of the (B,T,V) logits)
+  noxent   loss = mlm_logits.mean() — keeps the V-decoder matmul,
+           removes log_softmax/pick (isolates the xent cost)
+  nohead   loss = seq.mean() — removes decoder matmul AND xent
+           (isolates the whole MLM-head cost)
+  noln     every LayerNorm replaced by identity
+  relu     gelu -> relu in FFN + MLM head
+  noattn   attention scores/softmax removed (QKV+out projections kept:
+           out = out_proj(v)) — isolates the attention-core cost
+  nomom    plain SGD, no momentum, no fp32 masters
+  frozemb  embedding tables grad_req="null" — isolates the
+           scatter-add embedding backward (a classic TPU slow path)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+
+STEPS = int(os.environ.get("ABLATE_STEPS", "30"))
+WARMUP = 3
+# BERT-large phase-1 flagship shapes (bench.py); ABLATE_SMALL=1 smoke-tests
+if os.environ.get("ABLATE_SMALL"):
+    V, D, DFF, L, H, B, T = 1000, 64, 128, 2, 2, 4, 16
+else:
+    V, D, DFF, L, H, B, T = 30522, 1024, 4096, 24, 16, 32, 128
+
+
+def build_and_measure(variant: str):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+    from incubator_mxnet_tpu.models import bert
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    dropout = 0.0 if variant == "nodrop" else 0.1
+
+    if variant == "noln":
+        class _IdLN(nn.LayerNorm):
+            def forward(self, x):
+                return x
+        ln_cls, restore_ln = nn.LayerNorm, True
+        nn.LayerNorm = _IdLN
+    else:
+        restore_ln = False
+
+    if variant == "relu":
+        import incubator_mxnet_tpu.ndarray.nn_ops as nn_ops
+        real_gelu = nn_ops.gelu
+        nn_ops.gelu = lambda x, approximate=True: nn_ops.Activation(x, "relu")
+        mx.nd.gelu = nn_ops.gelu
+
+    if variant == "noattn":
+        from incubator_mxnet_tpu.models.bert import MultiHeadAttention
+
+        def _no_scores_forward(self, x, mask=None):
+            from incubator_mxnet_tpu.ndarray.ndarray import apply_op, wrap
+            x = wrap(x)
+            qkv = self.qkv(x)
+            v = apply_op(lambda a: a[..., 2 * self._units:], qkv)
+            return self.proj(v)
+
+        real_fwd = MultiHeadAttention.forward
+        MultiHeadAttention.forward = _no_scores_forward
+
+    try:
+        mx.random.seed(0)
+        net = bert.BERTForPretraining(vocab_size=V, units=D, hidden_size=DFF,
+                                      num_layers=L, num_heads=H, dropout=dropout)
+        net.initialize()
+        net(NDArray(jnp.ones((B, T), jnp.int32)))
+        net.cast("bfloat16")
+        if variant == "frozemb":
+            for name, p in net.collect_params().items():
+                if "embed" in name and "weight" in name:
+                    p.grad_req = "null"
+
+        class StepLoss(HybridBlock):
+            def __init__(self, net_, **kw):
+                super().__init__(**kw)
+                self.net = net_
+
+            def forward(self, tokens, labels):
+                mlm_logits, nsp_logits = self.net(tokens)
+                if variant == "noxent":
+                    return mlm_logits.mean() + nsp_logits.mean()
+                if variant == "bf16xent":
+                    logp = mx.nd.log_softmax(mlm_logits)
+                    mlm = -(mx.nd.pick(logp, labels).mean())
+                    nsp_logp = mx.nd.log_softmax(nsp_logits)
+                    return mlm + (-(nsp_logp[:, 0].mean()))
+                logp = mx.nd.log_softmax(mlm_logits.astype("float32"))
+                mlm = -(mx.nd.pick(logp, labels).mean())
+                nsp_logp = mx.nd.log_softmax(nsp_logits.astype("float32"))
+                return mlm + (-(nsp_logp[:, 0].mean()))
+
+        class EncoderOnlyLoss(HybridBlock):
+            def __init__(self, net_, **kw):
+                super().__init__(**kw)
+                self.net = net_
+
+            def forward(self, tokens, labels):
+                seq, pooled = self.net.bert(tokens)
+                return seq.mean() + pooled.mean()
+
+        model = (EncoderOnlyLoss if variant == "nohead" else StepLoss)(net)
+        model.hybridize()
+
+        opt_args = {"learning_rate": 1e-3}
+        if variant != "nomom":
+            opt_args.update(momentum=0.9, multi_precision=True)
+        trainer = Trainer(model.collect_params(), "sgd", opt_args,
+                          keep_grads=False)
+
+        key = jax.random.PRNGKey(0)
+        kx, ky = jax.random.split(key)
+        tokens = NDArray(jax.random.randint(kx, (B, T), 0, V, dtype=jnp.int32))
+        labels = NDArray(jax.random.randint(ky, (B, T), 0, V, dtype=jnp.int32))
+
+        def train_step():
+            with autograd.record():
+                loss = model(tokens, labels)
+            loss.backward()
+            trainer.step(1)
+            return loss
+
+        for _ in range(WARMUP):
+            loss = train_step()
+        float(loss.asnumpy())
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            loss = train_step()
+        float(loss.asnumpy())
+        dt = time.perf_counter() - t0
+        ms = dt / STEPS * 1e3
+        toks = B * T * STEPS / dt
+        return ms, toks
+    finally:
+        if restore_ln:
+            nn.LayerNorm = ln_cls
+        if variant == "relu":
+            nn_ops.gelu = real_gelu
+            mx.nd.gelu = real_gelu
+        if variant == "noattn":
+            MultiHeadAttention.forward = real_fwd
+
+
+def main():
+    variants = sys.argv[1:] or ["full", "nodrop", "noxent", "nohead", "noln",
+                                "relu", "noattn", "nomom", "frozemb",
+                                "bf16xent"]
+    print(f"device={jax.devices()[0].device_kind} B={B} T={T} L={L} D={D} "
+          f"steps={STEPS}")
+    base = None
+    for v in variants:
+        ms, toks = build_and_measure(v)
+        delta = "" if base is None else f"  delta={ms - base:+.2f} ms"
+        if v == "full":
+            base = ms
+        print(f"{v:>9}: {ms:7.2f} ms/step  {toks:9.0f} tok/s{delta}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -104,7 +104,9 @@ def _stem_s2d_applicable(x, w, nd, stride, dilate, pad, groups) -> bool:
             and w.ndim == 4 and w.shape[2:] == (7, 7) and w.shape[1] <= 4
             and x.ndim == 4 and x.shape[2] % 2 == 0 and x.shape[3] % 2 == 0
             and jax.default_backend() in ("tpu", "axon")
-            and not os.environ.get("MXTPU_NO_S2D_STEM"))
+            # opt-out only on an explicit truthy value ("0" keeps it on)
+            and os.environ.get("MXTPU_NO_S2D_STEM", "0").lower()
+            not in ("1", "true", "yes"))
 
 
 def _stem_conv_s2d(x, w):

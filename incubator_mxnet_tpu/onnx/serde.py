@@ -28,6 +28,8 @@ from typing import Dict, List, Optional
 
 import numpy as onp
 
+from ..utils.protowire import Reader as _WireReader
+
 # ONNX TensorProto.DataType
 FLOAT = 1
 INT64 = 7
@@ -85,47 +87,12 @@ def _float_field(field: int, value: float) -> bytes:
     return _tag(field, 5) + struct.pack("<f", value)
 
 
-class _Reader:
+class _Reader(_WireReader):
+    """ONNX wire reader: signed varints (protobuf int64 semantics —
+    axis=-1 must not decode as 2^64-1).  Shared core: utils/protowire."""
+
     def __init__(self, buf: bytes):
-        self.buf = buf
-        self.pos = 0
-
-    def eof(self):
-        return self.pos >= len(self.buf)
-
-    def varint(self) -> int:
-        shift = n = 0
-        while True:
-            b = self.buf[self.pos]
-            self.pos += 1
-            n |= (b & 0x7F) << shift
-            if not b & 0x80:
-                # protobuf int64 semantics: sign-extend two's complement
-                # (axis=-1 etc. must not decode as 2^64-1)
-                if n >= 1 << 63:
-                    n -= 1 << 64
-                return n
-            shift += 7
-
-    def field(self):
-        tag = self.varint()
-        field, wire = tag >> 3, tag & 0x7
-        if wire == 0:
-            return field, self.varint()
-        if wire == 2:
-            ln = self.varint()
-            payload = self.buf[self.pos:self.pos + ln]
-            self.pos += ln
-            return field, payload
-        if wire == 5:
-            v = struct.unpack("<f", self.buf[self.pos:self.pos + 4])[0]
-            self.pos += 4
-            return field, v
-        if wire == 1:
-            v = struct.unpack("<d", self.buf[self.pos:self.pos + 8])[0]
-            self.pos += 8
-            return field, v
-        raise ValueError(f"unsupported wire type {wire}")
+        super().__init__(buf, signed_varints=True)
 
 
 # ---------------------------------------------------------------------- #

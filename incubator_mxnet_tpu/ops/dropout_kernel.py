@@ -134,27 +134,79 @@ def _tile_geometry(R: int, Clp: int, itemsize: int):
     return _pick_br(R, cap), bc
 
 
-def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb):
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb, br, bc, kr, kc):
+    """One EXECUTION block covers a (kr x kc) window of MASK tiles.
+
+    The mask remains a pure function of (seed, global mask-tile id) with
+    (br, bc) mask tiles — identical bits to a kr=kc=1 run — while the
+    grid moves (kr*br, kc*bc) blocks per step.  Decoupling execution
+    blocking from mask geometry is what fixes the 16 KB-per-grid-step
+    regime this kernel shipped with (measured 203 GB/s on the BERT
+    flagship's (4096,1024) sites: 512 steps of 64x128; see
+    docs/performance.md)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    # distinct stream per global tile: seed words are (user seed,
+    # distinct stream per global MASK tile: seed words are (user seed,
     # LINEAR global tile id = (row_block_offset + i) * ncb + j).  Same
     # words in fwd and bwd regenerate the identical mask; TWO words —
     # Mosaic on the v5e rejects 3-word prng_seed — and the second word
     # linearizes (row block, col block) with the STATIC global column
     # block count, so the id is globally unique and shard-invariant.
-    pltpu.prng_seed(seed_ref[0],
-                    seed_ref[1] + pl.program_id(0) * ncb + pl.program_id(1))
-    # raw bits come back int32 — bitcast before the unsigned compare
-    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
-    # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)
     thresh = jnp.uint32(min(int(rate * (1 << 32)), (1 << 32) - 1))
-    keep = bits >= thresh
     scale = 1.0 / (1.0 - rate)
-    x = x_ref[...]
-    o_ref[...] = jnp.where(keep, x * jnp.asarray(scale, x.dtype),
-                           jnp.zeros_like(x))
+    base_i = pl.program_id(0) * kr
+    base_j = pl.program_id(1) * kc
+    for i in range(kr):  # static unroll over the mask tiles in-block
+        for j in range(kc):
+            pltpu.prng_seed(seed_ref[0],
+                            seed_ref[1] + (base_i + i) * ncb + (base_j + j))
+            # raw bits come back int32 — bitcast before unsigned compare
+            bits = pltpu.bitcast(pltpu.prng_random_bits((br, bc)),
+                                 jnp.uint32)
+            # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)
+            keep = bits >= thresh
+            x = x_ref[i * br:(i + 1) * br, j * bc:(j + 1) * bc]
+            o_ref[i * br:(i + 1) * br, j * bc:(j + 1) * bc] = jnp.where(
+                keep, x * jnp.asarray(scale, x.dtype), jnp.zeros_like(x))
+
+
+# execution-block budget: elements per (in OR out) VMEM block.  With
+# double buffering the kernel holds ~4x this in VMEM (2 MB blocks ->
+# ~8 MB), well inside the v5e's VMEM while making every DMA >= 2 MB.
+_EXEC_BUDGET_BYTES = 2 << 20
+# cap on mask tiles per execution block: the kernel body unrolls kr*kc
+# PRNG+select sequences statically, so compile time / code size scale
+# with it.  128 is the measured flagship configuration (64x128 tiles in
+# a (512,1024) block) — bounded, and already DMA-efficient.
+_MAX_UNROLL_TILES = 128
+
+
+def _exec_blocking(rows, cols, br, bc, itemsize):
+    """(kr, kc): how many MASK tiles one execution block covers.
+
+    Mask geometry (br, bc) is global-shape-derived and sharding-visible;
+    execution blocking is a pure local performance choice, so it adapts
+    to the LOCAL (shard) extents.  kr/kc must tile the local mask grid
+    exactly; a ragged row tail (ceil grid) keeps kr=1 so the BlockSpec
+    masks the tail block the same way the single-tile kernel did."""
+    target = max(1, _EXEC_BUDGET_BYTES // max(1, itemsize))
+    nbc = cols // bc
+    kc = 1
+    for k in range(nbc, 0, -1):
+        if nbc % k == 0 and k * bc * br <= target and k <= _MAX_UNROLL_TILES:
+            kc = k
+            break
+    if rows % br != 0:
+        return 1, kc
+    nbr = rows // br
+    kr = 1
+    for k in range(nbr, 0, -1):
+        if (nbr % k == 0 and k * br * kc * bc <= target
+                and k * kc <= _MAX_UNROLL_TILES):
+            kr = k
+            break
+    return kr, kc
 
 
 def _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
@@ -169,17 +221,19 @@ def _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
     from jax.experimental.pallas import tpu as pltpu
 
     rows, cols = x2d.shape
+    kr, kc = _exec_blocking(rows, cols, br, bc, x2d.dtype.itemsize)
     lin_off = (jnp.asarray(row_blk_off, jnp.int32) * ncb_g
                + jnp.asarray(col_blk_off, jnp.int32))
     seeds = jnp.concatenate([seed.astype(jnp.int32), lin_off.reshape(1)])
     return pl.pallas_call(
-        functools.partial(_dropout_kernel, rate=rate, ncb=ncb_g),
-        grid=(_row_grid(rows, br), -(-cols // bc)),
+        functools.partial(_dropout_kernel, rate=rate, ncb=ncb_g,
+                          br=br, bc=bc, kr=kr, kc=kc),
+        grid=(_row_grid(rows, kr * br), -(-cols // (kc * bc))),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # (2,) seed words
-            pl.BlockSpec((br, bc), lambda i, j: (i, j)),
+            pl.BlockSpec((kr * br, kc * bc), lambda i, j: (i, j)),
         ],
-        out_specs=pl.BlockSpec((br, bc), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((kr * br, kc * bc), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
         interpret=interpret,
     )(seeds, x2d)

@@ -17,7 +17,8 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
-           "Task", "Frame", "Marker", "scope", "trace_annotation", "state"]
+           "Task", "Frame", "Marker", "scope", "trace_annotation", "state",
+           "device_op_table", "device_op_summary"]
 
 _config = {
     "profile_all": False,
@@ -32,6 +33,15 @@ _events: List[dict] = []
 _agg: Dict[str, List[float]] = defaultdict(list)
 _running = False
 _jax_dir: Optional[str] = None
+_last_jax_dir: Optional[str] = None
+
+
+def _trace_dir() -> str:
+    """The device-trace dir: the one actually recorded by the last
+    start()/stop() cycle if any (robust against set_config(filename=..)
+    between stop() and a table query), else derived from config."""
+    return _last_jax_dir or (os.path.splitext(_config["filename"])[0]
+                             + "_xla")
 
 
 def set_config(**kwargs):
@@ -39,7 +49,7 @@ def set_config(**kwargs):
 
 
 def start(profile_process="worker"):
-    global _running, _jax_dir
+    global _running, _jax_dir, _last_jax_dir
     _running = True
     _events.clear()
     _agg.clear()
@@ -49,6 +59,7 @@ def start(profile_process="worker"):
 
             _jax_dir = os.path.splitext(_config["filename"])[0] + "_xla"
             jax.profiler.start_trace(_jax_dir)
+            _last_jax_dir = _jax_dir
         except Exception:
             _jax_dir = None
 
@@ -155,3 +166,27 @@ trace_annotation = _Scope
 
 def state():
     return "running" if _running else "stopped"
+
+
+def device_op_table(logdir: Optional[str] = None, top: int = 30,
+                    as_string: bool = True):
+    """Per-HLO-op device-time aggregate from the last `start()/stop()`
+    trace (or an explicit trace dir) — the TPU answer to the reference
+    profiler's per-operator table (`profiler.dumps` over
+    src/profiler/profiler.cc stats): under XLA the whole step is ONE
+    program, so per-op timing comes from the device trace, decoded by
+    `utils.xplane` without needing tensorboard.  Rows carry XLA's
+    cost-model FLOPs and bytes_accessed when the trace reports them."""
+    from .utils import xplane
+
+    rows = xplane.device_op_table(logdir or _trace_dir())
+    return xplane.dump_table(rows, top=top) if as_string else rows[:top]
+
+
+def device_op_summary(logdir: Optional[str] = None):
+    """Category-level device-time rollup (matmul/fusion/copy/...) from
+    the last trace — see `device_op_table`."""
+    from .utils import xplane
+
+    return xplane.category_summary(
+        xplane.device_op_table(logdir or _trace_dir()))

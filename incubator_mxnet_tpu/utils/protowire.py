@@ -1,0 +1,89 @@
+"""Protobuf wire-format reader shared by the hand-rolled decoders.
+
+Two subsystems decode protobuf without a generated library: the ONNX
+serde (onnx/serde.py) and the XPlane trace parser (utils/xplane.py).
+They share this reader so varint/tag/length-delimited semantics cannot
+drift between them (the first xplane revision re-implemented it and
+dropped int64 sign-extension — the exact trap serde had already fixed).
+
+``signed_varints`` controls int64 two's-complement sign-extension:
+ONNX attribute ints (axis=-1) need it; xplane durations/ids are
+unsigned and use raw accumulation, sign-extending only the fields the
+schema declares int64.
+"""
+from __future__ import annotations
+
+import struct
+
+__all__ = ["Reader", "sign_extend_64"]
+
+
+def sign_extend_64(n: int) -> int:
+    """protobuf int64 semantics: two's-complement sign-extension."""
+    return n - (1 << 64) if n >= 1 << 63 else n
+
+
+class Reader:
+    __slots__ = ("buf", "pos", "end", "signed")
+
+    def __init__(self, buf, pos: int = 0, end=None, signed_varints=False):
+        self.buf = buf
+        self.pos = pos
+        self.end = len(buf) if end is None else end
+        self.signed = signed_varints
+
+    def eof(self) -> bool:
+        return self.pos >= self.end
+
+    def varint(self) -> int:
+        shift = n = 0
+        buf, pos = self.buf, self.pos
+        while True:
+            b = buf[pos]
+            pos += 1
+            n |= (b & 0x7F) << shift
+            if not b & 0x80:
+                self.pos = pos
+                return sign_extend_64(n) if self.signed else n
+            shift += 7
+
+    def skip(self, wire: int):
+        if wire == 0:
+            self.varint()
+        elif wire == 2:
+            ln = self.varint()
+            self.pos += ln
+        elif wire == 5:
+            self.pos += 4
+        elif wire == 1:
+            self.pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+    def subreader(self) -> "Reader":
+        ln = self.varint()
+        r = Reader(self.buf, self.pos, self.pos + ln,
+                   signed_varints=self.signed)
+        self.pos += ln
+        return r
+
+    # serde-style convenience: (field, value) with wire-typed payloads
+    def field(self):
+        tag = self.varint()
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:
+            return field, self.varint()
+        if wire == 2:
+            ln = self.varint()
+            payload = self.buf[self.pos:self.pos + ln]
+            self.pos += ln
+            return field, payload
+        if wire == 5:
+            v = struct.unpack("<f", self.buf[self.pos:self.pos + 4])[0]
+            self.pos += 4
+            return field, v
+        if wire == 1:
+            v = struct.unpack("<d", self.buf[self.pos:self.pos + 8])[0]
+            self.pos += 8
+            return field, v
+        raise ValueError(f"unsupported wire type {wire}")

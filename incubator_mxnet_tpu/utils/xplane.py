@@ -1,0 +1,339 @@
+"""XPlane (TensorBoard profiler) trace parser — per-op device profile.
+
+`jax.profiler.start_trace()` writes an `*.xplane.pb` protobuf holding
+the per-HLO-op device timeline.  The reference framework's profiler
+printed a per-operator aggregate table (`mx.profiler.dumps(ops)` over
+`src/profiler/profiler.cc`); under XLA everything inside one `jit` is a
+single program, so the ONLY per-op view is the device trace — this
+module decodes it without requiring tensorflow/tensorboard, giving
+`mx.profiler` its aggregate-table parity on TPU.
+
+The wire format is decoded directly (same approach as onnx/serde.py):
+only the XSpace/XPlane/XLine/XEvent/XStat fields we consume are mapped,
+unknown fields are skipped — robust to schema additions.
+
+Schema (tensorflow/tsl/profiler/protobuf/xplane.proto):
+  XSpace.planes=1
+  XPlane: id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+  XLine:  id=1 name=2 timestamp_ns=3 events=4 display_name=11
+  XEvent: metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+          num_occurrences=5 (aggregated events)
+  XEventMetadata: id=1 name=2 display_name=4
+  XStatMetadata:  id=1 name=2
+  XStat: metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7
+"""
+from __future__ import annotations
+
+import glob
+import os
+import struct
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .protowire import Reader as _Reader, sign_extend_64
+
+
+@dataclass
+class XEvent:
+    name: str
+    offset_ps: int
+    duration_ps: int
+    stats: Dict[str, object] = field(default_factory=dict)
+    num_occurrences: int = 1
+
+
+@dataclass
+class XLine:
+    name: str
+    timestamp_ns: int
+    events: List[XEvent] = field(default_factory=list)
+
+
+@dataclass
+class XPlane:
+    name: str
+    lines: List[XLine] = field(default_factory=list)
+
+
+def _parse_stat(r: _Reader, stat_names: Dict[int, str]):
+    name_id = 0
+    value = None
+    while not r.eof():
+        tag = r.varint()
+        f, wire = tag >> 3, tag & 0x7
+        if f == 1 and wire == 0:
+            name_id = r.varint()
+        elif f == 2 and wire == 1:
+            value = struct.unpack("<d", r.buf[r.pos:r.pos + 8])[0]
+            r.pos += 8
+        elif f == 3 and wire == 0:  # uint64_value
+            value = r.varint()
+        elif f == 4 and wire == 0:  # int64_value: may be negative
+            value = sign_extend_64(r.varint())
+        elif f == 7 and wire == 0:
+            # ref_value: an interned string — the id points at the
+            # stat-metadata entry whose NAME holds the actual string
+            # (real traces intern repeated strings like hlo_category)
+            ref = r.varint()
+            value = stat_names.get(ref, ref)
+        elif f == 5 and wire == 2:
+            ln = r.varint()
+            value = r.buf[r.pos:r.pos + ln].decode("utf-8", "replace")
+            r.pos += ln
+        elif f == 6 and wire == 2:
+            ln = r.varint()
+            value = bytes(r.buf[r.pos:r.pos + ln])
+            r.pos += ln
+        else:
+            r.skip(wire)
+    return stat_names.get(name_id, str(name_id)), value
+
+
+def _parse_event(r: _Reader, ev_meta, stat_names):
+    meta_id = 0
+    offset_ps = duration_ps = 0
+    occurrences = 1
+    stats = {}
+    while not r.eof():
+        tag = r.varint()
+        f, wire = tag >> 3, tag & 0x7
+        if f == 1 and wire == 0:
+            meta_id = r.varint()
+        elif f == 2 and wire == 0:
+            offset_ps = r.varint()
+        elif f == 3 and wire == 0:
+            duration_ps = r.varint()
+        elif f == 4 and wire == 2:
+            k, v = _parse_stat(r.subreader(), stat_names)
+            stats[k] = v
+        elif f == 5 and wire == 0:
+            occurrences = r.varint()
+        else:
+            r.skip(wire)
+    name = ev_meta.get(meta_id, (str(meta_id), {}))
+    return XEvent(name=name[0], offset_ps=offset_ps, duration_ps=duration_ps,
+                  stats={**name[1], **stats}, num_occurrences=occurrences)
+
+
+def _parse_line(r: _Reader, ev_meta, stat_names):
+    line = XLine(name="", timestamp_ns=0)
+    display = None
+    while not r.eof():
+        tag = r.varint()
+        f, wire = tag >> 3, tag & 0x7
+        if f == 2 and wire == 2:
+            ln = r.varint()
+            line.name = r.buf[r.pos:r.pos + ln].decode("utf-8", "replace")
+            r.pos += ln
+        elif f == 11 and wire == 2:
+            ln = r.varint()
+            display = r.buf[r.pos:r.pos + ln].decode("utf-8", "replace")
+            r.pos += ln
+        elif f == 3 and wire == 0:
+            line.timestamp_ns = r.varint()
+        elif f == 4 and wire == 2:
+            line.events.append(_parse_event(r.subreader(), ev_meta, stat_names))
+        else:
+            r.skip(wire)
+    if display:
+        line.name = display
+    return line
+
+
+def _parse_metadata_entry(r: _Reader, stat_names):
+    """map<int64, XEventMetadata> entry: key=1, value=2."""
+    key = 0
+    name = ""
+    extra: Dict[str, object] = {}
+    while not r.eof():
+        tag = r.varint()
+        f, wire = tag >> 3, tag & 0x7
+        if f == 1 and wire == 0:
+            key = r.varint()
+        elif f == 2 and wire == 2:
+            sub = r.subreader()
+            display = None
+            while not sub.eof():
+                t2 = sub.varint()
+                f2, w2 = t2 >> 3, t2 & 0x7
+                if f2 == 1 and w2 == 0:
+                    key = sub.varint() or key
+                elif f2 == 2 and w2 == 2:
+                    ln = sub.varint()
+                    name = sub.buf[sub.pos:sub.pos + ln].decode("utf-8", "replace")
+                    sub.pos += ln
+                elif f2 == 4 and w2 == 2:
+                    ln = sub.varint()
+                    display = sub.buf[sub.pos:sub.pos + ln].decode("utf-8", "replace")
+                    sub.pos += ln
+                elif f2 == 5 and w2 == 2:  # XEventMetadata.stats
+                    k, v = _parse_stat(sub.subreader(), stat_names)
+                    extra[k] = v
+                else:
+                    sub.skip(w2)
+            if display and not name:
+                name = display
+        else:
+            r.skip(wire)
+    return key, (name, extra)
+
+
+def _parse_stat_metadata_entry(r: _Reader):
+    key = 0
+    name = ""
+    while not r.eof():
+        tag = r.varint()
+        f, wire = tag >> 3, tag & 0x7
+        if f == 1 and wire == 0:
+            key = r.varint()
+        elif f == 2 and wire == 2:
+            sub = r.subreader()
+            while not sub.eof():
+                t2 = sub.varint()
+                f2, w2 = t2 >> 3, t2 & 0x7
+                if f2 == 1 and w2 == 0:
+                    key = sub.varint() or key
+                elif f2 == 2 and w2 == 2:
+                    ln = sub.varint()
+                    name = sub.buf[sub.pos:sub.pos + ln].decode("utf-8", "replace")
+                    sub.pos += ln
+                else:
+                    sub.skip(w2)
+        else:
+            r.skip(wire)
+    return key, name
+
+
+def _parse_plane(r: _Reader) -> XPlane:
+    """Two-pass plane parse: the stat-name map (field 5) may appear
+    anywhere in the stream, so lines AND event-metadata payloads are
+    deferred until every XStatMetadata entry has been read."""
+    plane = XPlane(name="")
+    ev_meta: Dict[int, tuple] = {}
+    stat_names: Dict[int, str] = {}
+    line_payloads = []
+    meta_payloads = []
+    while not r.eof():
+        tag = r.varint()
+        f, wire = tag >> 3, tag & 0x7
+        if f == 2 and wire == 2:
+            ln = r.varint()
+            plane.name = r.buf[r.pos:r.pos + ln].decode("utf-8", "replace")
+            r.pos += ln
+        elif f == 3 and wire == 2:
+            line_payloads.append(r.subreader())
+        elif f == 4 and wire == 2:
+            meta_payloads.append(r.subreader())
+        elif f == 5 and wire == 2:
+            k, v = _parse_stat_metadata_entry(r.subreader())
+            stat_names[k] = v
+        else:
+            r.skip(wire)
+    for mp in meta_payloads:
+        k, v = _parse_metadata_entry(mp, stat_names)
+        ev_meta[k] = v
+    for lp in line_payloads:
+        plane.lines.append(_parse_line(lp, ev_meta, stat_names))
+    return plane
+
+
+def parse_xspace(path: str) -> List[XPlane]:
+    """Parse an .xplane.pb file into XPlane objects."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    r = _Reader(buf)
+    planes = []
+    while not r.eof():
+        tag = r.varint()
+        f_, wire = tag >> 3, tag & 0x7
+        if f_ == 1 and wire == 2:
+            planes.append(_parse_plane(r.subreader()))
+        else:
+            r.skip(wire)
+    return planes
+
+
+def find_xplane_files(logdir: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                            recursive=True))
+
+
+def _as_int(v) -> int:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _category(name: str, stats: Dict[str, object]) -> str:
+    cat = stats.get("hlo_category")
+    if isinstance(cat, str) and cat:
+        return cat
+    n = name.split(".")[0].split("(")[0]
+    return n
+
+
+def device_op_table(logdir_or_file: str, device_substr: str = "TPU",
+                    line_substr: str = "XLA Ops") -> List[dict]:
+    """Aggregate per-op device time from a profiler trace directory.
+
+    A directory aggregates every .xplane.pb of the LATEST run directory
+    (one file per host in multi-host traces); pass a file path to pin
+    one host.  Returns rows sorted by total time: {name, category,
+    total_us, occurrences, avg_us, flops, bytes_accessed} — the TPU
+    analogue of the reference profiler's per-operator aggregate table,
+    with XLA's cost-model FLOPs/bytes carried through when reported."""
+    if os.path.isdir(logdir_or_file):
+        files = find_xplane_files(logdir_or_file)
+        if not files:
+            raise FileNotFoundError(f"no .xplane.pb under {logdir_or_file}")
+        run_dir = os.path.dirname(files[-1])
+        paths = [f for f in files if os.path.dirname(f) == run_dir]
+    else:
+        paths = [logdir_or_file]
+    agg = defaultdict(lambda: [0, 0, "", 0, 0])
+    for path in paths:
+        for plane in parse_xspace(path):
+            if device_substr not in plane.name:
+                continue
+            for line in plane.lines:
+                if line_substr and line_substr not in line.name:
+                    continue
+                for ev in line.events:
+                    row = agg[ev.name]
+                    row[0] += ev.duration_ps
+                    row[1] += max(1, ev.num_occurrences)
+                    if not row[2]:
+                        row[2] = _category(ev.name, ev.stats)
+                    # aggregated events (num_occurrences=N) carry
+                    # per-occurrence cost-model stats: scale them so the
+                    # column means TOTAL flops/bytes either way
+                    occ = max(1, ev.num_occurrences)
+                    row[3] += _as_int(ev.stats.get("flops")) * occ
+                    row[4] += _as_int(ev.stats.get("bytes_accessed")) * occ
+    rows = [{"name": k, "category": v[2], "total_us": v[0] / 1e6,
+             "occurrences": v[1], "avg_us": v[0] / 1e6 / max(1, v[1]),
+             "flops": v[3], "bytes_accessed": v[4]}
+            for k, v in agg.items()]
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def category_summary(rows: List[dict]) -> List[dict]:
+    agg = defaultdict(lambda: [0.0, 0])
+    for r in rows:
+        agg[r["category"]][0] += r["total_us"]
+        agg[r["category"]][1] += r["occurrences"]
+    out = [{"category": k, "total_us": v[0], "occurrences": v[1]}
+           for k, v in agg.items()]
+    out.sort(key=lambda r: -r["total_us"])
+    return out
+
+
+def dump_table(rows: List[dict], top: int = 30) -> str:
+    lines = [f"{'total_ms':>10} {'count':>7} {'avg_us':>9}  name"]
+    for r in rows[:top]:
+        lines.append(f"{r['total_us']/1e3:10.3f} {r['occurrences']:7d} "
+                     f"{r['avg_us']:9.2f}  [{r['category']}] {r['name'][:70]}")
+    return "\n".join(lines)
