@@ -41,7 +41,9 @@ else:
     V, D, DFF, L, H, B, T = 30522, 1024, 4096, 24, 16, 32, 128
 
 
-def build_and_measure(variant: str):
+def build_and_measure(variant: str, trace_dir: str = None):
+    """trace_dir: wrap ONLY the timed steps in jax.profiler.trace —
+    tracing the compile too overflows the 2 GB XSpace protobuf cap."""
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import autograd
     from incubator_mxnet_tpu.gluon import Trainer, nn
@@ -95,6 +97,8 @@ def build_and_measure(variant: str):
             def __init__(self, net_, **kw):
                 super().__init__(**kw)
                 self.net = net_
+                from incubator_mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+                self.mlm_loss = SoftmaxCrossEntropyLoss()
 
             def forward(self, tokens, labels):
                 mlm_logits, nsp_logits = self.net(tokens)
@@ -105,8 +109,16 @@ def build_and_measure(variant: str):
                     mlm = -(mx.nd.pick(logp, labels).mean())
                     nsp_logp = mx.nd.log_softmax(nsp_logits)
                     return mlm + (-(nsp_logp[:, 0].mean()))
-                logp = mx.nd.log_softmax(mlm_logits.astype("float32"))
-                mlm = -(mx.nd.pick(logp, labels).mean())
+                if variant == "xlaxent":
+                    # pre-r4 path: fp32 log_softmax + pick (materializes
+                    # the (B,T,V) fp32 log-prob tensor)
+                    logp = mx.nd.log_softmax(mlm_logits.astype("float32"))
+                    mlm = -(mx.nd.pick(logp, labels).mean())
+                    nsp_logp = mx.nd.log_softmax(nsp_logits.astype("float32"))
+                    return mlm + (-(nsp_logp[:, 0].mean()))
+                # bench.py flagship path: gluon loss -> fused Pallas
+                # xent kernel on TPU (ops/xent_kernel.py)
+                mlm = self.mlm_loss(mlm_logits, labels).mean()
                 nsp_logp = mx.nd.log_softmax(nsp_logits.astype("float32"))
                 return mlm + (-(nsp_logp[:, 0].mean()))
 
@@ -143,11 +155,15 @@ def build_and_measure(variant: str):
         for _ in range(WARMUP):
             loss = train_step()
         float(loss.asnumpy())
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            loss = train_step()
-        float(loss.asnumpy())
-        dt = time.perf_counter() - t0
+        import contextlib
+        ctx = (jax.profiler.trace(trace_dir) if trace_dir
+               else contextlib.nullcontext())
+        with ctx:
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                loss = train_step()
+            float(loss.asnumpy())
+            dt = time.perf_counter() - t0
         ms = dt / STEPS * 1e3
         toks = B * T * STEPS / dt
         return ms, toks

@@ -238,11 +238,17 @@ def run_variant(name):
 
     out = multi(convs_m, moms, x, y_lab)
     float(jnp.asarray(out[-1]))  # compile+warm
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = multi(convs_m, moms, x, y_lab)
-    float(jnp.asarray(out[-1]))
-    dt = (time.perf_counter() - t0) / (REPS * K)
+    import contextlib
+    trace_dir = os.environ.get("RESNET_TRACE_DIR")
+    ctx = (jax.profiler.trace(trace_dir) if trace_dir
+           else contextlib.nullcontext())  # timed region only: tracing
+    # the compile overflows the 2 GB XSpace protobuf cap
+    with ctx:
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = multi(convs_m, moms, x, y_lab)
+        float(jnp.asarray(out[-1]))
+        dt = (time.perf_counter() - t0) / (REPS * K)
     print(f"  {name:9s} {B/dt:7.0f} img/s   ({dt*1e3:.1f} ms/step)",
           flush=True)
 

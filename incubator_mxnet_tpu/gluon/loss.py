@@ -115,16 +115,42 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._sparse_label = sparse_label
         self._from_logits = from_logits
 
+    def _use_fused(self, p):
+        from ..ops.xent_kernel import should_fuse
+
+        return (self._sparse_label and not self._from_logits
+                and self._axis in (-1, p.ndim - 1)
+                and should_fuse(p.shape[-1]))
+
     def forward(self, pred, label, sample_weight=None):
         def f(p, l, *sw):
-            logp = p if self._from_logits else jax.nn.log_softmax(p, axis=self._axis)
-            if self._sparse_label:
+            if self._use_fused(p):
+                # streamed Pallas softmax-xent: no (N, V) fp32
+                # log-prob tensor is ever materialized (the measured
+                # ~3 ms of the BERT flagship step — ops/xent_kernel.py).
+                # Cast back so the public loss dtype stays p.dtype on
+                # every backend/branch.
+                from ..ops.xent_kernel import fused_sparse_xent
+
+                loss = fused_sparse_xent(p, l).astype(p.dtype)
+            elif self._sparse_label and not self._from_logits:
+                # same fp32-lse numerics as the fused kernel: upcast
+                # before log_softmax, round only the per-element loss
+                logp = jax.nn.log_softmax(p.astype(jnp.float32),
+                                          axis=self._axis)
                 li = l.astype(jnp.int32)
                 loss = -jnp.take_along_axis(logp, jnp.expand_dims(li, self._axis),
                                             axis=self._axis)
-                loss = jnp.squeeze(loss, axis=self._axis)
+                loss = jnp.squeeze(loss, axis=self._axis).astype(p.dtype)
             else:
-                loss = -jnp.sum(logp * _reshape_like(logp, l), axis=self._axis)
+                logp = p if self._from_logits else jax.nn.log_softmax(p, axis=self._axis)
+                if self._sparse_label:
+                    li = l.astype(jnp.int32)
+                    loss = -jnp.take_along_axis(logp, jnp.expand_dims(li, self._axis),
+                                                axis=self._axis)
+                    loss = jnp.squeeze(loss, axis=self._axis)
+                else:
+                    loss = -jnp.sum(logp * _reshape_like(logp, l), axis=self._axis)
             loss = _apply_weighting(loss, self._weight, sw[0] if sw else None)
             return self._mean_all_but_batch(loss)
 

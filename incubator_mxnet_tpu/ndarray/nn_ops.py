@@ -386,6 +386,18 @@ def SoftmaxOutput(data, label=None, grad_scale: float = 1.0, ignore_label: float
 
 def softmax_cross_entropy(data, label):
     def f(x, y):
+        from ..ops.xent_kernel import fused_sparse_xent, should_fuse
+
+        if should_fuse(x.shape[-1]):
+            # streamed kernel path: no (N, V) log-prob materialization
+            # (ops/xent_kernel.py; same fp32 lse numerics).  one_hot
+            # semantics for out-of-range labels (they contribute 0,
+            # where the kernel's gather would clip) are preserved
+            # explicitly.
+            yi = y.astype(jnp.int32)
+            nll = fused_sparse_xent(x, yi)
+            valid = (yi >= 0) & (yi < x.shape[-1])
+            return jnp.sum(jnp.where(valid, nll, 0.0)).astype(x.dtype)
         logp = jax.nn.log_softmax(x, axis=-1)
         oh = jax.nn.one_hot(y.astype(jnp.int32), x.shape[-1], dtype=x.dtype)
         return -jnp.sum(oh * logp)

@@ -1,0 +1,235 @@
+"""Fused sparse softmax cross-entropy over large vocabularies.
+
+The XLA path for `-log_softmax(logits)[label]` on a (B*T, 30k) logits
+tensor materializes the full fp32 log-probability tensor (measured on
+the BERT-large flagship: a 500 MB fp32 write + re-reads ≈ 3 ms of the
+step, `docs/performance.md`).  This kernel streams vocab chunks
+through VMEM with the online-softmax recurrence (the flash-attention
+trick applied to the loss): forward reads the logits ONCE and emits
+only per-row lse; backward regenerates softmax from the saved lse and
+writes d(logits) directly — no (N, V) fp32 tensor ever exists.
+
+The forward kernel does ONLY the V-wide streaming work (max/exp/sum);
+the O(N) `logits[label]` gather runs as an XLA gather on 4k elements,
+keeping forward per-lane VPU work minimal (an in-kernel label
+hit-accumulate across every block measured ~1.6x slower), and only the
+ragged tail vocab block pays masking.  The backward keeps the label
+compare IN-kernel: the alternative — an O(N) scatter of -g outside —
+measured ~6 ms (TPU serializes scalar scatters), vs ~0.3 ms for the
+per-lane compare.
+
+Numerics match the unfused fp32 reference: chunks are upcast to f32 in
+VMEM, max/sum accumulate in f32, and `lse = m + log(l)` is the same
+quantity XLA's log_softmax computes.  The kernel uses no TPU-only
+primitives, so interpret mode covers it on CPU in CI; non-TPU backends
+take an equivalent jnp reference (ref: src/operator/nn/softmax.cc
+SoftmaxOutput fused grad, SURVEY.md §2.3).
+
+API: `fused_sparse_xent(logits, labels) -> nll` per row, custom VJP in
+d(logits) only.  `logits`: (..., V); `labels`: int (...).
+
+Per-row vectors ride as (BR, 1) blocks — Mosaic wants 2D tiled
+operands (a bare s32[N] carries XLA's T(1024) layout, which kernel
+block tilings cannot match).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_sparse_xent", "should_fuse", "FUSED_MIN_CLASSES"]
+
+_BR = 128    # rows per block
+_BV = 7680   # vocab lanes per block (60 * 128)
+
+# below this class count the streamed kernel's per-call overhead
+# outweighs the (N, V) fp32 log-prob tensor it avoids
+FUSED_MIN_CLASSES = 512
+
+
+def should_fuse(num_classes: int) -> bool:
+    """THE gate both public xent entry points share (gluon loss and
+    mx.nd.softmax_cross_entropy) — one constant, one backend list."""
+    return num_classes >= FUSED_MIN_CLASSES and _kernel_backend()
+
+
+def _ceil(a, b):
+    return -(-a // b)
+
+
+def _fwd_kernel(x_ref, lse_ref, m_ref, l_ref, *, V, bv, nv):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def update(x):
+        m_old = m_ref[...]  # (BR, 1)
+        m_new = jnp.maximum(m_old, jnp.max(x, axis=1, keepdims=True))
+        # exp(-inf - -inf) would be NaN before any real lane arrives
+        corr = jnp.where(m_old == -jnp.inf, 0.0, jnp.exp(m_old - m_new))
+        l_ref[...] = l_ref[...] * corr + jnp.sum(
+            jnp.exp(x - m_new), axis=1, keepdims=True)
+        m_ref[...] = m_new
+
+    ragged = V % bv != 0
+    if ragged:
+        # only the LAST vocab block has out-of-range lanes to mask
+        @pl.when(j == nv - 1)
+        def _tail():
+            x = x_ref[...].astype(jnp.float32)
+            vidx = j * bv + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+            update(jnp.where(vidx < V, x, -jnp.inf))
+
+        @pl.when(j < nv - 1)
+        def _body():
+            update(x_ref[...].astype(jnp.float32))
+    else:
+        update(x_ref[...].astype(jnp.float32))
+
+    @pl.when(j == nv - 1)
+    def _emit():
+        lse_ref[...] = m_ref[...] + jnp.log(l_ref[...])
+
+
+def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref, *, bv):
+    # d(logits) = (softmax - onehot(label)) * g.  The label compare runs
+    # in-kernel: an O(N) XLA scatter for the -g term measured ~6 ms
+    # (4096 scalar updates serialize on TPU), the per-lane compare ~0.3.
+    # Out-of-range tail lanes write garbage that the BlockSpec clips at
+    # the array boundary.
+    from jax.experimental import pallas as pl
+
+    x = x_ref[...].astype(jnp.float32)
+    p = jnp.exp(x - lse_ref[...])  # (BR,1) broadcasts over lanes
+    vidx = pl.program_id(1) * bv + jax.lax.broadcasted_iota(
+        jnp.int32, x.shape, 1)
+    hit = (vidx == lab_ref[...]).astype(jnp.float32)
+    dx_ref[...] = ((p - hit) * g_ref[...]).astype(dx_ref.dtype)
+
+
+def _block_rows(N):
+    return _BR if N % _BR == 0 else (8 if N % 8 == 0 else 1)
+
+
+def _pallas_fwd_lse(x2, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, V = x2.shape
+    br = _block_rows(N)
+    bv = min(_BV, _ceil(V, 128) * 128)
+    nv = _ceil(V, bv)
+    lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, V=V, bv=bv, nv=nv),
+        grid=(_ceil(N, br), nv),
+        in_specs=[pl.BlockSpec((br, bv), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((br, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32),
+                        pltpu.VMEM((br, 1), jnp.float32)],
+        interpret=interpret,
+    )(x2)
+    return lse[:, 0]
+
+
+def _pallas_bwd(x2, labels, lse, g, interpret):
+    from jax.experimental import pallas as pl
+
+    N, V = x2.shape
+    br = _block_rows(N)
+    bv = min(_BV, _ceil(V, 128) * 128)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, bv=bv),
+        grid=(_ceil(N, br), _ceil(V, bv)),
+        in_specs=[
+            pl.BlockSpec((br, bv), lambda i, j: (i, j)),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, bv), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+        interpret=interpret,
+    )(x2, labels.astype(jnp.int32).reshape(N, 1), lse.reshape(N, 1),
+      g.astype(jnp.float32).reshape(N, 1))
+
+
+def _label_logit(x2, labels):
+    """logits[row, label] upcast to f32 — exact for bf16 inputs."""
+    lab = labels.astype(jnp.int32)[:, None]
+    return jnp.take_along_axis(x2, lab, axis=-1)[:, 0].astype(jnp.float32)
+
+
+def _ref_lse(x2):
+    return jax.scipy.special.logsumexp(x2.astype(jnp.float32), axis=-1)
+
+
+def _kernel_backend() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _lse_of(x2, interpret=False):
+    if _kernel_backend() or interpret:
+        return _pallas_fwd_lse(x2, interpret)
+    return _ref_lse(x2)
+
+
+@jax.custom_vjp
+def _xent2d(x2, labels):
+    return _lse_of(x2) - _label_logit(x2, labels)
+
+
+def _xent2d_fwd(x2, labels):
+    lse = _lse_of(x2)
+    return lse - _label_logit(x2, labels), (x2, labels, lse)
+
+
+def _xent2d_bwd(res, g):
+    x2, labels, lse = res
+    if _kernel_backend():
+        return _pallas_bwd(x2, labels, lse, g, interpret=False), None
+    p = jnp.exp(x2.astype(jnp.float32) - lse[:, None])
+    oh = jax.nn.one_hot(labels.astype(jnp.int32), x2.shape[-1],
+                        dtype=jnp.float32)
+    dx = ((p - oh) * g.astype(jnp.float32)[:, None]).astype(x2.dtype)
+    return dx, None
+
+
+_xent2d.defvjp(_xent2d_fwd, _xent2d_bwd)
+
+
+def fused_sparse_xent(logits, labels):
+    """Per-element negative log-likelihood `lse - logits[label]`.
+
+    logits: (..., V); labels: integer (...) matching the leading dims.
+    Returns f32 (...) — differentiable in logits (streamed Pallas
+    kernel on TPU; exact jnp reference elsewhere)."""
+    V = logits.shape[-1]
+    lead = logits.shape[:-1]
+    x2 = logits.reshape(-1, V)
+    nll = _xent2d(x2, labels.reshape(-1))
+    return nll.reshape(lead)
+
+
+def run_interpret(logits, labels):
+    """Interpret-mode kernel run (CPU CI parity for the kernel math)."""
+    V = logits.shape[-1]
+    x2 = logits.reshape(-1, V)
+    lse = _pallas_fwd_lse(x2, interpret=True)
+    nll = lse - _label_logit(x2, labels.reshape(-1))
+    return nll.reshape(logits.shape[:-1]), lse
+
+
+def run_interpret_bwd(logits, labels, lse, g):
+    V = logits.shape[-1]
+    x2 = logits.reshape(-1, V)
+    dx = _pallas_bwd(x2, labels.reshape(-1), lse.reshape(-1),
+                     g.reshape(-1), interpret=True)
+    return dx.reshape(logits.shape)

@@ -274,6 +274,32 @@ def _category(name: str, stats: Dict[str, object]) -> str:
     return n
 
 
+def aggregate_events(events) -> List[dict]:
+    """Fold XEvents into per-op rows {name, category, total_us,
+    occurrences, avg_us, flops, bytes_accessed}, most expensive first —
+    the shared core of device_op_table and tools/xprof_summary's
+    module-window view."""
+    agg = defaultdict(lambda: [0, 0, "", 0, 0])
+    for ev in events:
+        row = agg[ev.name]
+        row[0] += ev.duration_ps
+        row[1] += max(1, ev.num_occurrences)
+        if not row[2]:
+            row[2] = _category(ev.name, ev.stats)
+        # aggregated events (num_occurrences=N) carry per-occurrence
+        # cost-model stats: scale them so the column means TOTAL
+        # flops/bytes either way
+        occ = max(1, ev.num_occurrences)
+        row[3] += _as_int(ev.stats.get("flops")) * occ
+        row[4] += _as_int(ev.stats.get("bytes_accessed")) * occ
+    rows = [{"name": k, "category": v[2], "total_us": v[0] / 1e6,
+             "occurrences": v[1], "avg_us": v[0] / 1e6 / max(1, v[1]),
+             "flops": v[3], "bytes_accessed": v[4]}
+            for k, v in agg.items()]
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
 def device_op_table(logdir_or_file: str, device_substr: str = "TPU",
                     line_substr: str = "XLA Ops") -> List[dict]:
     """Aggregate per-op device time from a profiler trace directory.
@@ -292,7 +318,7 @@ def device_op_table(logdir_or_file: str, device_substr: str = "TPU",
         paths = [f for f in files if os.path.dirname(f) == run_dir]
     else:
         paths = [logdir_or_file]
-    agg = defaultdict(lambda: [0, 0, "", 0, 0])
+    events = []
     for path in paths:
         for plane in parse_xspace(path):
             if device_substr not in plane.name:
@@ -300,24 +326,8 @@ def device_op_table(logdir_or_file: str, device_substr: str = "TPU",
             for line in plane.lines:
                 if line_substr and line_substr not in line.name:
                     continue
-                for ev in line.events:
-                    row = agg[ev.name]
-                    row[0] += ev.duration_ps
-                    row[1] += max(1, ev.num_occurrences)
-                    if not row[2]:
-                        row[2] = _category(ev.name, ev.stats)
-                    # aggregated events (num_occurrences=N) carry
-                    # per-occurrence cost-model stats: scale them so the
-                    # column means TOTAL flops/bytes either way
-                    occ = max(1, ev.num_occurrences)
-                    row[3] += _as_int(ev.stats.get("flops")) * occ
-                    row[4] += _as_int(ev.stats.get("bytes_accessed")) * occ
-    rows = [{"name": k, "category": v[2], "total_us": v[0] / 1e6,
-             "occurrences": v[1], "avg_us": v[0] / 1e6 / max(1, v[1]),
-             "flops": v[3], "bytes_accessed": v[4]}
-            for k, v in agg.items()]
-    rows.sort(key=lambda r: -r["total_us"])
-    return rows
+                events.extend(line.events)
+    return aggregate_events(events)
 
 
 def category_summary(rows: List[dict]) -> List[dict]:

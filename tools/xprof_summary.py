@@ -1,0 +1,81 @@
+"""Summarize a device trace: per-op and per-category tables.
+
+Usage:
+    python tools/xprof_summary.py TRACE_DIR [--top N] [--module SUBSTR]
+
+TRACE_DIR is a directory written by `mx.profiler.start()` /
+`jax.profiler.trace` (the one containing plugins/profile/...), or a
+single .xplane.pb file.  With --module, restricts to ops inside the
+LAST execution of the first XLA module whose name contains SUBSTR
+(e.g. --module jit_train_step isolates one steady-state step).
+
+This is the per-operator view the reference's `profiler.dumps`
+aggregate table gave (src/profiler/profiler.cc): under XLA a train
+step is ONE fused program, so op attribution must come from the
+device trace — decoded by utils/xplane.py, no tensorboard required.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from incubator_mxnet_tpu.utils import xplane
+
+
+def module_window_rows(path, substr, device_substr="TPU"):
+    """Rows restricted to the last execution window of the matching
+    XLA module — the steady-state-step view."""
+    if os.path.isdir(path):
+        files = xplane.find_xplane_files(path)
+        if not files:
+            raise FileNotFoundError(f"no .xplane.pb under {path}")
+        path = files[-1]
+    planes = [p for p in xplane.parse_xspace(path) if device_substr in p.name]
+    if not planes:
+        raise RuntimeError("no device plane in trace")
+    rows = []
+    for plane in planes:
+        lines = {l.name: l for l in plane.lines}
+        mods = lines.get("XLA Modules")
+        opsl = lines.get("XLA Ops")
+        if not mods or not opsl:
+            continue
+        cand = [e for e in mods.events if substr in e.name]
+        if not cand:
+            continue
+        last = max(cand, key=lambda e: e.offset_ps)
+        w0, w1 = last.offset_ps, last.offset_ps + last.duration_ps
+        rows += xplane.aggregate_events(
+            ev for ev in opsl.events if w0 <= ev.offset_ps < w1)
+    rows.sort(key=lambda r: -r["total_us"])
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--module", default=None,
+                    help="restrict to the last run of this XLA module")
+    args = ap.parse_args()
+
+    if args.module:
+        rows = module_window_rows(args.trace, args.module)
+    else:
+        rows = xplane.device_op_table(args.trace)
+
+    total = sum(r["total_us"] for r in rows)
+    print(f"== categories (total {total/1e3:.2f} ms device time) ==")
+    for c in xplane.category_summary(rows)[:15]:
+        flops = sum(r["flops"] for r in rows if r["category"] == c["category"])
+        d = c["total_us"] / 1e6
+        tf = flops / d / 1e12 if d else 0.0
+        print(f"  {c['total_us']/1e3:9.3f} ms  {c['total_us']/total*100:5.1f}%"
+              f"  x{c['occurrences']:6d}  {tf:6.1f} TF/s  {c['category']}")
+    print(f"== top {args.top} ops ==")
+    print(xplane.dump_table(rows, top=args.top))
+
+
+if __name__ == "__main__":
+    main()
