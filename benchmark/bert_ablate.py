@@ -22,6 +22,11 @@ Variants:
   nomom    plain SGD, no momentum, no fp32 masters
   frozemb  embedding tables grad_req="null" — isolates the
            scatter-add embedding backward (a classic TPU slow path)
+  attntr   pre-r4 TRANSPOSED attention formulation (explicit (B,H,T,D)
+           copies + fp32 reference einsums) — A/B partner for the
+           shipped transpose-free attention_bthd path
+  xlaxent  pre-r4 fp32 log_softmax+pick loss (materializes the (B,T,V)
+           fp32 log-prob tensor) — A/B partner for the fused kernel
 """
 import os
 import sys
@@ -80,6 +85,36 @@ def build_and_measure(variant: str, trace_dir: str = None):
 
         real_fwd = MultiHeadAttention.forward
         MultiHeadAttention.forward = _no_scores_forward
+
+    if variant == "attntr":
+        # the pre-r4 TRANSPOSED formulation (explicit (B,H,T,D) copies
+        # + fp32 reference einsums) — the A/B partner for the shipped
+        # transpose-free attention_bthd path
+        from incubator_mxnet_tpu.models.bert import MultiHeadAttention
+        from incubator_mxnet_tpu.ops.flash_attention import attention_reference
+
+        def _transposed_forward(self, x, mask=None):
+            if mask is not None:
+                raise NotImplementedError("attntr variant: no mask path")
+            from incubator_mxnet_tpu.ndarray.ndarray import apply_op, wrap
+            x = wrap(x)
+            Bx, Tx, Cx = x.shape
+            Hn = self._num_heads
+            Dh = Cx // Hn
+            qkv = self.qkv(x)
+
+            def attend(qkv_raw):
+                q, k, v = jnp.split(qkv_raw, 3, axis=-1)
+                q = q.reshape(Bx, Tx, Hn, Dh).transpose(0, 2, 1, 3)
+                k = k.reshape(Bx, Tx, Hn, Dh).transpose(0, 2, 1, 3)
+                v = v.reshape(Bx, Tx, Hn, Dh).transpose(0, 2, 1, 3)
+                o = attention_reference(q, k, v)
+                return o.transpose(0, 2, 1, 3).reshape(Bx, Tx, Cx)
+
+            return self.proj(apply_op(attend, qkv))
+
+        real_fwd = MultiHeadAttention.forward
+        MultiHeadAttention.forward = _transposed_forward
 
     try:
         mx.random.seed(0)
@@ -173,14 +208,14 @@ def build_and_measure(variant: str, trace_dir: str = None):
         if variant == "relu":
             nn_ops.gelu = real_gelu
             mx.nd.gelu = real_gelu
-        if variant == "noattn":
+        if variant in ("noattn", "attntr"):
             MultiHeadAttention.forward = real_fwd
 
 
 def main():
     variants = sys.argv[1:] or ["full", "nodrop", "noxent", "nohead", "noln",
-                                "relu", "noattn", "nomom", "frozemb",
-                                "bf16xent"]
+                                "relu", "noattn", "nomom", "attntr",
+                                "xlaxent", "bf16xent"]
     print(f"device={jax.devices()[0].device_kind} B={B} T={T} L={L} D={D} "
           f"steps={STEPS}")
     base = None

@@ -323,6 +323,30 @@ def _flash_bwd_core(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
 _PALLAS_FWD_MIN_SCORES = 512 * 512
 
 
+def kernel_active(tq, tk, force_reference=False) -> bool:
+    """Would flash_attention take the Pallas kernel at these sizes?
+    Callers that stay on the XLA path can pick the layout-friendlier
+    `attention_bthd` formulation instead of transposing to (B,H,T,D)."""
+    return _use_pallas(jax.default_backend(), tq, tk, force_reference)
+
+
+def attention_bthd(q, k, v, scale: Optional[float] = None):
+    """Transpose-free XLA attention: q/k/v in (B, T, H, D) layout, the
+    einsums carry the head transposition, scores accumulate in f32.
+
+    Numerically equivalent to `attention_reference` for bf16-exact
+    inputs and finite scores (non-causal, unmasked); avoids the four
+    materialized (B,H,T,D) layout copies per call the transposed
+    formulation costs below the flash crossover."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def _use_pallas(platform, tq, tk, force_reference):
     if force_reference:
         return False
