@@ -445,9 +445,17 @@ def _bn_stats_f32(x, axis: int = 1):
     return mean, var
 
 
-def batch_norm_stats(x, axis: int = 1):
-    mean, var = _bn_stats_f32(x, axis)
-    return mean.astype(x.dtype), var.astype(x.dtype)
+def batch_norm_stats(data, axis: int = 1):
+    """Per-channel (mean, var) over all non-`axis` dims (ref:
+    batch_norm.cc stats kernels).  Accepts NDArray like every exported
+    op — it previously reached into `_bn_stats_f32` with the wrapper
+    type and crashed on public inputs."""
+
+    def f(x):
+        mean, var = _bn_stats_f32(x, axis)
+        return mean.astype(x.dtype), var.astype(x.dtype)
+
+    return apply_op(f, data, n_out=2)
 
 
 def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps: float = 1e-5,

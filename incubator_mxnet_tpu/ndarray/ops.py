@@ -157,6 +157,9 @@ for _n, _f in [
     ("broadcast_add", jnp.add), ("broadcast_plus", jnp.add),
     ("broadcast_sub", jnp.subtract), ("broadcast_minus", jnp.subtract),
     ("broadcast_mul", jnp.multiply), ("broadcast_div", jnp.divide),
+    # mshadow_op::mod is divisor-sign (fmod + divisor correction when
+    # signs differ) — i.e. python/numpy-style, same kernel `%` routes
+    # through upstream; jnp.mod matches it
     ("broadcast_mod", jnp.mod), ("broadcast_power", jnp.power),
     ("broadcast_maximum", jnp.maximum), ("broadcast_minimum", jnp.minimum),
     ("broadcast_hypot", jnp.hypot),

@@ -568,3 +568,203 @@ def test_embedding_matrix():
                               output_dim=5).asnumpy()
         onp.testing.assert_array_equal(out.astype("float32"),
                                        w[idx].astype("float32"))
+
+
+# ------------------------------------------------------------------ #
+# alias + misc sweep (VERDICT r3 #7 closure audit): every exported op
+# not covered by the families above, at >=2 shapes x >=2 dtypes where
+# the op is dtype-generic.  Aliases are asserted against the SAME
+# oracle as their canonical name — a broken alias rebind is a real
+# regression class (MXNet user code uses both spellings).
+# ------------------------------------------------------------------ #
+_ALIAS_BINARY = [
+    ("broadcast_plus", onp.add), ("broadcast_minus", onp.subtract),
+    ("broadcast_mod", lambda a, b: onp.mod(a, b)),  # divisor-sign (mshadow_op::mod)
+    ("broadcast_equal", onp.equal), ("broadcast_not_equal", onp.not_equal),
+    ("broadcast_greater", onp.greater),
+    ("broadcast_greater_equal", onp.greater_equal),
+    ("broadcast_lesser", onp.less),
+    ("broadcast_lesser_equal", onp.less_equal),
+    ("broadcast_logical_and", onp.logical_and),
+    ("broadcast_logical_or", onp.logical_or),
+    ("broadcast_logical_xor", onp.logical_xor),
+    ("elemwise_add", onp.add), ("elemwise_sub", onp.subtract),
+    ("elemwise_mul", onp.multiply), ("elemwise_div", onp.divide),
+]
+
+
+@pytest.mark.parametrize("shapes", [((3, 4), (3, 4)), ((2, 1, 4), (1, 3, 4))])
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_alias_binary_matrix(shapes, dtype):
+    sa, sb = shapes
+    a = _data(sa, dtype, "nonzero")
+    b = _data(sb, dtype, "nonzero")
+    tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" else {}
+    for name, oracle in _ALIAS_BINARY:
+        if name.startswith("elemwise") and sa != sb:
+            continue  # elemwise requires equal shapes by contract
+        got = getattr(mx.nd, name)(NDArray(a), NDArray(b)).asnumpy()
+        ref = oracle(a.astype("float32").astype(dtype).astype("float32"),
+                     b.astype("float32").astype(dtype).astype("float32"))
+        assert_almost_equal(got.astype("float32"),
+                            onp.asarray(ref, "float32"),
+                            names=(f"{name}/{dtype}", "oracle"), **tol)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_alias_reduce_and_axes_matrix(dtype):
+    tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" else {}
+    for shape in [(3, 4), (2, 3, 4)]:
+        x = _data(shape, dtype, "any")
+        for name, oracle in [("sum_axis", onp.sum), ("max_axis", onp.max),
+                             ("min_axis", onp.min)]:
+            got = getattr(mx.nd, name)(NDArray(x), axis=1).asnumpy()
+            assert_almost_equal(got.astype("float32"),
+                                oracle(x.astype("float32"), axis=1),
+                                names=(f"{name}/{dtype}/{shape}", "oracle"),
+                                **tol)
+    # broadcast_axis: expand a size-1 dim
+    x = _data((2, 1, 3), dtype, "any")
+    got = mx.nd.broadcast_axis(NDArray(x), axis=1, size=4).asnumpy()
+    onp.testing.assert_array_equal(
+        got.astype("float32"),
+        onp.broadcast_to(x, (2, 4, 3)).astype("float32"))
+    # reshape_like / Flatten / SwapAxis / Concat / SliceChannel
+    a = _data((2, 6), dtype, "any")
+    b = _data((3, 4), dtype, "any")
+    onp.testing.assert_array_equal(
+        mx.nd.reshape_like(NDArray(a), NDArray(b)).asnumpy().astype("float32"),
+        a.reshape(3, 4).astype("float32"))
+    c = _data((2, 3, 4), dtype, "any")
+    onp.testing.assert_array_equal(
+        mx.nd.Flatten(NDArray(c)).asnumpy().astype("float32"),
+        c.reshape(2, 12).astype("float32"))
+    onp.testing.assert_array_equal(
+        mx.nd.SwapAxis(NDArray(c), dim1=0, dim2=2).asnumpy().astype("float32"),
+        onp.swapaxes(c, 0, 2).astype("float32"))
+    cc = mx.nd.Concat(NDArray(b), NDArray(b), dim=0).asnumpy()
+    onp.testing.assert_array_equal(cc.astype("float32"),
+                                   onp.concatenate([b, b], 0).astype("float32"))
+    parts = mx.nd.SliceChannel(NDArray(c), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    onp.testing.assert_array_equal(parts[1].asnumpy().astype("float32"),
+                                   c[:, 1:2, :].astype("float32"))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_misc_math_ops_matrix(dtype):
+    tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" else {}
+    for shape in [(3, 5), (2, 3, 5)]:
+        x = _data(shape, dtype, "any")
+        got = mx.nd.hard_sigmoid(NDArray(x)).asnumpy()
+        assert_almost_equal(got.astype("float32"),
+                            onp.clip(0.2 * x.astype("float32") + 0.5, 0, 1),
+                            names=(f"hard_sigmoid/{dtype}", "oracle"), **tol)
+    # argmax_channel: per-row argmax over the LAST axis (upstream doc
+    # example: [[0,1,2],[3,4,5]] -> [2, 2])
+    x = _data((3, 4), dtype, "any")
+    got = mx.nd.argmax_channel(NDArray(x)).asnumpy()
+    onp.testing.assert_array_equal(got.astype(int),
+                                   x.astype("float32").argmax(-1))
+    # batch_dot incl. transposes
+    a = _data((2, 3, 4), dtype, "any")
+    b = _data((2, 4, 5), dtype, "any")
+    got = mx.nd.batch_dot(NDArray(a), NDArray(b)).asnumpy()
+    ref = onp.einsum("bij,bjk->bik", a.astype("float32"), b.astype("float32"))
+    assert_almost_equal(got.astype("float32"), ref,
+                        names=(f"batch_dot/{dtype}", "oracle"), **tol)
+    got = mx.nd.batch_dot(NDArray(a), NDArray(a), transpose_b=True).asnumpy()
+    ref = onp.einsum("bij,bkj->bik", a.astype("float32"), a.astype("float32"))
+    assert_almost_equal(got.astype("float32"), ref,
+                        names=(f"batch_dot_tb/{dtype}", "oracle"), **tol)
+    # khatri_rao (column-wise kron)
+    a = _data((2, 3), "float32", "any")
+    b = _data((4, 3), "float32", "any")
+    got = mx.nd.khatri_rao(NDArray(a), NDArray(b)).asnumpy()
+    ref = onp.vstack([onp.kron(a[:, j], b[:, j]).reshape(-1)
+                      for j in range(3)]).T.reshape(8, 3)
+    assert_almost_equal(got, ref, names=("khatri_rao", "oracle"))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_nn_misc_ops_matrix(dtype):
+    tol = dict(rtol=4e-2, atol=2e-2) if dtype == "bfloat16" else {}
+    for shape in [(3, 6), (2, 4, 6)]:
+        x = _data(shape, dtype, "any")
+        m = (RS.uniform(size=shape) > 0.3).astype("float32")
+        m[..., 0] = 1.0  # at least one unmasked entry per row
+        got = mx.nd.masked_log_softmax(NDArray(x), NDArray(m)).asnumpy()
+        xf = onp.where(m.astype(bool), x.astype("float32"), -onp.inf)
+        ref = xf - onp.log(onp.sum(onp.exp(
+            xf - xf.max(-1, keepdims=True)), -1, keepdims=True)) \
+            - xf.max(-1, keepdims=True)
+        assert_almost_equal(onp.where(m.astype(bool), got.astype("float32"), 0),
+                            onp.where(m.astype(bool), ref, 0),
+                            names=(f"masked_log_softmax/{dtype}", "oracle"),
+                            **tol)
+        # SoftmaxOutput forward == softmax
+        got = mx.nd.SoftmaxOutput(NDArray(x)).asnumpy()
+        e = onp.exp(x.astype("float32") - x.astype("float32").max(-1, keepdims=True))
+        assert_almost_equal(got.astype("float32"), e / e.sum(-1, keepdims=True),
+                            names=(f"SoftmaxOutput/{dtype}", "oracle"), **tol)
+        # gelu (tanh approximation)
+        got = mx.nd.gelu(NDArray(x)).asnumpy()
+        xf = x.astype("float32")
+        ref = 0.5 * xf * (1 + onp.tanh(onp.sqrt(2 / onp.pi)
+                                       * (xf + 0.044715 * xf ** 3)))
+        assert_almost_equal(got.astype("float32"), ref, rtol=2e-2, atol=2e-2,
+                            names=(f"gelu/{dtype}", "oracle"))
+    # GroupNorm + batch_norm_stats vs numpy oracles (fp32 only — stats)
+    x = _data((2, 6, 4), "float32", "any")
+    g = onp.ones((6,), "float32"); bta = onp.zeros((6,), "float32")
+    got = mx.nd.GroupNorm(NDArray(x), NDArray(g), NDArray(bta),
+                          num_groups=2).asnumpy()
+    xr = x.reshape(2, 2, 3 * 4)
+    mean = xr.mean(-1, keepdims=True); var = xr.var(-1, keepdims=True)
+    ref = ((xr - mean) / onp.sqrt(var + 1e-5)).reshape(2, 6, 4)
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4, names=("GroupNorm", "oracle"))
+    mean, var = mx.nd.batch_norm_stats(NDArray(x), axis=1)
+    onp.testing.assert_allclose(mean.asnumpy(), x.mean(axis=(0, 2)),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(var.asnumpy(), x.var(axis=(0, 2)),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_misc_ops_matrix():
+    # arange_like
+    x = NDArray(onp.zeros((3, 5), "float32"))
+    got = mx.nd.contrib.arange_like(x, start=2.0, step=0.5).asnumpy()
+    onp.testing.assert_allclose(got, (2.0 + 0.5 * onp.arange(15)).reshape(3, 5))
+    got = mx.nd.contrib.arange_like(x, axis=1).asnumpy()
+    onp.testing.assert_allclose(got, onp.arange(5, dtype="float32"))
+    # div_sqrt_dim
+    a = _data((2, 9), "float32", "any")
+    got = mx.nd.contrib.div_sqrt_dim(NDArray(a)).asnumpy()
+    onp.testing.assert_allclose(got, a / onp.sqrt(9.0), rtol=1e-6)
+    # getnnz
+    z = onp.asarray([[0.0, 1.0, 0.0], [2.0, 0.0, 3.0]], "float32")
+    assert int(mx.nd.contrib.getnnz(NDArray(z)).asnumpy()) == 3
+    onp.testing.assert_array_equal(
+        mx.nd.contrib.getnnz(NDArray(z), axis=0).asnumpy().astype(int),
+        [1, 1, 1])
+    # interleaved qkv attention ops vs explicit einsum oracle
+    T, B, H, Dh = 4, 2, 3, 5
+    qkv = RS.uniform(-1, 1, size=(T, B, 3 * H * Dh)).astype("float32")
+    xq = qkv.reshape(T, B, H, 3, Dh)
+    q, k, v = xq[..., 0, :], xq[..., 1, :], xq[..., 2, :]
+    qh = onp.transpose(q, (1, 2, 0, 3)).reshape(B * H, T, Dh)
+    kh = onp.transpose(k, (1, 2, 0, 3)).reshape(B * H, T, Dh)
+    vh = onp.transpose(v, (1, 2, 0, 3)).reshape(B * H, T, Dh)
+    got = mx.nd.contrib.interleaved_matmul_selfatt_qk(
+        NDArray(qkv), heads=H).asnumpy()
+    ref = onp.einsum("bqd,bkd->bqk", qh / onp.sqrt(Dh), kh)
+    assert_almost_equal(got, ref, rtol=1e-5, atol=1e-5,
+                        names=("interleaved_selfatt_qk", "oracle"))
+    att = onp.exp(ref - ref.max(-1, keepdims=True))
+    att = att / att.sum(-1, keepdims=True)
+    got = mx.nd.contrib.interleaved_matmul_selfatt_valatt(
+        NDArray(qkv), NDArray(att.astype("float32")), heads=H).asnumpy()
+    ref_out = onp.einsum("bqk,bkd->bqd", att, vh)
+    ref_out = ref_out.reshape(B, H, T, Dh).transpose(2, 0, 1, 3).reshape(T, B, H * Dh)
+    assert_almost_equal(got, ref_out, rtol=1e-5, atol=1e-5,
+                        names=("interleaved_selfatt_valatt", "oracle"))
