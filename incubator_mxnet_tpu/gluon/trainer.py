@@ -540,16 +540,21 @@ class Trainer:
             if ctx is None:
                 return False
             self._fullstep_ctx = ctx
+        import jax.numpy as jnp
+
         idx_of = ctx["idx_of"]
+        lr, keys = self._advance_scalars(idx_of)
         ts = ctx.get("ts_dev")
         if ts is None:
             # first step after a ctx (re)build: materialize ts from the
-            # authoritative host counts (one transfer)
-            ts, lr, keys = self._step_scalars(idx_of)
-        else:
-            # steady state: ts is device-resident, incremented inside
-            # the donated program — no per-step host→device transfer
-            lr, keys = self._advance_scalars(idx_of)
+            # authoritative host counts (one transfer).  int32 so the
+            # on-device +1 stays exact past 2^24 steps (an f32 counter
+            # would silently freeze there); the update rules receive the
+            # f32 cast inside the program.
+            ts = jnp.asarray([int(opt._index_update_count[i])
+                              for i in idx_of], jnp.int32)
+        # else: steady state — ts is device-resident, incremented inside
+        # the donated program; no per-step host→device transfer
         states = ctx["states"]
         input_raws = self._shard_inputs(pending.input_raws)
         out_leaves, new_aux, grads, new_w, new_s, new_ts, sync = ctx["fn"](
@@ -652,7 +657,10 @@ class Trainer:
                    else jnp.zeros_like(l) for i, l in enumerate(leaves)]
             cot = jax.tree_util.tree_unflatten(tdef, cts)
             (grads,) = pullback(cot)
-            new_w, new_s = stacked(train_raws, grads, states, ts, lr, wd,
+            # int32 device counter: exact +1 at any step count; update
+            # rules see the f32 view they expect
+            new_w, new_s = stacked(train_raws, grads, states,
+                                   ts.astype(jnp.float32), lr, wd,
                                    rescale, keys)
             out_leaves = jax.tree_util.tree_leaves(out)
             out_grads = tuple(grads) if keep_grads else ()
@@ -664,7 +672,7 @@ class Trainer:
                 else jnp.float32(0)
             # device-resident step counter: the caller feeds new_ts back
             # instead of re-uploading host counts every step
-            new_ts = ts + 1.0
+            new_ts = ts + 1
             return (tuple(out_leaves), new_aux, out_grads, new_w, new_s,
                     new_ts, sync)
 

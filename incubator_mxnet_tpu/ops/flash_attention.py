@@ -509,27 +509,47 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              scale: Optional[float] = None,
-                             block_q: int = 128, block_k: int = 128,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
                              force_reference: bool = False):
-    """Differentiable (out, logsumexp) attention — ring building block."""
+    """Differentiable (out, logsumexp) attention — ring building block.
+    Blocks default to shape-derived sizes (`_auto_block`)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash_lse(q, k, v, causal, scale, block_q, block_k,
-                      force_reference)
+    return _flash_lse(q, k, v, causal, scale, _auto_block(q.shape[2], block_q),
+                      _auto_block(k.shape[2], block_k), force_reference)
+
+
+def _auto_block(t: int, requested) -> int:
+    """Largest of (512, 256, 128) dividing t, else 128 (the kernel's
+    legacy fixed size).  Bigger forward blocks amortize per-grid-step
+    overhead exactly like the backward's >=512 floor: at T=8192 the
+    (512,512) forward measures 2.4x the (128,128) one (fwd+bwd
+    29.6 vs 70.2 ms on one v5e, B2 H16 D64)."""
+    if requested is not None:
+        return requested
+    for b in (512, 256, 128):
+        if t % b == 0:
+            return b
+    return 128
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None, block_k: Optional[int] = None,
                     force_reference: bool = False):
     """Fused attention. q,k,v: (B, H, T, D) jax arrays (or NDArray).
 
     TPU → Pallas kernel; CPU → same kernel via the Pallas interpreter
     for small shapes, XLA reference otherwise (identical numerics).
     Differentiable via a custom VJP (exact softmax-attention backward).
+    ``block_q``/``block_k`` default to shape-derived sizes (see
+    `_auto_block`); pass explicit ints to pin them.
     """
     from ..ndarray.ndarray import NDArray, apply_op, raw
 
     was_nd = isinstance(q, NDArray)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    block_q = _auto_block(q.shape[2], block_q)
+    block_k = _auto_block(k.shape[2], block_k)
     if was_nd:
         # eager NDArray path: route through apply_op so autograd.record()
         # tapes the custom VJP like any other op
