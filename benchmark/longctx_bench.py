@@ -2,21 +2,21 @@
 
 SURVEY.md §5.7 makes long context a first-class capability; this
 measures it END-TO-END through the public Gluon loop (same path as
-bench.py): a decoder-only TransformerLM at T=8192 — 16x the
+bench.py): a decoder-only TransformerLM at T=32768 — 64x the
 reference's fused-attention ceiling (T<=512, BASELINE.md) — trains on
 ONE v5e chip because the Pallas flash kernels keep attention memory
 O(T) and the streamed xent kernel never materializes the (B*T, 32k)
 fp32 log-prob tensor.
 
-    python benchmark/longctx_bench.py [T ...]   (default 2048 8192)
+    python benchmark/longctx_bench.py [T ...]   (default 2048 8192 32768)
 
 Prints tok/s and MFU per config (attention FLOPs 12*L*T*D dominate at
 long T, so MFU here exercises the flash kernels, not the matmuls).
 
-Single-chip ceiling: the forward flash kernel keeps the full K/V rows
-VMEM-resident, which tops out near T=8192 at this head count on the
-v5e's 16 MB VMEM — beyond that, shard the sequence (ring attention /
-`shard_params` on a seq>1 mesh, docs/long_context.md §2).
+The forward dispatches between a whole-KV-VMEM-resident kernel (below
+~1 MB per K/V tensor — fastest) and a streamed-KV grid kernel beyond
+it, so a single chip trains T=32k+; sequence sharding (ring attention,
+docs/long_context.md §2) scales past a chip's HBM.
 """
 import os
 import sys
@@ -94,7 +94,7 @@ def measure(T: int, B: int, dropout: float = 0.1):
 
 
 def main():
-    Ts = [int(a) for a in sys.argv[1:]] or [2048, 8192]
+    Ts = [int(a) for a in sys.argv[1:]] or [2048, 8192, 32768]
     print(f"TransformerLM V={V} D={D} L={L} H={H}, bf16 + fp32 masters, "
           f"dropout=0.1, public Gluon loop")
     for T in Ts:

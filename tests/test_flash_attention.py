@@ -171,3 +171,67 @@ def test_ring_flash_impl_matches_einsum_and_oracle(causal):
     for a, b in zip(gf, gr):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
                                     rtol=2e-4, atol=2e-4)
+
+
+
+
+@pytest.fixture
+def streamed_kv_forced(monkeypatch):
+    """Force the streamed-KV forward branch; clear _flash_core's jit
+    cache on BOTH sides so resident-path tests never hit streamed
+    traces (the threshold is a traced-in module global)."""
+    import sys
+
+    import incubator_mxnet_tpu.ops.flash_attention  # noqa: F401
+    fa_mod = sys.modules["incubator_mxnet_tpu.ops.flash_attention"]
+    fa_mod._flash_core.clear_cache()
+    monkeypatch.setattr(fa_mod, "_KV_RESIDENT_MAX_BYTES", 0)
+    yield fa_mod
+    fa_mod._flash_core.clear_cache()
+
+
+@pytest.mark.parametrize("tq,tk", [(8, 8), (8, 16), (16, 8), (7, 13)])
+@pytest.mark.parametrize("causal", [False, True])
+def test_streamed_kv_kernel_vs_reference(tq, tk, causal, streamed_kv_forced):
+    """The streamed-KV forward (KV walk as the innermost grid axis —
+    the beyond-VMEM path, `_fa_kernel_streamed`) must match the
+    reference exactly like the resident kernel does.  Small shapes
+    dispatch resident by the byte threshold, so force the streamed
+    branch."""
+    ks = jax.random.split(jax.random.PRNGKey(tq * 31 + tk), 3)
+    q = jax.random.normal(ks[0], (2, 2, tq, 8))
+    k = jax.random.normal(ks[1], (2, 2, tk, 8))
+    v = jax.random.normal(ks[2], (2, 2, tk, 8))
+    a, lse = _flash_core(q, k, v, causal, 8 ** -0.5, 4, 4, True)
+    b = attention_reference(q, k, v, causal, 8 ** -0.5)
+    onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                rtol=2e-5, atol=2e-5)
+    from incubator_mxnet_tpu.ops.flash_attention import _reference_lse
+
+    onp.testing.assert_allclose(
+        onp.asarray(lse), onp.asarray(_reference_lse(q, k, causal, 8 ** -0.5)),
+        rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streamed_kv_custom_vjp_grads(causal, streamed_kv_forced):
+    """Gradients through the streamed forward: its saved lse feeds the
+    same streaming backward kernels."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (1, 2, 8, 8))
+    k = jax.random.normal(ks[1], (1, 2, 8, 8))
+    v = jax.random.normal(ks[2], (1, 2, 8, 8))
+
+    def loss(fn):
+        def g(q, k, v):
+            return (fn(q, k, v) * (1 + jnp.arange(8.0))).sum()
+        return g
+
+    f_kernel = loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=4, block_k=4))
+    f_ref = loss(lambda q, k, v: attention_reference(q, k, v, causal))
+    gk = jax.grad(f_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=3e-5, atol=3e-5)
