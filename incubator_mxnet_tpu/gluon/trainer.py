@@ -396,8 +396,11 @@ class Trainer:
             old = self._inflight.popleft()
             try:
                 jax.block_until_ready(old)
-            except Exception:
-                pass  # donated/deleted buffer: the pipeline moved past it
+            except RuntimeError as e:
+                # donated/deleted buffer: the pipeline moved past it;
+                # real async execution errors must surface
+                if "deleted" not in str(e):
+                    raise
 
     def _throttle_bytes(self, leaf, held_bytes: int):
         """Byte-budgeted run-ahead bound for the one-program step.
@@ -428,8 +431,12 @@ class Trainer:
                 last = self._inflight.popleft()
             try:
                 jax.block_until_ready(last)
-            except Exception:
-                pass
+            except RuntimeError as e:
+                # a leaf donated into a later step is already consumed —
+                # benign; anything else is a REAL async execution error
+                # (e.g. device OOM) that must not be silently dropped
+                if "deleted" not in str(e):
+                    raise
 
     def _fused_step(self):
         opt = self._optimizer
@@ -543,6 +550,7 @@ class Trainer:
         import jax.numpy as jnp
 
         idx_of = ctx["idx_of"]
+        prev_num_update = opt.num_update
         lr, keys = self._advance_scalars(idx_of)
         ts = ctx.get("ts_dev")
         if ts is None:
@@ -556,22 +564,47 @@ class Trainer:
         # else: steady state — ts is device-resident, incremented inside
         # the donated program; no per-step host→device transfer
         states = ctx["states"]
-        input_raws = self._shard_inputs(pending.input_raws)
-        out_leaves, new_aux, grads, new_w, new_s, new_ts, sync = ctx["fn"](
-            pending.train_raws, pending.aux_raws, states, pending.rng,
-            pending.rng_ctr, input_raws, ts, lr, opt.wd,
-            opt.rescale_grad, keys)
-        ctx["ts_dev"] = new_ts
-        pending.fill_from_full_step(out_leaves, new_aux,
-                                    grads if self._keep_grads else None)
-        # ALWAYS bound the dispatch queue: even with keep_grads=False the
-        # non-donated forward outputs (e.g. a (B,T,V) logits leaf in the
-        # canonical net→loss chain) are held by every in-flight step, so
-        # unbounded run-ahead still exhausts HBM.  The sync leaf is a
-        # dedicated non-donated scalar — waiting on it never touches the
-        # donated buffers.  Byte-budgeted: programs with small outputs
-        # never pay the (expensive-on-relays) host sync.
-        self._throttle_bytes(sync, ctx["held_bytes"])
+        try:
+            input_raws = self._shard_inputs(pending.input_raws)
+            out_leaves, new_aux, grads, new_w, new_s, new_ts, sync = ctx["fn"](
+                pending.train_raws, pending.aux_raws, states, pending.rng,
+                pending.rng_ctr, input_raws, ts, lr, opt.wd,
+                opt.rescale_grad, keys)
+            ctx["ts_dev"] = new_ts
+            pending.fill_from_full_step(out_leaves, new_aux,
+                                        grads if self._keep_grads else None)
+            # ALWAYS bound the dispatch queue: even with keep_grads=False
+            # the non-donated forward outputs (e.g. a (B,T,V) logits leaf
+            # in the canonical net→loss chain) are held by every in-flight
+            # step, so unbounded run-ahead still exhausts HBM.  The sync
+            # leaf is a dedicated non-donated scalar — waiting on it never
+            # touches the donated buffers.  Byte-budgeted: programs with
+            # small outputs never pay the (expensive-on-relays) host sync.
+            # Execution errors of EARLIER in-flight steps also surface
+            # here (async dispatch): the rollback below restores only the
+            # CURRENT step's count — counts of steps dispatched between
+            # the failed program and now stay advanced (indistinguishable
+            # without per-step error tracking); ctx teardown still forces
+            # a clean rebuild.
+            self._throttle_bytes(sync, ctx["held_bytes"])
+        except Exception:
+            # A mid-flight failure (e.g. transient OOM) may have
+            # invalidated the donated buffers (weights, states, ts), and
+            # the host counts advanced above would leave a retry running
+            # one step ahead of the actual update.  Preserve the latest
+            # live states (the per-index dict still holds buffers that
+            # were donated into earlier steps), drop the ctx so the next
+            # step rebuilds from authoritative host state, and roll the
+            # count advance back.
+            try:
+                self._sync_states()
+            except Exception:
+                pass  # states themselves invalidated: rebuild will surface it
+            self._fullstep_ctx = None
+            for i in idx_of:
+                opt._index_update_count[i] -= 1
+            opt.num_update = prev_num_update
+            raise
         for nd, nw in zip(ctx["nds"], new_w):
             nd._data = nw
         ctx["states"] = new_s

@@ -36,10 +36,21 @@ _jax_dir: Optional[str] = None
 _last_jax_dir: Optional[str] = None
 
 
+_trace_failed = False
+
+
 def _trace_dir() -> str:
     """The device-trace dir: the one actually recorded by the last
     start()/stop() cycle if any (robust against set_config(filename=..)
-    between stop() and a table query), else derived from config."""
+    between stop() and a table query), else derived from config.  After
+    a FAILED start() the config-derived fallback would resolve to the
+    previous run's directory and silently report a stale trace — error
+    visibly instead."""
+    if _trace_failed:
+        raise RuntimeError(
+            "the last profiler.start() failed to begin a device trace; "
+            "no current-run trace exists (pass logdir= explicitly to "
+            "query an older trace)")
     return _last_jax_dir or (os.path.splitext(_config["filename"])[0]
                              + "_xla")
 
@@ -49,10 +60,11 @@ def set_config(**kwargs):
 
 
 def start(profile_process="worker"):
-    global _running, _jax_dir, _last_jax_dir
+    global _running, _jax_dir, _last_jax_dir, _trace_failed
     _running = True
     _events.clear()
     _agg.clear()
+    _trace_failed = False  # each start() gets a fresh verdict
     if _config.get("profile_all") or _config.get("profile_symbolic"):
         try:
             import jax
@@ -61,7 +73,12 @@ def start(profile_process="worker"):
             jax.profiler.start_trace(_jax_dir)
             _last_jax_dir = _jax_dir
         except Exception:
+            # also forget the previous run's dir so device_op_table()
+            # can't silently report a stale trace as the current run
+            # (_trace_dir() errors visibly until a start() succeeds)
             _jax_dir = None
+            _last_jax_dir = None
+            _trace_failed = True
 
 
 def stop(profile_process="worker"):

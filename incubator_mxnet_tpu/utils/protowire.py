@@ -37,8 +37,11 @@ class Reader:
 
     def varint(self) -> int:
         shift = n = 0
-        buf, pos = self.buf, self.pos
+        buf, pos, end = self.buf, self.pos, self.end
         while True:
+            if pos >= end or shift > 63:
+                raise ValueError(
+                    "truncated/overlong varint at byte %d" % self.pos)
             b = buf[pos]
             pos += 1
             n |= (b & 0x7F) << shift

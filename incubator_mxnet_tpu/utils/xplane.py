@@ -259,6 +259,17 @@ def find_xplane_files(logdir: str) -> List[str]:
                             recursive=True))
 
 
+def latest_run_files(logdir: str) -> List[str]:
+    """Every .xplane.pb of the LATEST run directory under `logdir` (one
+    file per host in multi-host traces) — the shared file-selection rule
+    for all trace-view tools, so their totals stay comparable."""
+    files = find_xplane_files(logdir)
+    if not files:
+        raise FileNotFoundError(f"no .xplane.pb under {logdir}")
+    run_dir = os.path.dirname(files[-1])
+    return [f for f in files if os.path.dirname(f) == run_dir]
+
+
 def _as_int(v) -> int:
     try:
         return int(v)
@@ -311,11 +322,7 @@ def device_op_table(logdir_or_file: str, device_substr: str = "TPU",
     analogue of the reference profiler's per-operator aggregate table,
     with XLA's cost-model FLOPs/bytes carried through when reported."""
     if os.path.isdir(logdir_or_file):
-        files = find_xplane_files(logdir_or_file)
-        if not files:
-            raise FileNotFoundError(f"no .xplane.pb under {logdir_or_file}")
-        run_dir = os.path.dirname(files[-1])
-        paths = [f for f in files if os.path.dirname(f) == run_dir]
+        paths = latest_run_files(logdir_or_file)
     else:
         paths = [logdir_or_file]
     events = []

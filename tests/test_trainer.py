@@ -309,3 +309,43 @@ def test_explicit_inflight_step_cap_honored():
         tr.step(4)
     assert tr._fullstep_ctx is not None, "full-step path must engage"
     assert len(tr._inflight) <= 3
+
+
+def test_full_step_failure_rolls_back_and_recovers():
+    """A mid-flight failure of the fused-step program must (a) propagate,
+    (b) roll back the host update counts, (c) drop the fullstep ctx, and
+    (d) leave the trainer able to rebuild and train on the next step
+    (ADVICE r4: trainer.py fullstep exception safety)."""
+    net = _make_net(seed=3)
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9})
+    x, y = _data(seed=4)
+    loss_fn = mx.gluon.loss.L2Loss()
+
+    def _steps(n):
+        for _ in range(n):
+            with autograd.record():
+                L = loss_fn(net(NDArray(x)), NDArray(y))  # canonical chain
+            L.backward()
+            trainer.step(x.shape[0])
+
+    _steps(3)  # reach fused full-step steady state
+    opt = trainer._optimizer
+    ctx = trainer._fullstep_ctx
+    assert ctx is not None
+    counts_before = dict(opt._index_update_count)
+    nu_before = opt.num_update
+    ctx["fn"] = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("synthetic transient failure"))
+    with pytest.raises(RuntimeError, match="synthetic"):
+        _steps(1)
+    assert trainer._fullstep_ctx is None
+    assert dict(opt._index_update_count) == counts_before
+    assert opt.num_update == nu_before
+    # recovery: the next step rebuilds the ctx from live host state
+    w0 = onp.asarray(net[0].weight.data().asnumpy())
+    _steps(1)
+    assert trainer._fullstep_ctx is not None
+    assert opt.num_update == nu_before + 1
+    assert not onp.allclose(w0, net[0].weight.data().asnumpy())

@@ -167,3 +167,15 @@ def test_live_cpu_trace(tmp_path):
     assert files, "profiler wrote no xplane file"
     planes = xplane.parse_xspace(files[-1])
     assert planes and any(p.lines for p in planes)
+
+
+def test_varint_truncated_and_overlong():
+    """Corrupt .pb input raises a clear parse error, not IndexError."""
+    import pytest
+    from incubator_mxnet_tpu.utils.protowire import Reader
+
+    with pytest.raises(ValueError, match="varint"):
+        Reader(bytes([0x80, 0x80])).varint()  # continuation bit at EOF
+    with pytest.raises(ValueError, match="varint"):
+        Reader(bytes([0x80] * 11 + [0x01])).varint()  # >10-byte varint
+    assert Reader(bytes([0x96, 0x01])).varint() == 150  # normal path intact

@@ -27,14 +27,17 @@ def module_window_rows(path, substr, device_substr="TPU"):
     """Rows restricted to the last execution window of the matching
     XLA module — the steady-state-step view."""
     if os.path.isdir(path):
-        files = xplane.find_xplane_files(path)
-        if not files:
-            raise FileNotFoundError(f"no .xplane.pb under {path}")
-        path = files[-1]
-    planes = [p for p in xplane.parse_xspace(path) if device_substr in p.name]
+        paths = xplane.latest_run_files(path)  # device_op_table's rule
+    else:
+        paths = [path]
+    planes = [p for f in paths for p in xplane.parse_xspace(f)
+              if device_substr in p.name]
     if not planes:
         raise RuntimeError("no device plane in trace")
-    rows = []
+    # collect every plane's window events first, aggregate ONCE — so a
+    # multi-host run dir yields one merged row per op, same as
+    # device_op_table, not one fractional row per host file
+    window_events = []
     for plane in planes:
         lines = {l.name: l for l in plane.lines}
         mods = lines.get("XLA Modules")
@@ -46,10 +49,9 @@ def module_window_rows(path, substr, device_substr="TPU"):
             continue
         last = max(cand, key=lambda e: e.offset_ps)
         w0, w1 = last.offset_ps, last.offset_ps + last.duration_ps
-        rows += xplane.aggregate_events(
-            ev for ev in opsl.events if w0 <= ev.offset_ps < w1)
-    rows.sort(key=lambda r: -r["total_us"])
-    return rows
+        window_events += [ev for ev in opsl.events
+                          if w0 <= ev.offset_ps < w1]
+    return xplane.aggregate_events(window_events)  # sorted by -total_us
 
 
 def main():
