@@ -570,32 +570,13 @@ class Trainer:
                 pending.train_raws, pending.aux_raws, states, pending.rng,
                 pending.rng_ctr, input_raws, ts, lr, opt.wd,
                 opt.rescale_grad, keys)
-            ctx["ts_dev"] = new_ts
-            pending.fill_from_full_step(out_leaves, new_aux,
-                                        grads if self._keep_grads else None)
-            # ALWAYS bound the dispatch queue: even with keep_grads=False
-            # the non-donated forward outputs (e.g. a (B,T,V) logits leaf
-            # in the canonical net→loss chain) are held by every in-flight
-            # step, so unbounded run-ahead still exhausts HBM.  The sync
-            # leaf is a dedicated non-donated scalar — waiting on it never
-            # touches the donated buffers.  Byte-budgeted: programs with
-            # small outputs never pay the (expensive-on-relays) host sync.
-            # Execution errors of EARLIER in-flight steps also surface
-            # here (async dispatch): the rollback below restores only the
-            # CURRENT step's count — counts of steps dispatched between
-            # the failed program and now stay advanced (indistinguishable
-            # without per-step error tracking); ctx teardown still forces
-            # a clean rebuild.
-            self._throttle_bytes(sync, ctx["held_bytes"])
         except Exception:
-            # A mid-flight failure (e.g. transient OOM) may have
-            # invalidated the donated buffers (weights, states, ts), and
-            # the host counts advanced above would leave a retry running
-            # one step ahead of the actual update.  Preserve the latest
-            # live states (the per-index dict still holds buffers that
-            # were donated into earlier steps), drop the ctx so the next
-            # step rebuilds from authoritative host state, and roll the
-            # count advance back.
+            # Pre-dispatch / trace-time failure (bad input transfer,
+            # compile error, synchronous OOM at dispatch): nothing was
+            # donated, so full rollback is SOUND — preserve the latest
+            # live states, drop the ctx so the next step rebuilds from
+            # authoritative host state, and undo the count advance so a
+            # retry doesn't run one step ahead.
             try:
                 self._sync_states()
             except Exception:
@@ -605,10 +586,33 @@ class Trainer:
                 opt._index_update_count[i] -= 1
             opt.num_update = prev_num_update
             raise
+        ctx["ts_dev"] = new_ts
+        pending.fill_from_full_step(out_leaves, new_aux,
+                                    grads if self._keep_grads else None)
         for nd, nw in zip(ctx["nds"], new_w):
             nd._data = nw
         ctx["states"] = new_s
         self._states_stale = True  # dict synced lazily (save_states/fallback)
+        # ALWAYS bound the dispatch queue: even with keep_grads=False the
+        # non-donated forward outputs (e.g. a (B,T,V) logits leaf in the
+        # canonical net→loss chain) are held by every in-flight step, so
+        # unbounded run-ahead still exhausts HBM.  The sync leaf is a
+        # dedicated non-donated scalar — waiting on it never touches the
+        # donated buffers.  Byte-budgeted: programs with small outputs
+        # never pay the (expensive-on-relays) host sync.
+        try:
+            self._throttle_bytes(sync, ctx["held_bytes"])
+        except Exception:
+            # ASYNC execution error of an in-flight step surfacing at the
+            # throttle's host sync.  The failed program already consumed
+            # its donated inputs and its outputs (which params/states now
+            # reference) are poisoned — the step chain is UNRECOVERABLE
+            # in-process, and whether any given step's update applied is
+            # unknowable, so counts are deliberately NOT rolled back.
+            # Drop the ctx and re-raise the true device error; recovery
+            # is a checkpoint restore (utils.checkpoint / autoresume).
+            self._fullstep_ctx = None
+            raise
         return True
 
     def _prepare_full_step(self, pending, sig):

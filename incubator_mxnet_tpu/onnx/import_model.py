@@ -146,10 +146,70 @@ def _run_node(node, env):
         else:
             s = jax.lax.reduce_window(x[0], 0.0, jax.lax.add, dims,
                                       strides, pad4)
-            size = 1
-            for kk in k:
-                size *= kk
-            out(s / size)
+            if a.get("count_include_pad", 0):
+                size = 1
+                for kk in k:
+                    size *= kk
+                out(s / size)
+            else:
+                # ONNX default: padded cells do NOT count — divide by
+                # the per-window count of real elements
+                ones = jnp.ones(x[0].shape, s.dtype)
+                cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                            dims, strides, pad4)
+                out(s / cnt)
+    elif op == "BatchNormalization":
+        # inference form: (x - mean) / sqrt(var + eps) * scale + B,
+        # stats broadcast over the channel axis (1)
+        scale, b, mean, var = x[1], x[2], x[3], x[4]
+        shape = (1, -1) + (1,) * (x[0].ndim - 2)
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + a.get("epsilon", 1e-5))
+        out(((x[0].astype(jnp.float32) - mean.reshape(shape))
+             * (inv * scale).reshape(shape)
+             + b.reshape(shape)).astype(x[0].dtype))
+    elif op == "Flatten":
+        ax = a.get("axis", 1)
+        ax = ax + x[0].ndim if ax < 0 else ax
+        lead = 1
+        for d in x[0].shape[:ax]:
+            lead *= d
+        out(x[0].reshape(lead, -1))
+    elif op == "Clip":
+        # bind min/max POSITIONALLY from node.inputs — an omitted min is
+        # encoded as an empty name ("x", "", "max"), which the filtered
+        # x list would mis-bind
+        ins = node.inputs
+        lo = env[ins[1]] if len(ins) > 1 and ins[1] else a.get("min")
+        hi = env[ins[2]] if len(ins) > 2 and ins[2] else a.get("max")
+        out(jnp.clip(env[ins[0]], lo, hi))
+    elif op == "LeakyRelu":
+        out(jnp.where(x[0] >= 0, x[0], a.get("alpha", 0.01) * x[0]))
+    elif op == "Unsqueeze":
+        axes = (onp.asarray(x[1]).tolist() if len(x) > 1
+                else list(a["axes"]))
+        v = x[0]
+        for ax in sorted(d + v.ndim + len(axes) if d < 0 else d
+                         for d in axes):
+            v = jnp.expand_dims(v, ax)
+        out(v)
+    elif op == "Dropout":
+        out(x[0])  # inference graph: identity (mask output unused)
+    elif op == "Constant":
+        if "value" in a:
+            out(jnp.asarray(a["value"]))
+        elif "value_float" in a or "value_int" in a:
+            out(jnp.asarray(a.get("value_float", a.get("value_int"))))
+        elif "value_floats" in a or "value_ints" in a:
+            out(jnp.asarray(a.get("value_floats", a.get("value_ints"))))
+        else:
+            raise NotImplementedError(
+                f"ONNX import: Constant node {node.name!r} uses an "
+                f"unsupported value attribute variant ({sorted(a)})")
+    elif op == "Sum":
+        r = x[0]
+        for v in x[1:]:
+            r = r + v
+        out(r)
     elif op in ("GlobalMaxPool", "GlobalAveragePool"):
         axes = tuple(range(2, x[0].ndim))
         fn = jnp.max if op == "GlobalMaxPool" else jnp.mean

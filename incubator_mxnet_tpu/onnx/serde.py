@@ -41,6 +41,7 @@ BFLOAT16 = 16
 ATTR_FLOAT = 1
 ATTR_INT = 2
 ATTR_STRING = 3
+ATTR_TENSOR = 4
 ATTR_GRAPH = 5
 ATTR_FLOATS = 6
 ATTR_INTS = 7
@@ -164,6 +165,10 @@ def _encode_attr(name: str, value) -> bytes:
     elif isinstance(value, Graph):
         out += _len_delim(6, _encode_graph(value)) \
             + _int_field(20, ATTR_GRAPH)
+    elif isinstance(value, onp.ndarray):
+        # tensor attribute (e.g. Constant's `value`)
+        out += _len_delim(5, _encode_tensor("", value)) \
+            + _int_field(20, ATTR_TENSOR)
     elif isinstance(value, (list, tuple)) and value and isinstance(value[0], float):
         for v in value:
             out += _float_field(7, float(v))
@@ -268,6 +273,7 @@ def _decode_attr(buf: bytes):
     r = _Reader(buf)
     name, val, typ = "", None, None
     graph_val = None
+    tensor_val = None
     floats, ints = [], []
     while not r.eof():
         f, v = r.field()
@@ -279,6 +285,8 @@ def _decode_attr(buf: bytes):
             val = v
         elif f == 4:
             val = v.decode()
+        elif f == 5:
+            tensor_val = _decode_tensor(v)[1]
         elif f == 6:
             graph_val = _decode_graph(v)
         elif f == 7:
@@ -293,6 +301,8 @@ def _decode_attr(buf: bytes):
         val = ints
     elif typ == ATTR_GRAPH:
         val = graph_val
+    elif typ == ATTR_TENSOR:
+        val = tensor_val
     return name, val
 
 

@@ -29,8 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 log = logging.getLogger(__name__)
 
-__all__ = ["TP_RULES_TRANSFORMER", "ShardingReport", "spec_for",
-           "shard_params", "shard_param_tree", "data_parallel_spec"]
+__all__ = ["TP_RULES_TRANSFORMER", "TP_RULES_VISION", "ShardingReport",
+           "spec_for", "shard_params", "shard_param_tree",
+           "data_parallel_spec"]
 
 # (path regex, PartitionSpec) — first match wins; matched with
 # re.search against the structural path.  Specs refer to the 'model'
@@ -52,8 +53,28 @@ TP_RULES_TRANSFORMER: List[Tuple[str, P]] = [
     # alternative is anchored to a path segment so it cannot swallow
     # `*_proj` names handled above
     (r"(^|\.)(out_proj|o_proj|proj)\.weight$", P(None, "model")),
+    # column parallel: BERT pooler and the MLM transform dense (D, D)
+    (r"(pooler|mlm_dense|transform)\.weight$", P("model", None)),
+    # EXPLICITLY replicated: tiny classification heads (NSP's (2, D) —
+    # out-dim too small for a useful shard) — a rule, not an omission,
+    # so the report counts them as justified
+    (r"(^|\.)(nsp|cls|classifier)\.weight$", P()),
     # replicated: norms, biases, BN stats
     (r"(gamma|beta|bias|running_mean|running_var)$", P()),
+]
+
+# Vision nets (conv zoo): channel parallelism.  Conv weights are OIHW
+# (`gluon/nn/conv_layers.py:43`) — shard the OUT-channel dim; `_pad_spec`
+# truncates the same rule to P('model', None) for 2-D Dense classifier
+# weights (column parallel).  Per-channel 1-D params (BN stats, biases)
+# stay replicated — they are tiny, and replication keeps them valid for
+# any activation layout XLA picks.  Model-zoo blocks are built from
+# HybridSequential, so structural paths are numeric ('features.4.conv0
+# .weight'); matching on the `.weight`/statistic SUFFIX is therefore the
+# reliable signal, unlike the transformer rules' named-layer patterns.
+TP_RULES_VISION: List[Tuple[str, P]] = [
+    (r"(gamma|beta|bias|running_mean|running_var)$", P()),
+    (r"\.weight$", P("model", None, None, None)),
 ]
 
 
@@ -78,6 +99,7 @@ class ShardingReport(dict):
         self.seq_parallel = 0  # attention blocks routed to ring SP
         self.expert_parallel = 0  # MoE blocks routed to all_to_all EP
         self._elems_sharded = 0
+        self._elems_justified = 0  # replicated BY RULE/recorded fallback
         self._elems_matrix = 0
 
     @property
@@ -86,14 +108,24 @@ class ShardingReport(dict):
         sharded — the honest TP-memory-savings number."""
         return self._elems_sharded / max(1, self._elems_matrix)
 
+    @property
+    def accounted(self) -> float:
+        """Fraction of matrix-param elements that are either sharded or
+        replicated for a STATED reason (an explicit replicate rule, or a
+        fallback whose cause is recorded).  100% means no parameter's
+        placement is unexplained; anything below points at `unmatched`."""
+        return ((self._elems_sharded + self._elems_justified)
+                / max(1, self._elems_matrix))
+
     def summary(self) -> str:
         lines = [f"shard_params: {len(self.sharded)} sharded / "
                  f"{len(self.replicated)} replicated "
-                 f"({self.coverage:.0%} of matrix-param elements sharded)"]
+                 f"({self.coverage:.0%} of matrix-param elements sharded, "
+                 f"{self.accounted:.0%} accounted)"]
         for n, (want, why) in self.fallbacks.items():
             lines.append(f"  FALLBACK {n}: wanted {want} but {why}")
         if self.unmatched:
-            lines.append(f"  no rule matched (replicated): "
+            lines.append(f"  no rule matched (replicated, UNACCOUNTED): "
                          f"{', '.join(self.unmatched)}")
         return "\n".join(lines)
 
@@ -189,11 +221,15 @@ def shard_params(block, mesh: Mesh, rules=None, dp_axis: Optional[str] = None,
         else:
             if tp_failed:
                 report.replicated[name] = reason or "validation"
+                report._elems_justified += \
+                    _nelems(p.shape) if len(p.shape) >= 2 else 0
             elif not matched and len(p.shape) >= 2:
                 report.unmatched.append(name)
                 report.replicated[name] = "no rule matched"
             else:
                 report.replicated[name] = "rule: replicated"
+                report._elems_justified += \
+                    _nelems(p.shape) if len(p.shape) >= 2 else 0
         if len(p.shape) >= 2:
             report._elems_matrix += _nelems(p.shape)
     if warn:

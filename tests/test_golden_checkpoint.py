@@ -1,0 +1,82 @@
+"""Checkpoint wire-format backward-compat gate (r4 VERDICT item 7).
+
+The committed binaries under tests/fixtures/golden_ckpt/ were written
+by the r5 codebase (generate.py there) and are NEVER regenerated: this
+test proves the CURRENT code still (a) parses those exact bytes, (b)
+re-encodes the `.params` payload byte-for-byte identically (writer
+stability — a silent format fork would bifurcate every saved model),
+and (c) resumes full train state from the bundle and trains a step.
+Translation of the reference's model_backwards_compat_train/inference
+nightlies (SURVEY.md §4) to this framework's formats.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fixtures", "golden_ckpt")
+
+
+def _fresh_net(seed=999):
+    """Same architecture as generate.py, DIFFERENT init."""
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    net(NDArray(jnp.ones((4, 8), jnp.float32)))
+    net.hybridize()
+    return net
+
+
+def test_golden_params_load_and_writer_stability(tmp_path):
+    net = _fresh_net()
+    before = net[0].weight.data().asnumpy().copy()
+    net.load_parameters(os.path.join(HERE, "net.params"))
+    after = net[0].weight.data().asnumpy()
+    assert not onp.allclose(before, after), "load was a no-op"
+    assert net[0].weight.shape == (16, 8)
+    # writer stability: re-encoding the loaded params must reproduce the
+    # committed golden file EXACTLY
+    out = tmp_path / "resaved.params"
+    net.save_parameters(str(out))
+    golden = open(os.path.join(HERE, "net.params"), "rb").read()
+    assert out.read_bytes() == golden, \
+        ".params writer no longer byte-stable vs the committed golden file"
+
+
+def test_golden_bundle_restores_and_trains():
+    net = _fresh_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    mgr = CheckpointManager(os.path.join(HERE, "bundle"), keep=0,
+                            async_save=False)
+    info = mgr.restore(net=net, trainer=trainer)
+    assert info["step"] == 2
+    assert info["iterator_state"] == {"epoch": 0, "batch": 2}
+    assert info["extra"] == {"note": "golden r5 fixture"}
+    assert trainer._optimizer.num_update == 2
+    # momentum state restored for every param
+    assert len(trainer._states) == len(trainer._params)
+    # and the restored state trains: one full step, params move, no NaN
+    loss_fn = gluon.loss.L2Loss()
+    k = jax.random.PRNGKey(0)
+    x = NDArray(jax.random.normal(k, (4, 8), jnp.float32))
+    y = NDArray(jnp.zeros((4, 4), jnp.float32))
+    w0 = net[0].weight.data().asnumpy().copy()
+    with autograd.record():
+        L = loss_fn(net(x), y)
+    L.backward()
+    trainer.step(4)
+    lv = float(L.asnumpy().mean())
+    assert lv == lv
+    assert not onp.allclose(w0, net[0].weight.data().asnumpy())
+    assert trainer._optimizer.num_update == 3
