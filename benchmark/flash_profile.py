@@ -1,0 +1,92 @@
+"""Per-kernel flash-attention device profile at long T (r4 VERDICT #2).
+
+Times each Pallas kernel (fwd resident/streamed, bwd dK/dV, bwd dQ) in
+isolation with the chained-scan methodology (K invocations inside one
+jit, one value fetch) and reports achieved TF/s against the causal
+attention FLOPs each kernel actually performs:
+
+    fwd:    2·B·H·T²·D  (QKᵀ + PV, ×½ causal)
+    dK/dV:  4·B·H·T²·D  (S, dP, dV, dK dots, ×½ causal)
+    dQ:     3·B·H·T²·D  (S, dP, dS·K dots, ×½ causal)
+
+Run ON THE TPU, one T per process (HBM fragmentation accumulates):
+
+    python benchmark/flash_profile.py 8192
+    python benchmark/flash_profile.py 16384 32768
+"""
+import functools
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+H, D = 16, 64
+REPS, K = 3, 4
+
+
+def _time_chained(fn, args, flops):
+    """K invocations chained in one jit; fetch once.  Returns (ms, tfs)."""
+
+    @jax.jit
+    def multi(*a):
+        def body(_, __):
+            return 0.0, jnp.sum(fn(*a)[0][0, 0, 0]).astype(jnp.float32)
+
+        _c, ys = lax.scan(body, 0.0, None, length=K)
+        return ys[-1]
+
+    float(multi(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        float(multi(*args))
+        best = min(best, (time.perf_counter() - t0) / K)
+    return best * 1e3, flops / best / 1e12
+
+
+def main():
+    from incubator_mxnet_tpu.ops import flash_attention as fa
+
+    Ts = [int(a) for a in sys.argv[1:]] or [8192]
+    for T in Ts:
+        B = max(1, 2 * 8192 // T)
+        scale = 1.0 / math.sqrt(D)
+        key = jax.random.PRNGKey(0)
+        q, k, v, do = (jax.random.normal(jax.random.fold_in(key, i),
+                                         (B, H, T, D), jnp.bfloat16)
+                       for i in range(4))
+        causal_flops = B * H * T * T * D  # 2·T²·D·BH × ½ causal
+
+        bq = fa._auto_block(T, None)
+        resident = T * D * 2 <= fa._KV_RESIDENT_MAX_BYTES
+        fwd = functools.partial(fa._flash_core, causal=True, scale=scale,
+                                block_q=bq, block_k=bq, interpret=False)
+        ms, tfs = _time_chained(lambda a, b, c: fwd(a, b, c),
+                                (q, k, v), 2 * causal_flops)
+        print(f"T={T} B={B} fwd[{'resident' if resident else 'streamed'}] "
+              f"bq=bk={bq}: {ms:.2f} ms  {tfs:.1f} TF/s", flush=True)
+
+        out, lse = fwd(q, k, v)
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)
+        bqb = max(bq, 512)
+
+        def bwd(qq, kk, vv, dd):
+            return fa._flash_bwd_core(qq, kk, vv, dd, lse, delta,
+                                      causal=True, scale=scale, block_q=bqb,
+                                      block_k=bqb, interpret=False)
+
+        ms, tfs = _time_chained(lambda a, b, c, d: (bwd(a, b, c, d)[1],),
+                                (q, k, v, do), 7 * causal_flops)
+        print(f"T={T} B={B} bwd[dkdv+dq] bq=bk={bqb}: {ms:.2f} ms  "
+              f"{tfs:.1f} TF/s (combined)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -168,12 +168,46 @@ def scan_variant(B, K=8, reps=4):
     return B / dt
 
 
+def gluon_chain_variant(B, K=8):
+    """The PRODUCT path with multi-step chaining: the same public
+    record→backward→step loop, Trainer(chain_steps=K) — K steps per
+    dispatched program (r4 VERDICT item 1)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    net = _build_net(B)
+    net.hybridize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9,
+                  "multi_precision": True}, keep_grads=False,
+                 chain_steps=K)
+    x = NDArray(jnp.ones((B, 3, 224, 224), jnp.bfloat16))
+    y = NDArray(jnp.zeros((B,), jnp.int32))
+
+    def step_once():
+        with autograd.record():
+            L = loss_fn(net(x), y)
+        L.backward()
+        tr.step(B)
+        return L
+
+    # time whole chains: n must be a multiple of K so the fetch at the
+    # timing boundary lands right after a flush
+    return B / time_steps(step_once,
+                          lambda L: float(L.asnumpy().ravel()[0]),
+                          n=3 * K, warm=2 * K + 1)
+
+
 def main():
     which = sys.argv[1:] or ["gluon", "purejax"]
     B = int(os.environ.get("RESNET_PROBE_BS", "128"))
     for w in which:
         fn = {"gluon": gluon_variant, "purejax": purejax_variant,
-              "scan": scan_variant}[w]
+              "scan": scan_variant,
+              "gluon_chain": gluon_chain_variant}[w]
         print(f"{w} bf16 BS{B}: {fn(B):.0f} img/s", flush=True)
 
 

@@ -48,6 +48,12 @@ def build_parser():
     p.add_argument("--remat", action="store_true",
                    help="rematerializing backward (trade FLOPs for HBM; "
                         "hybridize(remat_backward=True))")
+    p.add_argument("--chain-steps", type=int, default=1,
+                   help="buffer K steps into ONE dispatched program "
+                        "(Trainer(chain_steps=K)); amortizes per-step "
+                        "dispatch overhead — metric updates are deferred "
+                        "to flush boundaries so they don't force early "
+                        "flushes")
     return p
 
 
@@ -108,10 +114,12 @@ def train(args):
                       {"learning_rate": args.lr, "momentum": args.momentum,
                        "wd": args.wd,
                        "multi_precision": args.dtype == "bfloat16"},
-                      keep_grads=False)  # grads consumed in the fused step
+                      keep_grads=False,  # grads consumed in the fused step
+                      chain_steps=args.chain_steps)
     acc = metric_mod.Accuracy()
 
     total_samples = 0
+    deferred = []  # (label, logits) awaiting a chain flush
     t_start = time.time()
     for epoch in range(args.num_epochs):
         speed = callback.Speedometer(args.batch_size, args.disp_batches)
@@ -127,10 +135,24 @@ def train(args):
                 L = loss_fn(out, y)
             L.backward()
             trainer.step(args.batch_size)
-            acc.update([y], [out])
+            if args.chain_steps > 1:
+                # reading `out` would force an early chain flush — defer
+                # metric updates to the flush boundary (values then fill
+                # from the already-dispatched chained program)
+                deferred.append((y, out))
+                if len(deferred) >= args.chain_steps:
+                    for yy, oo in deferred:
+                        acc.update([yy], [oo])
+                    deferred.clear()
+            else:
+                acc.update([y], [out])
             total_samples += args.batch_size
             speed(callback.BatchEndParam(epoch=epoch, nbatch=nbatch,
                                          eval_metric=acc, locals=locals()))
+        trainer.flush()
+        for yy, oo in deferred:
+            acc.update([yy], [oo])
+        deferred.clear()
         print(f"Epoch {epoch}: train_acc={acc.get()[1]:.4f}")
         if args.model_prefix:
             # save from the inner model: keys stay loadable into a bare

@@ -294,6 +294,33 @@ def _program_jits(raw_fn):
     return fn, grad, fwd_record, bwd_record
 
 
+def _capture_raw(p):
+    """Capture a parameter's raw array for a RECORDING forward without
+    forcing a pending value: during Trainer multi-step chaining the
+    param nd holds a LazyRef whose force flushes the whole chain — the
+    recording path defers instead (the fused/chained program ignores
+    these captures; any eager consumer resolves them via
+    `_resolve_raws`, which flushes first and therefore sees the
+    post-chain weights its step logically follows)."""
+    nd = p._data_nd
+    return nd._lazy if nd._lazy is not None else nd._raw
+
+
+def _resolve_raws(raws):
+    """Force any LazyRef captures (see `_capture_raw`) to concrete
+    arrays.  No-op (and allocation-free-ish) for plain tuples."""
+    if any(isinstance(r, LazyRef) for r in raws):
+        return tuple(r.force() if isinstance(r, LazyRef) else r
+                     for r in raws)
+    return raws
+
+
+def _aval_or_raw(r):
+    """jax.eval_shape accepts ShapeDtypeStructs and arrays mixed."""
+    return jax.ShapeDtypeStruct(r.aval.shape, r.aval.dtype) \
+        if isinstance(r, LazyRef) else r
+
+
 def _grads_not_kept():
     from ..base import MXNetError
 
@@ -346,6 +373,10 @@ class _PendingStep:
         if self.fwd_done:
             return
         blk = self.block
+        # resolve deferred weight/aux captures first (flushes any open
+        # Trainer chain, so this step sees its true predecessor weights)
+        self.train_raws = _resolve_raws(tuple(self.train_raws))
+        self.aux_raws = _resolve_raws(tuple(self.aux_raws))
         # rebind aux params to their captured concrete values first —
         # apply_fn's save/rebind would otherwise force our own cells
         for p, cell, a in zip(self.aux_params, self.aux_cells, self.aux_raws):
@@ -655,12 +686,20 @@ class HybridBlock(Block):
             self._ensure_shapes(args)
             self._build_cache()
         trainable, aux = self._cached_param_order
-        train_raws = tuple(p._data_nd._data for p in trainable)
-        aux_raws = tuple(p._data_nd._data for p in aux)
         input_raws = [a._data for a in input_nds]
         rng, rng_ctr = _random.step_key()
         training = _tape.is_training()
         fn = self._cached_fn
+        if not recording or self._remat_backward:
+            # eager/remat consumers need concrete values — the forcing
+            # read flushes any open Trainer chain first
+            train_raws = tuple(p._data_nd._data for p in trainable)
+            aux_raws = tuple(p._data_nd._data for p in aux)
+        else:
+            # recording defers: an open chain's weight LazyRefs pass
+            # through unforced (the fused program never reads them)
+            train_raws = tuple(_capture_raw(p) for p in trainable)
+            aux_raws = tuple(_capture_raw(p) for p in aux)
         if not recording:
             out_raws, new_aux = fn(training, arg_tree, train_raws, aux_raws,
                                    rng, rng_ctr, *input_raws)
@@ -686,7 +725,9 @@ class HybridBlock(Block):
 
             out_shape, aux_shape = jax.eval_shape(
                 functools.partial(fn, training, arg_tree),
-                train_raws, aux_raws, rng, rng_ctr, *input_raws)
+                tuple(_aval_or_raw(r) for r in train_raws),
+                tuple(_aval_or_raw(r) for r in aux_raws),
+                rng, rng_ctr, *input_raws)
             leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
             spec = (treedef, leaves_avals)
             self._aval_cache[sig] = spec
@@ -697,8 +738,9 @@ class HybridBlock(Block):
         # aux params go lazy too: they are rebound to cells the pending
         # fills (a read before the step forces the staged forward)
         for p, a in zip(aux, aux_raws):
+            av = _aval_or_raw(a)
             cell = LazyRef(pending.force_fwd,
-                           jax.ShapeDtypeStruct(a.shape, a.dtype))
+                           jax.ShapeDtypeStruct(av.shape, av.dtype))
             pending.aux_cells.append(cell)
             p._data_nd._data = cell
 
@@ -793,11 +835,11 @@ class HybridBlock(Block):
         # params shared between the halves appear once (tr_src/aux_src)
         train_raws = tuple(
             pend.train_raws[i] if where == "up"
-            else down_tr[i]._data_nd._data
+            else _capture_raw(down_tr[i])
             for where, i in chained.tr_src)
         aux_raws = tuple(
             pend.aux_raws[i] if where == "up"
-            else down_aux[i]._data_nd._data
+            else _capture_raw(down_aux[i])
             for where, i in chained.aux_src)
         input_raws = tuple(pend.input_raws) \
             + tuple(nd._data for nd in concrete_nds)
@@ -812,7 +854,9 @@ class HybridBlock(Block):
 
             out_shape, _aux_shape = jax.eval_shape(
                 functools.partial(chained._cached_fn, training, token),
-                train_raws, aux_raws, pend.rng, pend.rng_ctr, *input_raws)
+                tuple(_aval_or_raw(r) for r in train_raws),
+                tuple(_aval_or_raw(r) for r in aux_raws),
+                pend.rng, pend.rng_ctr, *input_raws)
             down_shape, up_shape = out_shape
             d_leaves, d_treedef = jax.tree_util.tree_flatten(down_shape)
             leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
@@ -826,8 +870,9 @@ class HybridBlock(Block):
                                 aux_raws, pend.rng, pend.rng_ctr, input_raws,
                                 treedef, out_avals, comb_aux)
         for p, a in zip(comb_aux, aux_raws):
+            av = _aval_or_raw(a)
             cell = LazyRef(pending2.force_fwd,
-                           jax.ShapeDtypeStruct(a.shape, a.dtype))
+                           jax.ShapeDtypeStruct(av.shape, av.dtype))
             pending2.aux_cells.append(cell)
             p._data_nd._data = cell
         # the upstream's existing output cells become the tail of this
@@ -1005,8 +1050,12 @@ def _make_apply_fn(block: Block, trainable: List[Parameter], aux: List[Parameter
     dispatch); else ``block.__call__``."""
 
     def apply_fn(train_raws, aux_raws, rng_key, *input_raws, training=False):
-        t_saved = [p._data_nd._data for p in trainable]
-        a_saved = [p._data_nd._data for p in aux]
+        # save WITHOUT forcing: an open Trainer chain leaves LazyRefs on
+        # the param nds, and this save/restore is pure bookkeeping (the
+        # values are never consumed) — the setter in `finally` re-binds
+        # a LazyRef as-is
+        t_saved = [_capture_raw(p) for p in trainable]
+        a_saved = [_capture_raw(p) for p in aux]
         rec_saved = _tape.set_recording(False)
         trn_saved = _tape.set_training(training)
         try:

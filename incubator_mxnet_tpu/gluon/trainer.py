@@ -54,7 +54,8 @@ class Trainer:
                  keep_grads: bool = True,
                  max_inflight_steps: Optional[int] = None,
                  max_inflight_bytes: int = 6 << 30,
-                 mesh=None, data_axis: str = "data"):
+                 mesh=None, data_axis: str = "data",
+                 chain_steps: int = 1):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -119,6 +120,16 @@ class Trainer:
         # (BASELINE.json north star) without a DataParallelExecutorGroup.
         self._mesh = mesh
         self._data_axis = data_axis
+        # multi-step chaining: buffer K canonical steps and dispatch ONE
+        # lax.scan program over the full train state — amortizes the
+        # per-dispatch host/relay overhead that otherwise sits between
+        # device steps.  Reads of any chained value (loss, outputs,
+        # params, grads) flush the chain first, so semantics match the
+        # per-step path exactly; requires keep_grads=False.
+        self._chain_steps = max(1, int(chain_steps))
+        self._chain_buf: list = []
+        self._chain_state: Optional[dict] = None
+        self._chain_weight_cells: list = []
 
     def _get_mesh(self):
         """Explicit mesh, else inferred from any NamedSharded param.
@@ -438,6 +449,270 @@ class Trainer:
                 if "deleted" not in str(e):
                     raise
 
+    # ------------------------------------------------------------------ #
+    # multi-step chaining (chain_steps > 1): K canonical steps buffered
+    # and dispatched as ONE lax.scan program over the full train state.
+    # Values a user may touch mid-chain (loss/outputs/params/grads) are
+    # LazyRefs whose force flushes the chain first — semantics match
+    # the per-step path exactly; the win is K-1 avoided host/relay
+    # dispatch gaps (the dependency-engine run-ahead, one level up).
+    # ------------------------------------------------------------------ #
+    def _chain_allowed(self) -> bool:
+        if self._chain_steps <= 1:
+            return False
+        kv = self._kvstore
+        reason = None
+        if self._keep_grads or not self._donate:
+            reason = "it requires keep_grads=False and donate=True"
+        elif kv is not None and getattr(kv, "_is_dist", False):
+            reason = "it is not supported with a distributed kvstore"
+        elif self._get_mesh() is not None:
+            reason = "it is not supported with a device mesh (yet)"
+        if reason is not None:
+            if not getattr(self, "_chain_warned", False):
+                import warnings
+
+                warnings.warn(
+                    f"Trainer(chain_steps={self._chain_steps}) is being "
+                    f"IGNORED: {reason}; steps dispatch one program each",
+                    stacklevel=4)
+                self._chain_warned = True
+            return False
+        return True
+
+    def flush(self):
+        """Dispatch any buffered chained steps (no-op when none)."""
+        self._flush_chain()
+
+    def _enqueue_chain(self, ctx, pending) -> bool:
+        import jax.numpy as jnp
+
+        from ..engine import LazyRef
+
+        opt = self._optimizer
+        idx_of = ctx["idx_of"]
+        lr, keys = self._advance_scalars(idx_of)
+        if self._chain_state is None:
+            from .block import _resolve_raws
+
+            ts = ctx.get("ts_dev")
+            if ts is None:
+                ts = jnp.asarray([int(opt._index_update_count[i])
+                                  for i in idx_of], jnp.int32)
+            self._chain_state = {
+                "w": tuple(nd._data for nd in ctx["nds"]),
+                "aux": _resolve_raws(pending.aux_raws),
+                "states": ctx["states"],
+                "ts": ts,
+                "ctx": ctx,
+            }
+            flush = self._flush_chain
+            cells = []
+            for nd, w in zip(ctx["nds"], self._chain_state["w"]):
+                cell = LazyRef(flush,
+                               jax.ShapeDtypeStruct(w.shape, w.dtype))
+                nd._data = cell
+                cells.append(cell)
+            self._chain_weight_cells = cells
+        flush = self._flush_chain
+        self._chain_buf.append({
+            "pending": pending,
+            "rng": pending.rng, "ctr": pending.rng_ctr,
+            "inputs": tuple(pending.input_raws),
+            "lr": float(lr), "wd": float(opt.wd),
+            "rescale": float(opt.rescale_grad),
+            "keys": keys,
+        })
+        for cell in pending.out_cells:
+            cell.force_fn = flush
+        for cell in pending.aux_cells:
+            cell.force_fn = flush
+        for cell in pending.grad_cells.values():
+            cell.force_fn = flush
+        if len(self._chain_buf) >= self._chain_steps:
+            self._flush_chain()
+        return True
+
+    def _get_chain_fn(self, ctx, has_keys: bool):
+        key = ("chain_fn", has_keys)
+        fn = ctx.get(key)
+        if fn is None:
+            import jax.numpy as jnp
+            from jax import lax
+
+            pure = ctx["pure"]
+
+            def chain(w, aux, states, ts, per_step):
+                # per_step: K per-step tuples — stacked HERE, inside the
+                # one jitted program, so a flush costs exactly ONE
+                # dispatch (each eager jnp.stack would be its own
+                # host-blocking dispatch on relayed devices)
+                xs = jax.tree_util.tree_map(lambda *a: jnp.stack(a),
+                                            *per_step)
+
+                def body(carry, x):
+                    cw, caux, cst, cts = carry
+                    if has_keys:
+                        rng, ctr, inp, lr, wd, rs, ky = x
+                    else:
+                        rng, ctr, inp, lr, wd, rs = x
+                        ky = None
+                    out_leaves, new_aux, _g, new_w, new_s, new_ts, sync = \
+                        pure(cw, caux, cst, rng, ctr, inp,
+                             cts, lr, wd, rs, ky)
+                    return ((new_w, new_aux, new_s, new_ts),
+                            (out_leaves, new_aux, sync))
+
+                carry, ys = lax.scan(body, (w, aux, states, ts), xs)
+                outs, auxs, syncs = ys
+                return carry + (outs, auxs, syncs[-1])
+
+            # aux (arg 1) deliberately NOT donated — the single-step fn
+            # never donates it either, so user-held aux references (e.g.
+            # a captured running_mean array) stay readable, parity with
+            # the per-step path
+            fn = jax.jit(chain, donate_argnums=(0, 2, 3))
+            ctx[key] = fn
+        return fn
+
+    @staticmethod
+    def _chain_step_lost():
+        raise MXNetError(
+            "this value belonged to a chained Trainer step whose flush "
+            "failed; the step never executed (see the raised flush error)")
+
+    def _flush_chain(self):
+        buf, st = self._chain_buf, self._chain_state
+        if not buf:
+            return
+        import jax.numpy as jnp
+
+        self._chain_buf = []
+        self._chain_state = None
+        wcells, self._chain_weight_cells = self._chain_weight_cells, []
+        ctx = st["ctx"]
+        opt = self._optimizer
+        K = len(buf)
+        done = 0  # steps whose update definitely applied before a failure
+        live = (st["w"], st["aux"], st["states"], st["ts"])
+        try:
+            if K >= 2 and K == self._chain_steps:
+                has_keys = buf[0]["keys"] is not None
+                import numpy as onp
+
+                # host scalars ride along as plain numpy scalars — they
+                # transfer with the one call, never as their own dispatch
+                per_step = tuple(
+                    (r["rng"], onp.int32(r["ctr"]), r["inputs"],
+                     onp.float32(r["lr"]), onp.float32(r["wd"]),
+                     onp.float32(r["rescale"]))
+                    + ((r["keys"],) if has_keys else ())
+                    for r in buf)
+                fn = self._get_chain_fn(ctx, has_keys)
+                new_w, new_aux, new_s, new_ts, outs, auxs, sync = fn(
+                    st["w"], st["aux"], st["states"], st["ts"], per_step)
+                for k, r in enumerate(buf):
+                    self._fill_pending_sliced(
+                        r["pending"], outs, auxs, k,
+                        final_aux=new_aux if k == K - 1 else None)
+            else:
+                # tail/partial flush: reuse the compiled single-step fn
+                w, aux, states, ts = live
+                for r in buf:
+                    out_leaves, aux, _g, w, states, ts, sync = ctx["fn"](
+                        w, aux, states, r["rng"], r["ctr"], r["inputs"],
+                        ts, r["lr"], r["wd"], r["rescale"], r["keys"])
+                    r["pending"].fill_from_full_step(out_leaves, aux, None)
+                    done += 1
+                    live = (w, aux, states, ts)
+                new_w, new_aux, new_s, new_ts = w, aux, states, ts
+        except Exception:
+            # A dispatch failure leaves its own donation unapplied, so
+            # `live` — the carry after the last SUCCESSFUL step (the
+            # original st for done=0) — is intact: restore it to the
+            # nds, mark only the steps that never ran as lost, and roll
+            # back exactly their count advances.
+            w_live, aux_live, s_live, ts_live = live
+            for nd, cell, w in zip(ctx["nds"], wcells, w_live):
+                cell.value = w
+                if nd._lazy is cell:
+                    nd._data = w
+            last = buf[-1]["pending"]
+            for p, cell, a in zip(last.aux_params, last.aux_cells,
+                                  aux_live):
+                cell.value = a
+                if p._data_nd._lazy is cell:
+                    p._data_nd._data = a
+            for r in buf[done:]:
+                for cell in (list(r["pending"].out_cells)
+                             + list(r["pending"].grad_cells.values())):
+                    if cell.value is None:
+                        cell.force_fn = self._chain_step_lost
+            for i in ctx["idx_of"]:
+                opt._index_update_count[i] -= (K - done)
+            opt.num_update = max(
+                [opt.begin_num_update] + list(
+                    opt._index_update_count.values()))
+            if done:
+                ctx["states"] = s_live
+                ctx["ts_dev"] = ts_live
+                self._states_stale = True
+            try:
+                self._sync_states()  # while ctx is still attached
+            except Exception:
+                pass
+            self._fullstep_ctx = None
+            raise
+        for nd, cell, w in zip(ctx["nds"], wcells, new_w):
+            cell.value = w
+            if nd._lazy is cell:
+                nd._data = w
+        ctx["states"] = new_s
+        ctx["ts_dev"] = new_ts
+        self._states_stale = True
+        try:
+            self._throttle_bytes(sync, ctx["held_bytes"] * K)
+        except Exception:
+            # async execution error of an in-flight program: see the
+            # single-step handler — unrecoverable in-process, counts
+            # deliberately kept; recovery is a checkpoint restore
+            self._fullstep_ctx = None
+            raise
+
+    @staticmethod
+    def _fill_pending_sliced(pending, outs, auxs, k, final_aux=None):
+        """Fill a chained pending from the scan-stacked outputs without
+        dispatching K×leaves slice programs: out/aux cells get per-cell
+        force_fns that slice ON READ.  The LAST pending's aux must be
+        concrete (the aux nds are rebound to its cells) — `final_aux`
+        passes the scan carry (identical to auxs[:, -1], no slicing)."""
+        from .block import _grads_not_kept
+
+        def slicer(cell, stacked):
+            def fill():
+                cell.value = stacked[k]
+            return fill
+
+        for cell, stacked in zip(pending.out_cells, outs):
+            if cell.value is None:
+                cell.force_fn = slicer(cell, stacked)
+        if final_aux is not None:
+            for p, cell, v in zip(pending.aux_params, pending.aux_cells,
+                                  final_aux):
+                cell.value = v
+                if p._data_nd._lazy is cell:
+                    p._data_nd._data = v
+        else:
+            for cell, stacked in zip(pending.aux_cells, auxs):
+                if cell.value is None:
+                    cell.force_fn = slicer(cell, stacked)
+        for pos, cell in pending.grad_cells.items():
+            if cell.value is None:
+                cell.force_fn = _grads_not_kept
+        pending.fwd_done = True
+        pending.bwd_done = True
+        pending.pullback = None
+
     def _fused_step(self):
         opt = self._optimizer
         self._sync_states()
@@ -542,11 +817,19 @@ class Trainer:
         sig = (id(block), block._cache_version, pending.training,
                pending.arg_tree, pending.head_positions,
                tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
+        if self._chain_buf and (ctx is None or ctx["sig"] != sig
+                                or ctx["mults"] != mults):
+            # shape/block change mid-chain: flush before rebuilding so
+            # the rebuild sees real (post-chain) weights
+            self._flush_chain()
+            ctx = self._fullstep_ctx
         if ctx is None or ctx["sig"] != sig or ctx["mults"] != mults:
             ctx = self._prepare_full_step(pending, sig)
             if ctx is None:
                 return False
             self._fullstep_ctx = ctx
+        if self._chain_allowed():
+            return self._enqueue_chain(ctx, pending)
         import jax.numpy as jnp
 
         idx_of = ctx["idx_of"]
@@ -564,10 +847,13 @@ class Trainer:
         # else: steady state — ts is device-resident, incremented inside
         # the donated program; no per-step host→device transfer
         states = ctx["states"]
+        from .block import _resolve_raws
+
         try:
             input_raws = self._shard_inputs(pending.input_raws)
             out_leaves, new_aux, grads, new_w, new_s, new_ts, sync = ctx["fn"](
-                pending.train_raws, pending.aux_raws, states, pending.rng,
+                _resolve_raws(pending.train_raws),
+                _resolve_raws(pending.aux_raws), states, pending.rng,
                 pending.rng_ctr, input_raws, ts, lr, opt.wd,
                 opt.rescale_grad, keys)
         except Exception:
@@ -638,7 +924,7 @@ class Trainer:
                     opt.create_state_multi_precision(i, self._params[i].data()),
                     self._params[i]._data_nd._data)
         mults = self._mults_key(idx_of)
-        fn = self._build_full_step(pending, mults)
+        fn, pure = self._build_full_step(pending, mults)
 
         held = sum(_aval_bytes(a) for a in pending.out_avals)
         held += sum(_aval_bytes(a) for a in pending.aux_raws)  # new_aux outputs
@@ -661,6 +947,7 @@ class Trainer:
             "nds": [self._params[i]._data_nd for i in idx_of],
             "states": tuple(self._states[i] for i in idx_of),
             "fn": fn,
+            "pure": pure,
             "held_bytes": held,
         }
 
@@ -714,7 +1001,7 @@ class Trainer:
                     new_ts, sync)
 
         donate = (0, 2, 6) if self._donate else ()
-        return jax.jit(full, donate_argnums=donate)
+        return jax.jit(full, donate_argnums=donate), full
 
     def _allreduce_grads_packed(self):
         """ONE compressed exchange for the whole model: concat all grads
@@ -823,6 +1110,7 @@ class Trainer:
 
         import jax
 
+        self._flush_chain()
         self._sync_states()
         with open(fname, "wb") as f:
             states_host = jax.tree_util.tree_map(lambda x: jax.device_get(x), self._states)
@@ -834,6 +1122,7 @@ class Trainer:
     def load_states(self, fname):
         import pickle
 
+        self._flush_chain()
         with open(fname, "rb") as f:
             blob = pickle.load(f)
         self._states = {k: _to_device(v) for k, v in blob["states"].items()}

@@ -140,17 +140,22 @@ class MultiHeadAttention(HybridBlock):
 
 
 class PositionwiseFFN(HybridBlock):
-    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu", **kwargs):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 drop_output=True, **kwargs):
         super().__init__(**kwargs)
         self.ffn_dense1 = nn.Dense(hidden_size, flatten=False, in_units=units)
         self.ffn_dense2 = nn.Dense(units, flatten=False, in_units=hidden_size)
         self.drop = nn.Dropout(dropout)
         self._act = activation
+        # drop_output=False: the parent fuses this dropout with its
+        # residual add (nn.DropoutAdd) — same math, one less HBM pass
+        self._drop_output = drop_output
 
     def forward(self, x):
         h = self.ffn_dense1(wrap(x))
         h = nd.gelu(h) if self._act == "gelu" else nd.Activation(h, act_type=self._act)
-        return self.drop(self.ffn_dense2(h))
+        h = self.ffn_dense2(h)
+        return self.drop(h) if self._drop_output else h
 
 
 class BERTLayer(HybridBlock):
@@ -166,16 +171,15 @@ class BERTLayer(HybridBlock):
         self.attention = MultiHeadAttention(units, num_heads, dropout,
                                             use_flash=use_flash)
         self.ln1 = nn.LayerNorm(in_channels=units)
-        self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                   drop_output=False)
         self.ln2 = nn.LayerNorm(in_channels=units)
-        self.drop = nn.Dropout(dropout)
+        self.drop_add = nn.DropoutAdd(dropout)
 
     def forward(self, x, mask=None):
         x = wrap(x)
-        attn = self.drop(self.attention(x, mask))
-        x = self.ln1(x + attn)
-        ffn = self.ffn(x)
-        return self.ln2(x + ffn)
+        x = self.ln1(self.drop_add(self.attention(x, mask), x))
+        return self.ln2(self.drop_add(self.ffn(x), x))
 
 
 class BERTEncoder(HybridBlock):

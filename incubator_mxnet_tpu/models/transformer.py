@@ -106,12 +106,12 @@ class _EncoderLayer(HybridBlock):
         self.attn = MultiHeadAttention(units, num_heads, dropout)
         self.ln2 = nn.LayerNorm(in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout, activation="relu")
-        self.drop = nn.Dropout(dropout)
+        self.drop_add = nn.DropoutAdd(dropout)
 
     def forward(self, x, mask=None):
         x = wrap(x)
-        x = x + self.drop(self.attn(self.ln1(x), mask))
-        return x + self.drop(self.ffn(self.ln2(x)))
+        x = self.drop_add(self.attn(self.ln1(x), mask), x)
+        return self.drop_add(self.ffn(self.ln2(x)), x)
 
 
 class _DecoderLayer(HybridBlock):
@@ -123,13 +123,13 @@ class _DecoderLayer(HybridBlock):
         self.cross_attn = _CrossAttention(units, num_heads)
         self.ln3 = nn.LayerNorm(in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout, activation="relu")
-        self.drop = nn.Dropout(dropout)
+        self.drop_add = nn.DropoutAdd(dropout)
 
     def forward(self, x, mem, mem_mask=None):
         x = wrap(x)
-        x = x + self.drop(self.self_attn(self.ln1(x)))
-        x = x + self.drop(self.cross_attn(self.ln2(x), mem, mem_mask))
-        return x + self.drop(self.ffn(self.ln3(x)))
+        x = self.drop_add(self.self_attn(self.ln1(x)), x)
+        x = self.drop_add(self.cross_attn(self.ln2(x), mem, mem_mask), x)
+        return self.drop_add(self.ffn(self.ln3(x)), x)
 
 
 class TransformerEncoder(HybridBlock):
@@ -174,12 +174,12 @@ class _LMLayer(HybridBlock):
         self.ln2 = nn.LayerNorm(in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout,
                                    activation="gelu")
-        self.drop = nn.Dropout(dropout)
+        self.drop_add = nn.DropoutAdd(dropout)
 
     def forward(self, x):
         x = wrap(x)
-        x = x + self.drop(self.attn(self.ln1(x)))
-        return x + self.drop(self.ffn(self.ln2(x)))
+        x = self.drop_add(self.attn(self.ln1(x)), x)
+        return self.drop_add(self.ffn(self.ln2(x)), x)
 
 
 class TransformerLM(HybridBlock):

@@ -43,6 +43,7 @@ __all__ = [
     "InstanceNorm",
     "L2Normalization",
     "Dropout",
+    "DropoutAdd",
     "UpSampling",
     "RNN",
     "smooth_l1",
@@ -583,6 +584,23 @@ def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: boo
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
 
     return apply_op(lambda x: f(x, key), data)
+
+
+def DropoutAdd(data, residual, p: float = 0.5, mode: str = "training",
+               training: bool = False):
+    """``residual + Dropout(data)`` fused into one kernel pass — the
+    transformer post-sublayer pattern.  Same mask bits and partitioning
+    as `Dropout` (no-axes form); falls back to the plain sum when
+    dropout is inactive."""
+    if not (training or mode == "always") or p <= 0.0:
+        return wrap(data) + wrap(residual)
+    from .. import random as _random
+    from ..ops.dropout_kernel import fused_dropout_add
+
+    seed_arr = _random.key_to_seed(_random.next_key())
+    return apply_op(
+        lambda x, r: fused_dropout_add(x, r, seed_arr, float(p)),
+        data, residual)
 
 
 # ---------------------------------------------------------------------- #

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["fused_dropout"]
+__all__ = ["fused_dropout", "fused_dropout_add"]
 
 # upper bound on rows per tile; actual tile geometry is shape-derived
 _BLOCK_ROWS = 1024
@@ -134,7 +134,7 @@ def _tile_geometry(R: int, Clp: int, itemsize: int):
     return _pick_br(R, cap), bc
 
 
-def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb, br, bc, kr, kc):
+def _dropout_kernel(seed_ref, x_ref, *rest, rate, ncb, br, bc, kr, kc):
     """One EXECUTION block covers a (kr x kc) window of MASK tiles.
 
     The mask remains a pure function of (seed, global mask-tile id) with
@@ -143,10 +143,16 @@ def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb, br, bc, kr, kc):
     blocking from mask geometry is what fixes the 16 KB-per-grid-step
     regime this kernel shipped with (measured 203 GB/s on the BERT
     flagship's (4096,1024) sites: 512 steps of 64x128; see
-    docs/performance.md)."""
+    docs/performance.md).
+
+    ``rest`` is ``(o_ref,)`` for plain dropout or ``(r_ref, o_ref)``
+    for the fused residual-add epilogue (``out = res + dropout(x)``,
+    the transformer post-sublayer pattern) — ONE body so the
+    mask-defining machinery can never fork between the two ops."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    r_ref, o_ref = rest if len(rest) == 2 else (None, rest[0])
     # distinct stream per global MASK tile: seed words are (user seed,
     # LINEAR global tile id = (row_block_offset + i) * ncb + j).  Same
     # words in fwd and bwd regenerate the identical mask; TWO words —
@@ -166,9 +172,11 @@ def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb, br, bc, kr, kc):
                                  jnp.uint32)
             # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)
             keep = bits >= thresh
-            x = x_ref[i * br:(i + 1) * br, j * bc:(j + 1) * bc]
-            o_ref[i * br:(i + 1) * br, j * bc:(j + 1) * bc] = jnp.where(
-                keep, x * jnp.asarray(scale, x.dtype), jnp.zeros_like(x))
+            sl = (slice(i * br, (i + 1) * br), slice(j * bc, (j + 1) * bc))
+            x = x_ref[sl]
+            y = jnp.where(keep, x * jnp.asarray(scale, x.dtype),
+                          jnp.zeros_like(x))
+            o_ref[sl] = y if r_ref is None else y + r_ref[sl]
 
 
 # execution-block budget: elements per (in OR out) VMEM block.  With
@@ -210,13 +218,14 @@ def _exec_blocking(rows, cols, br, bc, itemsize):
 
 
 def _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
-              interpret):
+              interpret, r2d=None):
     """Run the Pallas kernel over the (rows_local, cols_local) 2D view.
 
     ``row_blk_off``/``col_blk_off``: this shard's global tile offsets
     (0 unpartitioned); ``ncb_g``: GLOBAL column-block count — the
     static stride that linearizes (row block, col block) into the
-    shard-invariant tile id."""
+    shard-invariant tile id.  ``r2d``: optional residual for the fused
+    ``res + dropout(x)`` epilogue (same kernel body, same mask bits)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -225,18 +234,18 @@ def _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
     lin_off = (jnp.asarray(row_blk_off, jnp.int32) * ncb_g
                + jnp.asarray(col_blk_off, jnp.int32))
     seeds = jnp.concatenate([seed.astype(jnp.int32), lin_off.reshape(1)])
+    blk = pl.BlockSpec((kr * br, kc * bc), lambda i, j: (i, j))
+    args = (seeds, x2d) if r2d is None else (seeds, x2d, r2d)
     return pl.pallas_call(
         functools.partial(_dropout_kernel, rate=rate, ncb=ncb_g,
                           br=br, bc=bc, kr=kr, kc=kc),
         grid=(_row_grid(rows, kr * br), -(-cols // (kc * bc))),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # (2,) seed words
-            pl.BlockSpec((kr * br, kc * bc), lambda i, j: (i, j)),
-        ],
-        out_specs=pl.BlockSpec((kr * br, kc * bc), lambda i, j: (i, j)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]  # (2,) seed words
+                 + [blk] * (len(args) - 1),
+        out_specs=blk,
         out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
         interpret=interpret,
-    )(seeds, x2d)
+    )(*args)
 
 
 def _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
@@ -274,12 +283,14 @@ def _kernel_backend() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def _blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
+def _blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
+             r2d=None):
     if _kernel_backend():
         return _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
-                         ncb_g, interpret=False)
-    return _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
-                        ncb_g)
+                         ncb_g, interpret=False, r2d=r2d)
+    y = _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
+                     ncb_g)
+    return y if r2d is None else y + r2d
 
 
 # ------------------------------------------------------------------ #
@@ -319,8 +330,11 @@ def _shard_count_and_offset(spec_entry, m, extent, block):
     return spec_entry, off
 
 
-def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
-    x_info, seed_info = arg_shapes
+def _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, with_res):
+    """Shared GSPMD partition rule for the dropout op and its fused
+    residual-add variant — ONE implementation of the shard-offset
+    lowering so the mask keying cannot fork between the two."""
+    x_info = arg_shapes[0]
     x_sh = x_info.sharding
     m = x_sh.mesh
     R, Clp = x_info.shape
@@ -330,10 +344,22 @@ def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
     canon = NamedSharding(m, P(rows_spec, cols_spec))
     seed_sh = NamedSharding(m, P(None))
 
+    if with_res:
+        def lower(xs, rs, seed):
+            return _blocked(xs, seed, row_off(), col_off(), rate, br, bc,
+                            ncb_g, r2d=rs)
+
+        # the residual is elementwise-aligned with x: same canon
+        return mesh, lower, canon, (canon, canon, seed_sh)
+
     def lower(xs, seed):
         return _blocked(xs, seed, row_off(), col_off(), rate, br, bc, ncb_g)
 
     return mesh, lower, canon, (canon, seed_sh)
+
+
+def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
+    return _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, False)
 
 
 _dp2d.def_partition(
@@ -342,6 +368,24 @@ _dp2d.def_partition(
     # rows (i) AND cols (j) may shard — tile ids are global either way;
     # only the seed (k) must replicate
     sharding_rule="i j, k -> i j",
+    need_replication_factors=("k",),
+)
+
+
+@functools.partial(custom_partitioning, static_argnums=(3, 4, 5, 6))
+def _dpadd2d(x2d, r2d, seed, rate, br, bc, ncb_g):
+    z = jnp.int32(0)
+    return _blocked(x2d, seed, z, z, rate, br, bc, ncb_g, r2d=r2d)
+
+
+def _dpadd2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
+    return _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, True)
+
+
+_dpadd2d.def_partition(
+    _dpadd2d_partition,
+    infer_sharding_from_operands=None,
+    sharding_rule="i j, i j, k -> i j",
     need_replication_factors=("k",),
 )
 
@@ -421,3 +465,33 @@ def _bwd(rate, seed, dy):
 
 
 fused_dropout.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_dropout_add(x, res, seed, rate: float):
+    """``res + dropout(x)`` in one kernel pass — the transformer
+    post-sublayer pattern fused so the dropped activation never makes
+    an extra HBM round trip between the dropout and the residual add.
+    Mask bits are IDENTICAL to ``fused_dropout(x, seed, rate)`` (same
+    canonical view, tile geometry, and seed words), so the zero-memory
+    backward regenerates them exactly; same GSPMD partitioning rule."""
+    if rate >= 1.0:
+        return res + jnp.zeros_like(x)
+    if rate <= 0.0 or x.size == 0:
+        return x + res
+    y2, restore, br, bc, ncb_g = _canonical_2d(x)
+    r2, _, _, _, _ = _canonical_2d(res)
+    out2 = _dpadd2d(y2, r2, seed, float(rate), int(br), int(bc), int(ncb_g))
+    return restore(out2)
+
+
+def _add_fwd(x, res, seed, rate):
+    return fused_dropout_add(x, res, seed, rate), seed
+
+
+def _add_bwd(rate, seed, dy):
+    # d_x = mask*scale*dy (regenerated); d_res = dy (pass-through)
+    return fused_dropout(dy, seed, rate), dy, None
+
+
+fused_dropout_add.defvjp(_add_fwd, _add_bwd)

@@ -70,6 +70,8 @@ class CheckpointManager:
                     arrays[f"param:{name}"] = onp.asarray(
                         jax.device_get(p.data()._data))
         if trainer is not None:
+            if hasattr(trainer, "_flush_chain"):
+                trainer._flush_chain()  # drain buffered chained steps
             trainer._sync_states()
             blob["trainer"] = {
                 "states": jax.tree_util.tree_map(

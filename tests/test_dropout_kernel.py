@@ -212,3 +212,84 @@ def test_pallas_interpret_matches_contract():
     onp.testing.assert_allclose(onp.unique(y[y != 0]), [1.0 / 0.75], rtol=1e-5)
     y2 = onp.asarray(jax.device_get(dk._run(x, SEED, 0.25, interpret=True)))
     onp.testing.assert_array_equal(y, y2)
+
+
+class TestDropoutAdd:
+    """fused_dropout_add = residual + dropout(x), same mask bits."""
+
+    def test_matches_dropout_plus_add_bitexact(self):
+        from incubator_mxnet_tpu.ops.dropout_kernel import (fused_dropout,
+                                                            fused_dropout_add)
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, 384), jnp.float32)
+        r = jax.random.normal(jax.random.PRNGKey(2), (32, 384), jnp.float32)
+        fused = onp.asarray(jax.jit(
+            lambda a, b: fused_dropout_add(a, b, SEED, 0.3))(x, r))
+        split = onp.asarray(jax.jit(
+            lambda a, b: b + fused_dropout(a, SEED, 0.3))(x, r))
+        onp.testing.assert_array_equal(fused, split)
+
+    def test_gradients(self):
+        from incubator_mxnet_tpu.ops.dropout_kernel import (fused_dropout,
+                                                            fused_dropout_add)
+
+        x = jax.random.normal(jax.random.PRNGKey(3), (16, 256), jnp.float32)
+        r = jax.random.normal(jax.random.PRNGKey(4), (16, 256), jnp.float32)
+        dy = jax.random.normal(jax.random.PRNGKey(5), (16, 256), jnp.float32)
+
+        def f(a, b):
+            return jnp.sum(fused_dropout_add(a, b, SEED, 0.4) * dy)
+
+        dx, dr = jax.grad(f, argnums=(0, 1))(x, r)
+        # residual grad passes through untouched
+        onp.testing.assert_array_equal(onp.asarray(dr), onp.asarray(dy))
+        # x grad is the regenerated mask applied to dy (same zeros;
+        # kept entries differ only by f32 multiply ordering)
+        want = onp.asarray(jax.jit(
+            lambda d: fused_dropout(d, SEED, 0.4))(dy))
+        onp.testing.assert_array_equal(onp.asarray(dx) == 0, want == 0)
+        onp.testing.assert_allclose(onp.asarray(dx), want, rtol=1e-6)
+
+    def test_degenerate_rates(self):
+        from incubator_mxnet_tpu.ops.dropout_kernel import fused_dropout_add
+
+        x = jnp.ones((8, 128), jnp.float32)
+        r = 2 * jnp.ones((8, 128), jnp.float32)
+        onp.testing.assert_array_equal(
+            onp.asarray(fused_dropout_add(x, r, SEED, 0.0)), 3.0)
+        onp.testing.assert_array_equal(
+            onp.asarray(fused_dropout_add(x, r, SEED, 1.0)), 2.0)
+
+    def test_partitioned_matches_unsharded(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from incubator_mxnet_tpu.ops.dropout_kernel import fused_dropout_add
+        from incubator_mxnet_tpu.parallel import create_mesh
+
+        mesh = create_mesh(data=4, model=2)
+        x = jax.random.normal(jax.random.PRNGKey(6), (8, 16, 384), jnp.float32)
+        r = jax.random.normal(jax.random.PRNGKey(7), (8, 16, 384), jnp.float32)
+        sh = NamedSharding(mesh, P("data", None, "model"))
+        y = jax.jit(lambda a, b: fused_dropout_add(a, b, SEED, 0.25))(
+            jax.device_put(x, sh), jax.device_put(r, sh))
+        ref = jax.jit(lambda a, b: fused_dropout_add(a, b, SEED, 0.25))(x, r)
+        onp.testing.assert_array_equal(onp.asarray(y), onp.asarray(ref))
+
+    def test_nd_op_and_gluon_block(self):
+        import incubator_mxnet_tpu as mx
+        from incubator_mxnet_tpu import _tape, autograd
+        from incubator_mxnet_tpu.gluon import nn
+        from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+        mx.random.seed(0)
+        y = NDArray(jnp.ones((4, 256), jnp.float32))
+        res = NDArray(2 * jnp.ones((4, 256), jnp.float32))
+        blk = nn.DropoutAdd(0.5)
+        out_eval = blk(y, res)  # not training: plain sum
+        onp.testing.assert_array_equal(out_eval.asnumpy(), 3.0)
+        with autograd.record():
+            out = blk(y, res)
+        v = out.asnumpy()
+        kept = v[v != 3.0 - 1.0]  # dropped entries equal the residual (2)
+        assert ((v == 2.0) | (v == 4.0)).all()  # 2 + {0, 1/0.5}
+        assert 0.2 < (v == 2.0).mean() < 0.8
