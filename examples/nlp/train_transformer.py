@@ -45,6 +45,8 @@ def build_parser():
                    choices=["float32", "bfloat16"],
                    help="bfloat16 = MXU-rate matmuls + fp32 master weights")
     p.add_argument("--seed", type=int, default=1, help="data RNG seed")
+    p.add_argument("--report-mfu", action="store_true",
+                   help="print an MFU line (bench.py FLOPs convention)")
     return p
 
 
@@ -125,6 +127,7 @@ def train(args):
     tokens_done = 0
     t0 = None  # started AFTER the first step so compile time is excluded
     acc = 0.0
+    best_tps = 0.0
     for step in range(1, args.steps + 1):
         src, tgt_in, tgt_lbl = synthetic_batch(rng, args.batch_size,
                                                args.seq_len, args.vocab)
@@ -141,11 +144,38 @@ def train(args):
         if step % args.eval_every == 0 or step == args.steps:
             loss_val = float(L.asnumpy())   # drains the async queue
             tps = tokens_done / max(time.time() - t0, 1e-9)
+            best_tps = max(best_tps, tps)
             acc = greedy_token_acc(net, src, tgt_lbl, args.vocab)
             print(f"step {step}: loss={loss_val:.4f} "
                   f"greedy_acc={acc:.3f} {tps:.0f} tok/s (post-compile)")
             t0 = time.time()
             tokens_done = 0
+    if args.report_mfu:
+        # bench.py's convention: 6·N FLOPs/token over the matmul params
+        # (embedding tables are gathers — excluded) + the attention
+        # score/value terms.  Each step processes B target tokens whose
+        # program also runs the encoder over B·T source tokens, so the
+        # per-reported-token cost doubles, and the decoder carries self
+        # PLUS cross attention.
+        from incubator_mxnet_tpu.callback import device_peak_flops
+        import jax
+
+        d = dims[args.model]
+        D_, L_ = d["units"], d["num_layers"]
+        n_params = sum(p.data().size
+                       for p in net.collect_params().values()
+                       if p.grad_req != "null")
+        n_embed = sum(p.data().size
+                      for name, p in
+                      net._collect_params_with_prefix().items()
+                      if "embed" in name or "pos" in name)
+        T_ = args.seq_len
+        flops_per_tok = (6 * (n_params - n_embed) * 2
+                         + 12 * T_ * D_ * (L_ + 2 * L_))
+        mfu = best_tps * flops_per_tok / device_peak_flops(jax.devices()[0])
+        print(f"MFU {100 * mfu:.2f}% at {best_tps:.0f} tok/s "
+              f"(T={T_}, {n_params / 1e6:.0f}M params, "
+              f"final loss {loss_val:.4f}, greedy_acc {acc:.3f})")
     return acc
 
 

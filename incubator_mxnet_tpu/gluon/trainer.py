@@ -55,7 +55,7 @@ class Trainer:
                  max_inflight_steps: Optional[int] = None,
                  max_inflight_bytes: int = 6 << 30,
                  mesh=None, data_axis: str = "data",
-                 chain_steps: int = 1):
+                 chain_steps: int = 1, chain_unroll: bool = False):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -127,6 +127,11 @@ class Trainer:
         # params, grads) flush the chain first, so semantics match the
         # per-step path exactly; requires keep_grads=False.
         self._chain_steps = max(1, int(chain_steps))
+        # unroll: python-loop the K bodies instead of lax.scan — longer
+        # compile (K copies of the step), but no while-loop bookkeeping,
+        # no input stacking, and per-step outputs come back as separate
+        # arrays (no slicing on read)
+        self._chain_unroll = bool(chain_unroll)
         self._chain_buf: list = []
         self._chain_state: Optional[dict] = None
         self._chain_weight_cells: list = []
@@ -534,13 +539,33 @@ class Trainer:
         return True
 
     def _get_chain_fn(self, ctx, has_keys: bool):
-        key = ("chain_fn", has_keys)
+        key = ("chain_fn", has_keys, self._chain_unroll)
         fn = ctx.get(key)
         if fn is None:
             import jax.numpy as jnp
             from jax import lax
 
             pure = ctx["pure"]
+
+            if self._chain_unroll:
+                def chain_unrolled(w, aux, states, ts, per_step):
+                    outs, auxs, sync = [], [], None
+                    for x in per_step:
+                        if has_keys:
+                            rng, ctr, inp, lr, wd, rs, ky = x
+                        else:
+                            rng, ctr, inp, lr, wd, rs = x
+                            ky = None
+                        out_leaves, aux, _g, w, states, ts, sync = pure(
+                            w, aux, states, rng, ctr, inp, ts, lr, wd,
+                            rs, ky)
+                        outs.append(out_leaves)
+                        auxs.append(aux)
+                    return w, aux, states, ts, tuple(outs), tuple(auxs), sync
+
+                fn = jax.jit(chain_unrolled, donate_argnums=(0, 2, 3))
+                ctx[key] = fn
+                return fn
 
             def chain(w, aux, states, ts, per_step):
                 # per_step: K per-step tuples — stacked HERE, inside the
@@ -611,10 +636,17 @@ class Trainer:
                 fn = self._get_chain_fn(ctx, has_keys)
                 new_w, new_aux, new_s, new_ts, outs, auxs, sync = fn(
                     st["w"], st["aux"], st["states"], st["ts"], per_step)
-                for k, r in enumerate(buf):
-                    self._fill_pending_sliced(
-                        r["pending"], outs, auxs, k,
-                        final_aux=new_aux if k == K - 1 else None)
+                if self._chain_unroll:
+                    # per-step outputs are separate arrays — fill direct
+                    for k, r in enumerate(buf):
+                        r["pending"].fill_from_full_step(outs[k], auxs[k],
+                                                         None)
+                        done += 1
+                else:
+                    for k, r in enumerate(buf):
+                        self._fill_pending_sliced(
+                            r["pending"], outs, auxs, k,
+                            final_aux=new_aux if k == K - 1 else None)
             else:
                 # tail/partial flush: reuse the compiled single-step fn
                 w, aux, states, ts = live

@@ -185,6 +185,13 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
     # full tile and pad — Mosaic requires sublane/lane-divisible blocks
     bq = min(block_q, Tq) if interpret else block_q
     bk = min(block_k, Tk) if interpret else block_k
+    streamed = Tk * D * k.dtype.itemsize > _KV_RESIDENT_MAX_BYTES
+    if streamed and not interpret and Tk >= 1024:
+        # streamed-KV grid: per-step work/DMA is one (bk, D) block, so
+        # 512-row blocks leave the MXU idle between tiny 64 KB DMAs —
+        # 1024 measures 47.9 vs 29.9 TF/s at T=16k (2048 regresses and
+        # 4096 exceeds VMEM; benchmark/flash_profile.py sweep)
+        bk = max(bk, 1024)
     pad_q = (-Tq) % bq
     pad_k = (-Tk) % bk
     qf = q.reshape(B * H, Tq, D)
@@ -201,7 +208,7 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
         jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
         jax.ShapeDtypeStruct((B * H, 8, Tq_p), jnp.float32),
     ]
-    if Tk_p * D * k.dtype.itemsize <= _KV_RESIDENT_MAX_BYTES:
+    if not streamed:
         # below the VMEM wall: whole KV resident, fastest
         kernel = functools.partial(_fa_kernel_resident, scale=scale,
                                    causal=causal, bq=bq, bk=bk, nk=nk,

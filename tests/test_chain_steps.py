@@ -39,11 +39,12 @@ def _batch(s):
 
 
 def _run(chain_steps, n_steps, read_every=None, opt="sgd",
-         opt_args=None):
+         opt_args=None, unroll=False):
     net = _net(seed=7)
     tr = Trainer(net.collect_params(), opt,
                  opt_args or {"learning_rate": 0.05, "momentum": 0.9},
-                 keep_grads=False, chain_steps=chain_steps)
+                 keep_grads=False, chain_steps=chain_steps,
+                 chain_unroll=unroll)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     read = []
     for s in range(n_steps):
@@ -59,9 +60,10 @@ def _run(chain_steps, n_steps, read_every=None, opt="sgd",
     return params, read, tr
 
 
-def test_chained_matches_per_step_including_bn_stats():
+@pytest.mark.parametrize("unroll", [False, True])
+def test_chained_matches_per_step_including_bn_stats(unroll):
     p1, _r1, tr1 = _run(1, 7)
-    p3, _r3, tr3 = _run(3, 7)  # 2 full scans + a 1-step tail flush
+    p3, _r3, tr3 = _run(3, 7, unroll=unroll)  # 2 full flushes + 1 tail
     assert tr3._chain_steps == 3
     for i, (a, b) in enumerate(zip(p3, p1)):
         onp.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6,
