@@ -58,14 +58,17 @@ def _time_chained(fn, args, flops):
 
     float(multi(*args))
     float(null(*args))
-    best = float("inf")
+    t_null = float("inf")
     for _ in range(REPS):
         t0 = time.perf_counter()
         float(null(*args))
-        t_null = time.perf_counter() - t0
+        t_null = min(t_null, time.perf_counter() - t0)
+    best = float("inf")
+    for _ in range(REPS):
         t0 = time.perf_counter()
         float(multi(*args))
         best = min(best, (time.perf_counter() - t0 - t_null) / K)
+    best = max(best, 1e-6)  # fetch jitter must never yield <=0
     return best * 1e3, flops / best / 1e12
 
 

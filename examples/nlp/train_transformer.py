@@ -169,9 +169,13 @@ def train(args):
                       for name, p in
                       net._collect_params_with_prefix().items()
                       if "embed" in name or "pos" in name)
+        # per step: 6·B·T FLOPs through the encoder params + 6·B·T
+        # through the decoder params = 6·(N−N_embed) per REPORTED token
+        # (tokens_done counts B·T/step); attention adds enc-self +
+        # dec-self + cross = 3L score/value terms
         T_ = args.seq_len
-        flops_per_tok = (6 * (n_params - n_embed) * 2
-                         + 12 * T_ * D_ * (L_ + 2 * L_))
+        flops_per_tok = (6 * (n_params - n_embed)
+                         + 12 * T_ * D_ * 3 * L_)
         mfu = best_tps * flops_per_tok / device_peak_flops(jax.devices()[0])
         print(f"MFU {100 * mfu:.2f}% at {best_tps:.0f} tok/s "
               f"(T={T_}, {n_params / 1e6:.0f}M params, "

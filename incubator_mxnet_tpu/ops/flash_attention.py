@@ -185,13 +185,6 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
     # full tile and pad — Mosaic requires sublane/lane-divisible blocks
     bq = min(block_q, Tq) if interpret else block_q
     bk = min(block_k, Tk) if interpret else block_k
-    streamed = Tk * D * k.dtype.itemsize > _KV_RESIDENT_MAX_BYTES
-    if streamed and not interpret and Tk >= 1024:
-        # streamed-KV grid: per-step work/DMA is one (bk, D) block, so
-        # 512-row blocks leave the MXU idle between tiny 64 KB DMAs —
-        # 1024 measures 47.9 vs 29.9 TF/s at T=16k (2048 regresses and
-        # 4096 exceeds VMEM; benchmark/flash_profile.py sweep)
-        bk = max(bk, 1024)
     pad_q = (-Tq) % bq
     pad_k = (-Tk) % bk
     qf = q.reshape(B * H, Tq, D)
@@ -208,8 +201,9 @@ def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
         jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
         jax.ShapeDtypeStruct((B * H, 8, Tq_p), jnp.float32),
     ]
-    if not streamed:
-        # below the VMEM wall: whole KV resident, fastest
+    if Tk_p * D * k.dtype.itemsize <= _KV_RESIDENT_MAX_BYTES:
+        # below the VMEM wall (PADDED extent — what the kernel actually
+        # holds): whole KV resident, fastest
         kernel = functools.partial(_fa_kernel_resident, scale=scale,
                                    causal=causal, bq=bq, bk=bk, nk=nk,
                                    tq=Tq, tk=Tk)
@@ -640,7 +634,7 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     Blocks default to shape-derived sizes (`_auto_block`)."""
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     return _flash_lse(q, k, v, causal, scale, _auto_block(q.shape[2], block_q),
-                      _auto_block(k.shape[2], block_k), force_reference)
+                      _auto_block_k(k, block_k), force_reference)
 
 
 def _auto_block(t: int, requested) -> int:
@@ -655,6 +649,29 @@ def _auto_block(t: int, requested) -> int:
         if t % b == 0:
             return b
     return 128
+
+
+def _auto_block_k(k, requested) -> int:
+    """Default KV block.  On the STREAMED-KV path (per K/V tensor over
+    the VMEM-resident budget) the per-grid-step work/DMA is one (bk, D)
+    block, and 512-row blocks leave the MXU idle between 64 KB DMAs —
+    1024 measures 47.9 vs 29.9 TF/s at T=16k D=64 (2048 regresses,
+    4096 exceeds VMEM; benchmark/flash_profile.py sweep).  The bump
+    applies only to DEFAULTED block_k and small head dims (the f32
+    K+V double-buffered working set stays ≲2 MB at D≤128); explicit
+    caller blocks are always honored."""
+    if requested is not None:
+        return requested
+    t, d = k.shape[2], k.shape[3]
+    b = _auto_block(t, None)
+    import numpy as _onp
+
+    itemsize = _onp.dtype(jnp.bfloat16).itemsize if k.dtype == jnp.bfloat16 \
+        else _onp.dtype(k.dtype).itemsize
+    if (t * d * itemsize > _KV_RESIDENT_MAX_BYTES and d <= 128
+            and t >= 1024):
+        b = max(b, 1024)
+    return b
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
@@ -673,7 +690,7 @@ def flash_attention(q, k, v, causal: bool = False, scale: Optional[float] = None
     was_nd = isinstance(q, NDArray)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     block_q = _auto_block(q.shape[2], block_q)
-    block_k = _auto_block(k.shape[2], block_k)
+    block_k = _auto_block_k(k, block_k)
     if was_nd:
         # eager NDArray path: route through apply_op so autograd.record()
         # tapes the custom VJP like any other op
