@@ -210,6 +210,35 @@ def _run_node(node, env):
         for v in x[1:]:
             r = r + v
         out(r)
+    elif op == "Shape":
+        # emit a HOST constant: shapes are static under jit, and
+        # downstream shape-programming ops (ConstantOfShape, Reshape,
+        # Expand) need concrete ints, not a traced array
+        out(onp.asarray(x[0].shape, onp.int64))
+    elif op == "ConstantOfShape":
+        fill = a.get("value")
+        fill = jnp.asarray(fill).reshape(()) if fill is not None \
+            else jnp.float32(0)
+        import jax.core as _jcore
+
+        if isinstance(x[0], _jcore.Tracer):
+            raise NotImplementedError(
+                "ONNX import: ConstantOfShape with a data-dependent "
+                "shape (XLA needs static shapes)")
+        out(jnp.full(tuple(onp.asarray(x[0]).tolist()), fill))
+    elif op == "Pad":
+        pads = (onp.asarray(x[1]).tolist() if len(x) > 1
+                else list(a["pads"]))
+        n = len(pads) // 2
+        cfg = list(zip(pads[:n], pads[n:]))
+        mode = a.get("mode", "constant")
+        if mode == "constant":
+            cval = onp.asarray(x[2]).reshape(()) if len(x) > 2 \
+                else a.get("value", 0.0)
+            out(jnp.pad(x[0], cfg, constant_values=cval))
+        else:  # reflect / edge
+            out(jnp.pad(x[0], cfg,
+                        mode={"reflect": "reflect", "edge": "edge"}[mode]))
     elif op in ("GlobalMaxPool", "GlobalAveragePool"):
         axes = tuple(range(2, x[0].ndim))
         fn = jnp.max if op == "GlobalMaxPool" else jnp.mean

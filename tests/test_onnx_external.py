@@ -63,6 +63,8 @@ def attr(name: str, value) -> bytes:
         out += iv(3, value) + iv(20, 2)          # i / INT
     elif isinstance(value, float):
         out += fv(2, value) + iv(20, 1)          # f / FLOAT
+    elif isinstance(value, str):
+        out += ld(4, value.encode()) + iv(20, 3)      # s / STRING
     elif isinstance(value, onp.ndarray):
         out += ld(5, tensor("", value)) + iv(20, 4)   # t / TENSOR
     elif isinstance(value, (list, tuple)):
@@ -201,6 +203,26 @@ def test_external_clip_with_omitted_min_input(tmp_path):
     x = onp.asarray([-3.0, 0.5, 6.5, 100.0], onp.float32)
     onp.testing.assert_allclose(
         onp.asarray(m(x)), onp.asarray([-3.0, 0.5, 6.0, 6.0]), rtol=1e-6)
+
+
+def test_external_pad_shape_constantofshape(tmp_path):
+    """Shape -> ConstantOfShape -> Add with a reflect-Pad branch — the
+    shape-programming idiom external exporters emit constantly."""
+    nodes = [
+        node("Pad", ["x"], ["p"], pads=[0, 1, 0, 1], mode="reflect"),
+        node("Shape", ["p"], ["s"]),
+        node("ConstantOfShape", ["s"], ["z"],
+             value=onp.asarray([2.5], onp.float32)),
+        node("Add", ["p", "z"], ["y"]),
+    ]
+    by = model(nodes, [], [("x", (2, 3))], [("y", (2, 5))])
+    p = tmp_path / "external_shapeprog.onnx"
+    p.write_bytes(by)
+    m, _a, _x = mx_onnx.import_model(str(p))
+    x = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    got = onp.asarray(m(x))
+    want = onp.pad(x, ((0, 0), (1, 1)), mode="reflect") + 2.5
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
 
 
 def test_serde_decodes_tensor_attribute_roundtrip():
