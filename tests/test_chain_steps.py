@@ -142,3 +142,26 @@ def test_chained_save_states_flushes(tmp_path):
     # restored counts round-trip
     tr.load_states(str(tmp_path / "t.states"))
     assert tr._optimizer.num_update == 3
+
+
+def test_chain_steps_refused_loudly_when_config_unsupported():
+    """chain_steps>1 with keep_grads=True must warn once, not silently
+    run unchained (review r5 finding)."""
+    import warnings as _w
+
+    net = _net(seed=13)
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05},
+                 keep_grads=True, chain_steps=4)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = _batch(0)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        for _ in range(2):
+            with autograd.record():
+                L = loss_fn(net(NDArray(x)), NDArray(y))
+            L.backward()
+            tr.step(B)
+    msgs = [str(w.message) for w in rec if "chain_steps" in str(w.message)]
+    assert len(msgs) == 1, msgs  # warned, and only once
+    assert "keep_grads" in msgs[0]
+    assert not tr._chain_buf
