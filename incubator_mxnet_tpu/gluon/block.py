@@ -621,6 +621,7 @@ class HybridBlock(Block):
         self._cached_fn = None
         self._aval_cache = {}
         self._chain_cache = {}
+        self._aux_cell_avals = None
         self._cache_version += 1
 
     def infer_shape(self, *args):
@@ -736,11 +737,19 @@ class HybridBlock(Block):
         pending = _PendingStep(self, training, arg_tree, train_raws, aux_raws,
                                rng, rng_ctr, input_raws, treedef, out_avals, aux)
         # aux params go lazy too: they are rebound to cells the pending
-        # fills (a read before the step forces the staged forward)
-        for p, a in zip(aux, aux_raws):
-            av = _aval_or_raw(a)
-            cell = LazyRef(pending.force_fwd,
-                           jax.ShapeDtypeStruct(av.shape, av.dtype))
+        # fills (a read before the step forces the staged forward).
+        # Cell avals are CACHED per block — building a ShapeDtypeStruct
+        # per aux param per step measured ~5 ms/step of pure host
+        # bookkeeping on ResNet-50's 106 BN stats
+        cell_avals = getattr(self, "_aux_cell_avals", None)
+        if cell_avals is None or len(cell_avals) != len(aux):
+            cell_avals = tuple(
+                jax.ShapeDtypeStruct(_aval_or_raw(a).shape,
+                                     _aval_or_raw(a).dtype)
+                for a in aux_raws)
+            self._aux_cell_avals = cell_avals
+        for p, av in zip(aux, cell_avals):
+            cell = LazyRef(pending.force_fwd, av)
             pending.aux_cells.append(cell)
             p._data_nd._data = cell
 
@@ -869,10 +878,15 @@ class HybridBlock(Block):
         pending2 = _PendingStep(chained, training, token, train_raws,
                                 aux_raws, pend.rng, pend.rng_ctr, input_raws,
                                 treedef, out_avals, comb_aux)
-        for p, a in zip(comb_aux, aux_raws):
-            av = _aval_or_raw(a)
-            cell = LazyRef(pending2.force_fwd,
-                           jax.ShapeDtypeStruct(av.shape, av.dtype))
+        cell_avals = getattr(chained, "_aux_cell_avals", None)
+        if cell_avals is None or len(cell_avals) != len(comb_aux):
+            cell_avals = tuple(
+                jax.ShapeDtypeStruct(_aval_or_raw(a).shape,
+                                     _aval_or_raw(a).dtype)
+                for a in aux_raws)
+            chained._aux_cell_avals = cell_avals
+        for p, av in zip(comb_aux, cell_avals):
+            cell = LazyRef(pending2.force_fwd, av)
             pending2.aux_cells.append(cell)
             p._data_nd._data = cell
         # the upstream's existing output cells become the tail of this
