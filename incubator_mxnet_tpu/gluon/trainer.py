@@ -32,6 +32,17 @@ from ..ndarray.ndarray import raw
 from .parameter import Parameter, ParameterDict
 
 
+def _wait_or_surface(leaf) -> None:
+    """Block on a throttle leaf; a buffer donated into a later step is
+    already consumed (benign), but a REAL async execution error (e.g.
+    device OOM) must not be silently dropped."""
+    try:
+        jax.block_until_ready(leaf)
+    except RuntimeError as e:
+        if "deleted" not in str(e):
+            raise
+
+
 def _aval_bytes(a) -> int:
     import math
 
@@ -410,13 +421,7 @@ class Trainer:
         self._inflight.append(leaf)
         while len(self._inflight) > self._max_inflight:
             old = self._inflight.popleft()
-            try:
-                jax.block_until_ready(old)
-            except RuntimeError as e:
-                # donated/deleted buffer: the pipeline moved past it;
-                # real async execution errors must surface
-                if "deleted" not in str(e):
-                    raise
+            _wait_or_surface(old)
 
     def _throttle_bytes(self, leaf, held_bytes: int):
         """Byte-budgeted run-ahead bound for the one-program step.
@@ -445,14 +450,7 @@ class Trainer:
             last = None
             while len(self._inflight) > depth // 2:
                 last = self._inflight.popleft()
-            try:
-                jax.block_until_ready(last)
-            except RuntimeError as e:
-                # a leaf donated into a later step is already consumed —
-                # benign; anything else is a REAL async execution error
-                # (e.g. device OOM) that must not be silently dropped
-                if "deleted" not in str(e):
-                    raise
+            _wait_or_surface(last)
 
     # ------------------------------------------------------------------ #
     # multi-step chaining (chain_steps > 1): K canonical steps buffered
@@ -462,6 +460,19 @@ class Trainer:
     # the per-step path exactly; the win is K-1 avoided host/relay
     # dispatch gaps (the dependency-engine run-ahead, one level up).
     # ------------------------------------------------------------------ #
+    def _materialize_ts(self, ctx, idx_of):
+        """Device step counter: steady-state device-resident, else ONE
+        transfer from the authoritative host counts (int32: exact +1
+        past 2^24; update rules get the f32 view in-program)."""
+        import jax.numpy as jnp
+
+        ts = ctx.get("ts_dev")
+        if ts is None:
+            opt = self._optimizer
+            ts = jnp.asarray([int(opt._index_update_count[i])
+                              for i in idx_of], jnp.int32)
+        return ts
+
     def _chain_allowed(self) -> bool:
         if self._chain_steps <= 1:
             return False
@@ -497,21 +508,17 @@ class Trainer:
         opt = self._optimizer
         idx_of = ctx["idx_of"]
         lr, keys = self._advance_scalars(idx_of)
+        flush = self._flush_chain
         if self._chain_state is None:
             from .block import _resolve_raws
 
-            ts = ctx.get("ts_dev")
-            if ts is None:
-                ts = jnp.asarray([int(opt._index_update_count[i])
-                                  for i in idx_of], jnp.int32)
             self._chain_state = {
                 "w": tuple(nd._data for nd in ctx["nds"]),
                 "aux": _resolve_raws(pending.aux_raws),
                 "states": ctx["states"],
-                "ts": ts,
+                "ts": self._materialize_ts(ctx, idx_of),
                 "ctx": ctx,
             }
-            flush = self._flush_chain
             cells = []
             for nd, w in zip(ctx["nds"], self._chain_state["w"]):
                 cell = LazyRef(flush,
@@ -519,7 +526,6 @@ class Trainer:
                 nd._data = cell
                 cells.append(cell)
             self._chain_weight_cells = cells
-        flush = self._flush_chain
         self._chain_buf.append({
             "pending": pending,
             "rng": pending.rng, "ctr": pending.rng_ctr,
@@ -867,17 +873,7 @@ class Trainer:
         idx_of = ctx["idx_of"]
         prev_num_update = opt.num_update
         lr, keys = self._advance_scalars(idx_of)
-        ts = ctx.get("ts_dev")
-        if ts is None:
-            # first step after a ctx (re)build: materialize ts from the
-            # authoritative host counts (one transfer).  int32 so the
-            # on-device +1 stays exact past 2^24 steps (an f32 counter
-            # would silently freeze there); the update rules receive the
-            # f32 cast inside the program.
-            ts = jnp.asarray([int(opt._index_update_count[i])
-                              for i in idx_of], jnp.int32)
-        # else: steady state — ts is device-resident, incremented inside
-        # the donated program; no per-step host→device transfer
+        ts = self._materialize_ts(ctx, idx_of)
         states = ctx["states"]
         from .block import _resolve_raws
 

@@ -236,9 +236,11 @@ def _run_node(node, env):
             cval = onp.asarray(x[2]).reshape(()) if len(x) > 2 \
                 else a.get("value", 0.0)
             out(jnp.pad(x[0], cfg, constant_values=cval))
-        else:  # reflect / edge
-            out(jnp.pad(x[0], cfg,
-                        mode={"reflect": "reflect", "edge": "edge"}[mode]))
+        elif mode in ("reflect", "edge"):
+            out(jnp.pad(x[0], cfg, mode=mode))
+        else:
+            raise NotImplementedError(
+                f"ONNX import: Pad mode {mode!r} is not supported")
     elif op in ("GlobalMaxPool", "GlobalAveragePool"):
         axes = tuple(range(2, x[0].ndim))
         fn = jnp.max if op == "GlobalMaxPool" else jnp.mean

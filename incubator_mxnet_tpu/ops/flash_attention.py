@@ -664,10 +664,7 @@ def _auto_block_k(k, requested) -> int:
         return requested
     t, d = k.shape[2], k.shape[3]
     b = _auto_block(t, None)
-    import numpy as _onp
-
-    itemsize = _onp.dtype(jnp.bfloat16).itemsize if k.dtype == jnp.bfloat16 \
-        else _onp.dtype(k.dtype).itemsize
+    itemsize = jnp.dtype(k.dtype).itemsize  # handles bfloat16 too
     if (t * d * itemsize > _KV_RESIDENT_MAX_BYTES and d <= 128
             and t >= 1024):
         b = max(b, 1024)
