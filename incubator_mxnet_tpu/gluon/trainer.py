@@ -482,8 +482,6 @@ class Trainer:
             reason = "it requires keep_grads=False and donate=True"
         elif kv is not None and getattr(kv, "_is_dist", False):
             reason = "it is not supported with a distributed kvstore"
-        elif self._get_mesh() is not None:
-            reason = "it is not supported with a device mesh (yet)"
         if reason is not None:
             if not getattr(self, "_chain_warned", False):
                 import warnings
@@ -529,7 +527,10 @@ class Trainer:
         self._chain_buf.append({
             "pending": pending,
             "rng": pending.rng, "ctr": pending.rng_ctr,
-            "inputs": tuple(pending.input_raws),
+            # mesh runs: batch inputs placed on the data axis HERE, so
+            # the chained program sees the same shardings the per-step
+            # path would (GSPMD then shards the in-program stack too)
+            "inputs": tuple(self._shard_inputs(pending.input_raws)),
             "lr": float(lr), "wd": float(opt.wd),
             "rescale": float(opt.rescale_grad),
             "keys": keys,

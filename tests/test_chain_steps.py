@@ -165,3 +165,84 @@ def test_chain_steps_refused_loudly_when_config_unsupported():
     assert len(msgs) == 1, msgs  # warned, and only once
     assert "keep_grads" in msgs[0]
     assert not tr._chain_buf
+
+
+def test_chained_on_mesh_matches_single_device():
+    """chain_steps on a TP×DP mesh: the real Gluon BERT through the
+    PUBLIC loop, chained, must match the unchained single-device oracle
+    (the chained program carries SHARDED weights/states and stacks the
+    data-axis-sharded batches in-program)."""
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+    from incubator_mxnet_tpu.gluon.utils import shard_batch
+    from incubator_mxnet_tpu.models import bert
+    from incubator_mxnet_tpu.parallel import create_mesh
+    from incubator_mxnet_tpu.parallel.sharding import shard_params
+
+    V, D, DFF, L, H, Bb, T = 32, 16, 32, 2, 2, 8, 8
+
+    class WithLoss(HybridBlock):
+        def __init__(self, net_, **kw):
+            super().__init__(**kw)
+            self.net = net_
+
+        def forward(self, tokens, labels):
+            mlm_logits, _nsp = self.net(tokens)
+            logp = mx.nd.log_softmax(mlm_logits.astype("float32"))
+            return -(mx.nd.pick(logp, labels).mean())
+
+    def build():
+        mx.random.seed(21)
+        net_ = bert.BERTForPretraining(vocab_size=V, units=D,
+                                       hidden_size=DFF, num_layers=L,
+                                       num_heads=H, dropout=0.0)
+        net_.initialize()
+        net_(NDArray(jnp.ones((Bb, T), jnp.int32)))
+        m = WithLoss(net_)
+        m.hybridize()
+        return net_, m
+
+    def batch(s):
+        k = jax.random.PRNGKey(300 + s)
+        kx, ky = jax.random.split(k)
+        return (jax.random.randint(kx, (Bb, T), 0, V, dtype=jnp.int32),
+                jax.random.randint(ky, (Bb, T), 0, V, dtype=jnp.int32))
+
+    def train(model, tr, mesh, n):
+        losses = []
+        for s in range(n):
+            tok, lab = batch(s)
+            if mesh is not None:
+                tok, lab = shard_batch(tok, mesh), shard_batch(lab, mesh)
+            else:
+                tok, lab = NDArray(tok), NDArray(lab)
+            with autograd.record():
+                L_ = model(tok, lab)
+            L_.backward()
+            tr.step(1)
+        tr.flush()
+        losses.append(float(L_.asnumpy()))
+        return losses
+
+    net1, m1 = build()
+    tr1 = Trainer(m1.collect_params(), "sgd",
+                  {"learning_rate": 0.1, "momentum": 0.9},
+                  keep_grads=False)
+    l1 = train(m1, tr1, None, 6)
+
+    net2, m2 = build()
+    mesh = create_mesh(jax.devices()[:8], data=4, model=2)
+    shard_params(net2, mesh)
+    tr2 = Trainer(m2.collect_params(), "sgd",
+                  {"learning_rate": 0.1, "momentum": 0.9},
+                  keep_grads=False, mesh=mesh, chain_steps=3)
+    l2 = train(m2, tr2, mesh, 6)
+    assert tr2._chain_steps == 3 and not tr2._chain_buf
+    onp.testing.assert_allclose(l2, l1, rtol=3e-5, atol=3e-6)
+    for (pa, pb) in zip(m1.collect_params().values(),
+                        m2.collect_params().values()):
+        onp.testing.assert_allclose(pb.data().asnumpy(),
+                                    pa.data().asnumpy(),
+                                    rtol=5e-5, atol=5e-6)
