@@ -75,7 +75,11 @@ def greedy_token_acc(net, src, tgt_labels, vocab):
     bos = jnp.zeros((B, 1), jnp.int32)
     tgt_in = jnp.concatenate([bos, tgt_labels[:, :-1]], axis=1)
     logits = net(NDArray(src), NDArray(tgt_in))
-    pred = logits.asnumpy().argmax(-1)
+    # argmax ON DEVICE: fetching (B, T, V) logits over the relay's ~MB/s
+    # device->host link costs minutes at V=32k — a (B, T) array is free.
+    # NDArray.argmax returns float32 (mxnet convention); round-trip to
+    # int so the equality check is dtype-honest
+    pred = logits.argmax(axis=-1).asnumpy().astype("int64")
     import numpy as onp
 
     return float((pred == onp.asarray(tgt_labels)).mean())

@@ -272,13 +272,24 @@ class LabelSmoothedCELoss(HybridBlock):
     def forward(self, logits, labels):
         import jax
 
+        from ..ops.xent_kernel import fused_smoothed_xent, should_fuse
+
         def f(lg, lb):
             V = lg.shape[-1]
-            logp = jax.nn.log_softmax(lg, axis=-1)
             lb_i = lb.astype(jnp.int32)
-            nll = -jnp.take_along_axis(logp, lb_i[..., None], axis=-1)[..., 0]
-            smooth = -jnp.mean(logp, axis=-1)
-            loss = (1 - self._eps) * nll + self._eps * smooth
+            if should_fuse(V):
+                # streamed Pallas path: per-element smoothed CE without
+                # the (N, V) fp32 log-prob tensor (ops/xent_kernel.py).
+                # ignore_index rows contribute 0 via the valid mask and
+                # get zero cotangent, so their in-range-wrapped label
+                # lookup never leaks into loss or grads
+                loss = fused_smoothed_xent(lg, lb_i, self._eps)
+            else:
+                logp = jax.nn.log_softmax(lg, axis=-1)
+                nll = -jnp.take_along_axis(logp, lb_i[..., None],
+                                           axis=-1)[..., 0]
+                smooth = -jnp.mean(logp, axis=-1)
+                loss = (1 - self._eps) * nll + self._eps * smooth
             valid = (lb_i != self._ignore).astype(jnp.float32)
             return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 

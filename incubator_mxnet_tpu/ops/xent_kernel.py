@@ -39,7 +39,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["fused_sparse_xent", "should_fuse", "FUSED_MIN_CLASSES"]
+__all__ = ["fused_sparse_xent", "fused_smoothed_xent", "should_fuse",
+           "FUSED_MIN_CLASSES"]
 
 _BR = 128    # rows per block
 _BV = 7680   # vocab lanes per block (60 * 128)
@@ -59,17 +60,28 @@ def _ceil(a, b):
     return -(-a // b)
 
 
-def _fwd_kernel(x_ref, lse_ref, m_ref, l_ref, *, V, bv, nv):
+def _fwd_kernel(x_ref, *refs, V, bv, nv, want_sum):
+    """want_sum=False: refs = (lse_ref, m_ref, l_ref) — the plain-xent
+    forward, unchanged cost.  want_sum=True adds (xsum_ref out, s_ref
+    scratch): the per-row raw-logit sum rides the same streaming pass
+    (the label-smoothing term is lse - sum/V); only the smoothed path
+    pays the extra per-lane add."""
     from jax.experimental import pallas as pl
 
+    if want_sum:
+        lse_ref, xsum_ref, m_ref, l_ref, s_ref = refs
+    else:
+        lse_ref, m_ref, l_ref = refs
     j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[...] = jnp.zeros_like(l_ref)
+        if want_sum:
+            s_ref[...] = jnp.zeros_like(s_ref)
 
-    def update(x):
+    def update(x, xz):
         m_old = m_ref[...]  # (BR, 1)
         m_new = jnp.maximum(m_old, jnp.max(x, axis=1, keepdims=True))
         # exp(-inf - -inf) would be NaN before any real lane arrives
@@ -77,6 +89,9 @@ def _fwd_kernel(x_ref, lse_ref, m_ref, l_ref, *, V, bv, nv):
         l_ref[...] = l_ref[...] * corr + jnp.sum(
             jnp.exp(x - m_new), axis=1, keepdims=True)
         m_ref[...] = m_new
+        if want_sum:
+            # xz = x with tail lanes zeroed (not -inf)
+            s_ref[...] = s_ref[...] + jnp.sum(xz, axis=1, keepdims=True)
 
     ragged = V % bv != 0
     if ragged:
@@ -85,25 +100,31 @@ def _fwd_kernel(x_ref, lse_ref, m_ref, l_ref, *, V, bv, nv):
         def _tail():
             x = x_ref[...].astype(jnp.float32)
             vidx = j * bv + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-            update(jnp.where(vidx < V, x, -jnp.inf))
+            update(jnp.where(vidx < V, x, -jnp.inf),
+                   jnp.where(vidx < V, x, 0.0) if want_sum else None)
 
         @pl.when(j < nv - 1)
         def _body():
-            update(x_ref[...].astype(jnp.float32))
+            x = x_ref[...].astype(jnp.float32)
+            update(x, x)
     else:
-        update(x_ref[...].astype(jnp.float32))
+        x = x_ref[...].astype(jnp.float32)
+        update(x, x)
 
     @pl.when(j == nv - 1)
     def _emit():
         lse_ref[...] = m_ref[...] + jnp.log(l_ref[...])
+        if want_sum:
+            xsum_ref[...] = s_ref[...]
 
 
-def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref, *, bv):
-    # d(logits) = (softmax - onehot(label)) * g.  The label compare runs
-    # in-kernel: an O(N) XLA scatter for the -g term measured ~6 ms
-    # (4096 scalar updates serialize on TPU), the per-lane compare ~0.3.
-    # Out-of-range tail lanes write garbage that the BlockSpec clips at
-    # the array boundary.
+def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref, *, bv, V, eps):
+    # d(logits) = (softmax - target) * g with target = (1-eps)·onehot +
+    # eps/V (eps=0 is the plain xent this kernel shipped with).  The
+    # label compare runs in-kernel: an O(N) XLA scatter for the -g term
+    # measured ~6 ms (4096 scalar updates serialize on TPU), the
+    # per-lane compare ~0.3.  Out-of-range tail lanes write garbage
+    # that the BlockSpec clips at the array boundary.
     from jax.experimental import pallas as pl
 
     x = x_ref[...].astype(jnp.float32)
@@ -111,14 +132,17 @@ def _bwd_kernel(x_ref, lab_ref, lse_ref, g_ref, dx_ref, *, bv):
     vidx = pl.program_id(1) * bv + jax.lax.broadcasted_iota(
         jnp.int32, x.shape, 1)
     hit = (vidx == lab_ref[...]).astype(jnp.float32)
-    dx_ref[...] = ((p - hit) * g_ref[...]).astype(dx_ref.dtype)
+    target = hit if eps == 0.0 else (1.0 - eps) * hit + eps / V
+    dx_ref[...] = ((p - target) * g_ref[...]).astype(dx_ref.dtype)
 
 
 def _block_rows(N):
     return _BR if N % _BR == 0 else (8 if N % 8 == 0 else 1)
 
 
-def _pallas_fwd_lse(x2, interpret):
+def _pallas_fwd(x2, interpret, want_sum):
+    """lse — and, for the smoothed loss (want_sum), the per-row logit
+    sum — in ONE streaming pass over (N, V)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -126,27 +150,33 @@ def _pallas_fwd_lse(x2, interpret):
     br = _block_rows(N)
     bv = min(_BV, _ceil(V, 128) * 128)
     nv = _ceil(V, bv)
-    lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, V=V, bv=bv, nv=nv),
+    out = pl.BlockSpec((br, 1), lambda i, j: (i, 0))
+    row = jax.ShapeDtypeStruct((N, 1), jnp.float32)
+    scratch = pltpu.VMEM((br, 1), jnp.float32)
+    n_out = 2 if want_sum else 1
+    res = pl.pallas_call(
+        functools.partial(_fwd_kernel, V=V, bv=bv, nv=nv,
+                          want_sum=want_sum),
         grid=(_ceil(N, br), nv),
         in_specs=[pl.BlockSpec((br, bv), lambda i, j: (i, j))],
-        out_specs=pl.BlockSpec((br, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((N, 1), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((br, 1), jnp.float32),
-                        pltpu.VMEM((br, 1), jnp.float32)],
+        out_specs=(out,) * n_out if want_sum else out,
+        out_shape=(row,) * n_out if want_sum else row,
+        scratch_shapes=[scratch] * (n_out + 1),
         interpret=interpret,
     )(x2)
-    return lse[:, 0]
+    if want_sum:
+        return res[0][:, 0], res[1][:, 0]
+    return res[:, 0], None
 
 
-def _pallas_bwd(x2, labels, lse, g, interpret):
+def _pallas_bwd(x2, labels, lse, g, interpret, eps=0.0):
     from jax.experimental import pallas as pl
 
     N, V = x2.shape
     br = _block_rows(N)
     bv = min(_BV, _ceil(V, 128) * 128)
     return pl.pallas_call(
-        functools.partial(_bwd_kernel, bv=bv),
+        functools.partial(_bwd_kernel, bv=bv, V=V, eps=float(eps)),
         grid=(_ceil(N, br), _ceil(V, bv)),
         in_specs=[
             pl.BlockSpec((br, bv), lambda i, j: (i, j)),
@@ -175,30 +205,47 @@ def _kernel_backend() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def _lse_of(x2, interpret=False):
+def _stats_of(x2, eps, interpret=False):
+    """(lse, xsum-or-None): the plain path (eps=0) runs the lse-only
+    kernel so it pays nothing for the smoothing machinery."""
     if _kernel_backend() or interpret:
-        return _pallas_fwd_lse(x2, interpret)
-    return _ref_lse(x2)
+        return _pallas_fwd(x2, interpret, want_sum=eps != 0.0)
+    if eps == 0.0:
+        return _ref_lse(x2), None
+    return _ref_lse(x2), jnp.sum(x2.astype(jnp.float32), axis=-1)
 
 
-@jax.custom_vjp
-def _xent2d(x2, labels):
-    return _lse_of(x2) - _label_logit(x2, labels)
+def _smooth_value(x2, labels, eps, lse, xsum):
+    # loss = lse - (1-eps)·logits[label] - eps·mean_v(logits): the
+    # exact jax.nn.log_softmax-based smoothed CE, reassociated so only
+    # O(N) row statistics survive the (N, V) stream
+    pick = _label_logit(x2, labels)
+    if eps == 0.0:
+        return lse - pick
+    return lse - (1.0 - eps) * pick - (eps / x2.shape[-1]) * xsum
 
 
-def _xent2d_fwd(x2, labels):
-    lse = _lse_of(x2)
-    return lse - _label_logit(x2, labels), (x2, labels, lse)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _xent2d(x2, labels, eps):
+    lse, xsum = _stats_of(x2, eps)
+    return _smooth_value(x2, labels, eps, lse, xsum)
 
 
-def _xent2d_bwd(res, g):
+def _xent2d_fwd(x2, labels, eps):
+    lse, xsum = _stats_of(x2, eps)
+    return _smooth_value(x2, labels, eps, lse, xsum), (x2, labels, lse)
+
+
+def _xent2d_bwd(eps, res, g):
     x2, labels, lse = res
     if _kernel_backend():
-        return _pallas_bwd(x2, labels, lse, g, interpret=False), None
+        return _pallas_bwd(x2, labels, lse, g, interpret=False,
+                           eps=eps), None
+    V = x2.shape[-1]
     p = jnp.exp(x2.astype(jnp.float32) - lse[:, None])
-    oh = jax.nn.one_hot(labels.astype(jnp.int32), x2.shape[-1],
-                        dtype=jnp.float32)
-    dx = ((p - oh) * g.astype(jnp.float32)[:, None]).astype(x2.dtype)
+    oh = jax.nn.one_hot(labels.astype(jnp.int32), V, dtype=jnp.float32)
+    tgt = oh if eps == 0.0 else (1.0 - eps) * oh + eps / V
+    dx = ((p - tgt) * g.astype(jnp.float32)[:, None]).astype(x2.dtype)
     return dx, None
 
 
@@ -214,22 +261,38 @@ def fused_sparse_xent(logits, labels):
     V = logits.shape[-1]
     lead = logits.shape[:-1]
     x2 = logits.reshape(-1, V)
-    nll = _xent2d(x2, labels.reshape(-1))
+    nll = _xent2d(x2, labels.reshape(-1), 0.0)
     return nll.reshape(lead)
 
 
-def run_interpret(logits, labels):
-    """Interpret-mode kernel run (CPU CI parity for the kernel math)."""
+def fused_smoothed_xent(logits, labels, smoothing: float):
+    """Label-smoothed CE `lse - (1-eps)·logits[label] - eps·mean(logits)`
+    per element — the exact log_softmax-based smoothed loss, streamed so
+    no (N, V) fp32 log-prob tensor ever materializes (the per-row logit
+    sum rides the same online-softmax pass; the backward kernel folds
+    the eps/V uniform target in).  smoothing=0 is `fused_sparse_xent`."""
+    V = logits.shape[-1]
+    lead = logits.shape[:-1]
+    x2 = logits.reshape(-1, V)
+    loss = _xent2d(x2, labels.reshape(-1), float(smoothing))
+    return loss.reshape(lead)
+
+
+def run_interpret(logits, labels, smoothing: float = 0.0):
+    """Interpret-mode kernel run (CPU CI parity for the kernel math) —
+    same want_sum selection as production: smoothing=0 exercises the
+    lse-only kernel variant, smoothing>0 the (lse, xsum) one."""
     V = logits.shape[-1]
     x2 = logits.reshape(-1, V)
-    lse = _pallas_fwd_lse(x2, interpret=True)
-    nll = lse - _label_logit(x2, labels.reshape(-1))
-    return nll.reshape(logits.shape[:-1]), lse
+    eps = float(smoothing)
+    lse, xsum = _stats_of(x2, eps, interpret=True)
+    loss = _smooth_value(x2, labels.reshape(-1), eps, lse, xsum)
+    return loss.reshape(logits.shape[:-1]), lse
 
 
-def run_interpret_bwd(logits, labels, lse, g):
+def run_interpret_bwd(logits, labels, lse, g, smoothing: float = 0.0):
     V = logits.shape[-1]
     x2 = logits.reshape(-1, V)
     dx = _pallas_bwd(x2, labels.reshape(-1), lse.reshape(-1),
-                     g.reshape(-1), interpret=True)
+                     g.reshape(-1), interpret=True, eps=float(smoothing))
     return dx.reshape(logits.shape)
