@@ -89,8 +89,11 @@ def main():
 
 
 def bandwidth():
-    """Effective GB/s at the flagship site shape (r4 perf fix gate:
-    the shipped 64x128-per-grid-step geometry measured ~200 GB/s)."""
+    """Effective GB/s at the flagship site shape under the r5
+    mask-split traffic model (mask write+read at 1 B/elem + apply's
+    x read / y write).  History: the r4 apply-in-kernel op measured
+    ~200 GB/s before execution blocking and >1100 GB/s after, on a
+    2*itemsize model — not directly comparable to this number."""
     import time
 
     from jax import lax
@@ -123,8 +126,13 @@ def bandwidth():
         return b
 
     per_call = (best(chained) - best(null)) / K
-    traffic = 2 * x.size * x.dtype.itemsize  # read + write
-    print(f"  flagship-site fused_dropout: {per_call*1e6:.1f} us/call, "
+    # r5 mask-split traffic per call: mask write + mask read (1 B/elem
+    # each) + the XLA apply's x read and y write.  (Pre-r5
+    # apply-in-kernel was 2*itemsize; the old ~200 GB/s r4 gate number
+    # is not directly comparable.)
+    traffic = x.size * (2 + 2 * x.dtype.itemsize)
+    print(f"  flagship-site fused_dropout (mask+apply): "
+          f"{per_call*1e6:.1f} us/call, "
           f"{traffic/per_call/1e9:.0f} GB/s effective")
 
 

@@ -564,13 +564,14 @@ def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: boo
     key = _random.next_key()
 
     if not axes:
-        # fused path on EVERY backend: on TPU the mask comes from the
-        # in-kernel Mosaic PRNG (no threefry mask materialized through
-        # HBM — the BERT "dropout tax", BASELINE.md) and backward
-        # regenerates it from the seed (zero extra memory); elsewhere a
-        # block-keyed threefry with the same structure.  Both are
-        # GSPMD-partitionable (custom_partitioning row rule), so this
-        # path stays active on multi-device meshes.
+        # fused path on EVERY backend: on TPU the uint8 keep-mask comes
+        # from the in-kernel Mosaic PRNG (1 byte/element, off the
+        # critical path — the BERT "dropout tax", BASELINE.md) and the
+        # apply fuses into neighboring XLA fusions; backward reuses the
+        # saved mask.  Elsewhere a block-keyed threefry mask with the
+        # same structure.  Both are GSPMD-partitionable
+        # (custom_partitioning tile rule), so this path stays active on
+        # multi-device meshes.
         from ..ops.dropout_kernel import fused_dropout
 
         seed_arr = _random.key_to_seed(key)
@@ -588,10 +589,10 @@ def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: boo
 
 def DropoutAdd(data, residual, p: float = 0.5, mode: str = "training",
                training: bool = False):
-    """``residual + Dropout(data)`` fused into one kernel pass — the
-    transformer post-sublayer pattern.  Same mask bits and partitioning
-    as `Dropout` (no-axes form); falls back to the plain sum when
-    dropout is inactive."""
+    """``residual + Dropout(data)`` — the transformer post-sublayer
+    pattern; the masked apply and the add ride one XLA fusion.  Same
+    mask bits and partitioning as `Dropout` (no-axes form); falls back
+    to the plain sum when dropout is inactive."""
     if not (training or mode == "always") or p <= 0.0:
         return wrap(data) + wrap(residual)
     from .. import random as _random

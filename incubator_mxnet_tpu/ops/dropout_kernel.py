@@ -3,16 +3,24 @@ GSPMD-partitionable over any device mesh.
 
 Kills the "dropout tax" (BASELINE.md: threefry mask generation cost
 ~16 ms/step ≈ 20 MFU points on BERT-large): instead of materializing a
-full-size mask through XLA's counter-based threefry (bandwidth-bound:
-mask write + read on top of the data traffic), each Pallas program
-seeds the per-core PRNG (`pltpu.prng_seed`) and draws the keep-mask for
-its tile on the fly — the op touches HBM exactly twice (read x, write
-out), the bandwidth floor of any elementwise op.
+full-size mask through XLA's counter-based threefry (bandwidth-bound,
+and serialized with the step's compute), each Pallas program seeds the
+per-core PRNG (`pltpu.prng_seed`) and draws the keep-mask for its tile
+on the fly (ref: src/operator/nn/dropout.cc MSHADOW path, SURVEY.md
+§2.3 — re-designed for the TPU memory system).
 
-Backward regenerates the SAME bits from the same seed words instead of
-saving the mask — zero extra memory, the recompute trick the
-reference's fused dropout uses for cuDNN-free paths
-(ref: src/operator/nn/dropout.cc MSHADOW path, SURVEY.md §2.3).
+r5 split: the KERNEL emits only the uint8 keep-mask (HBM write at 1
+byte/element; x rides along as an operand for the GSPMD rule but is
+never DMA'd or read); the APPLY
+(`where(mask, x*scale, 0) [+ residual]`) is ordinary XLA that fuses
+into the producer/consumer fusions exactly like the dropout-off graph.
+The per-HLO-op A/B profile that motivated this (docs/performance.md)
+showed the previous apply-in-kernel design cost ~5 ms/step on the
+flagship: +1.9 ms of kernel time (its bandwidth floor) but also +3.7
+ms of copy-done stalls and evicted matmul-epilogue fusions from 98
+Pallas punctuation points in the schedule.  Backward reuses the SAVED
+mask (uint8, ~4 MB per flagship site), so fwd/bwd mask identity holds
+by construction and dx fuses into the backward fusions the same way.
 
 Mesh compatibility (the r3 gap: the kernel used to demand ONE device).
 The array is viewed as a canonical 2D grid of (block_rows x block_cols)
@@ -22,11 +30,8 @@ mask depends only on ``(seed, global_tile_coordinates)``.  A
 AND columns (so batch/seq-sharded and tensor-parallel model-sharded
 activations both stay sharded — no all-gather): each shard computes
 its global tile offsets from its mesh coordinates and regenerates
-exactly the bits the unpartitioned op would produce.  Because the mask
-is a pure function of global tile coordinates, ANY tile-aligned
-partitioning — including fwd and bwd landing on different shardings —
-yields the identical global mask, which is what keeps the zero-memory
-backward exact under GSPMD.
+exactly the bits the unpartitioned op would produce — so ANY
+tile-aligned partitioning yields the identical global mask.
 
 CPU (and any non-TPU backend) takes a block-keyed threefry reference
 with the same tile-coordinate keying — same partitioning behavior and
@@ -42,11 +47,15 @@ import jax.numpy as jnp
 from jax.experimental.custom_partitioning import custom_partitioning
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["fused_dropout", "fused_dropout_add"]
+__all__ = ["fused_dropout", "fused_dropout_add", "dropout_mask"]
 
 # upper bound on rows per tile; actual tile geometry is shape-derived
 _BLOCK_ROWS = 1024
-# per-block VMEM budget in elements (x block + out block both live there)
+# tile-geometry budget in bytes at the INPUT's itemsize.  Historically a
+# VMEM bound for the apply-in-kernel design; today the kernel only
+# writes uint8 — but the (shape, dtype)->(br, bc) map is part of the
+# MASK-BIT CONTRACT (changing it reshuffles every mask), so the formula
+# is frozen, itemsize included
 _BLOCK_BUDGET_BYTES = 2 << 20
 
 
@@ -134,33 +143,35 @@ def _tile_geometry(R: int, Clp: int, itemsize: int):
     return _pick_br(R, cap), bc
 
 
-def _dropout_kernel(seed_ref, x_ref, *rest, rate, ncb, br, bc, kr, kc):
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, rate, ncb, br, bc, kr, kc):
     """One EXECUTION block covers a (kr x kc) window of MASK tiles.
 
-    The mask remains a pure function of (seed, global mask-tile id) with
+    The mask is a pure function of (seed, global mask-tile id) with
     (br, bc) mask tiles — identical bits to a kr=kc=1 run — while the
     grid moves (kr*br, kc*bc) blocks per step.  Decoupling execution
     blocking from mask geometry is what fixes the 16 KB-per-grid-step
     regime this kernel shipped with (measured 203 GB/s on the BERT
-    flagship's (4096,1024) sites: 512 steps of 64x128; see
-    docs/performance.md).
+    flagship's (4096,1024) sites; see docs/performance.md).
 
-    ``rest`` is ``(o_ref,)`` for plain dropout or ``(r_ref, o_ref)``
-    for the fused residual-add epilogue (``out = res + dropout(x)``,
-    the transformer post-sublayer pattern) — ONE body so the
-    mask-defining machinery can never fork between the two ops."""
+    r5 redesign: the kernel emits the uint8 KEEP-MASK only; the apply
+    (``where(mask, x*scale, 0) [+ res]``) is ordinary XLA so it fuses
+    into the producer/consumer fusions exactly like the dropout-off
+    graph — the per-op A/B profile showed the old apply-in-kernel
+    design cost ~2x its own bandwidth in broken fusions and copy-done
+    stalls (docs/performance.md).  ``x_ref`` rides along UNREAD (ANY
+    memory space, no DMA): it exists so the GSPMD rule has a
+    sharding-carrying operand."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    r_ref, o_ref = rest if len(rest) == 2 else (None, rest[0])
+    del x_ref  # sharding carrier only
     # distinct stream per global MASK tile: seed words are (user seed,
-    # LINEAR global tile id = (row_block_offset + i) * ncb + j).  Same
-    # words in fwd and bwd regenerate the identical mask; TWO words —
+    # LINEAR global tile id = (row_block_offset + i) * ncb + j).  Any
+    # tile-aligned sharding regenerates the identical bits; TWO words —
     # Mosaic on the v5e rejects 3-word prng_seed — and the second word
     # linearizes (row block, col block) with the STATIC global column
     # block count, so the id is globally unique and shard-invariant.
     thresh = jnp.uint32(min(int(rate * (1 << 32)), (1 << 32) - 1))
-    scale = 1.0 / (1.0 - rate)
     base_i = pl.program_id(0) * kr
     base_j = pl.program_id(1) * kc
     for i in range(kr):  # static unroll over the mask tiles in-block
@@ -173,10 +184,7 @@ def _dropout_kernel(seed_ref, x_ref, *rest, rate, ncb, br, bc, kr, kc):
             # keep iff bits >= rate * 2^32  (P(drop) = rate to 2^-32)
             keep = bits >= thresh
             sl = (slice(i * br, (i + 1) * br), slice(j * bc, (j + 1) * bc))
-            x = x_ref[sl]
-            y = jnp.where(keep, x * jnp.asarray(scale, x.dtype),
-                          jnp.zeros_like(x))
-            o_ref[sl] = y if r_ref is None else y + r_ref[sl]
+            o_ref[sl] = keep.astype(jnp.uint8)
 
 
 # execution-block budget: elements per (in OR out) VMEM block.  With
@@ -218,38 +226,41 @@ def _exec_blocking(rows, cols, br, bc, itemsize):
 
 
 def _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
-              interpret, r2d=None):
-    """Run the Pallas kernel over the (rows_local, cols_local) 2D view.
+              interpret):
+    """Run the mask kernel over the (rows_local, cols_local) 2D view →
+    uint8 keep-mask.
 
     ``row_blk_off``/``col_blk_off``: this shard's global tile offsets
     (0 unpartitioned); ``ncb_g``: GLOBAL column-block count — the
     static stride that linearizes (row block, col block) into the
-    shard-invariant tile id.  ``r2d``: optional residual for the fused
-    ``res + dropout(x)`` epilogue (same kernel body, same mask bits)."""
+    shard-invariant tile id.  ``x2d`` is never read (ANY memory space,
+    no DMA) — it carries the sharding for the GSPMD rule."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     rows, cols = x2d.shape
-    kr, kc = _exec_blocking(rows, cols, br, bc, x2d.dtype.itemsize)
+    kr, kc = _exec_blocking(rows, cols, br, bc, 1)
     lin_off = (jnp.asarray(row_blk_off, jnp.int32) * ncb_g
                + jnp.asarray(col_blk_off, jnp.int32))
     seeds = jnp.concatenate([seed.astype(jnp.int32), lin_off.reshape(1)])
     blk = pl.BlockSpec((kr * br, kc * bc), lambda i, j: (i, j))
-    args = (seeds, x2d) if r2d is None else (seeds, x2d, r2d)
+    # interpret mode has no TPU memory spaces: give x a real BlockSpec
+    x_spec = (blk if interpret
+              else pl.BlockSpec(memory_space=pltpu.ANY))
     return pl.pallas_call(
         functools.partial(_dropout_kernel, rate=rate, ncb=ncb_g,
                           br=br, bc=bc, kr=kr, kc=kc),
         grid=(_row_grid(rows, kr * br), -(-cols // (kc * bc))),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]  # (2,) seed words
-                 + [blk] * (len(args) - 1),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),  # (2,) seed words
+                  x_spec],
         out_specs=blk,
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, jnp.uint8),
         interpret=interpret,
-    )(*args)
+    )(seeds, x2d)
 
 
 def _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
-    """Threefry reference with the SAME global tile keying (CPU /
+    """Threefry reference mask with the SAME global tile keying (CPU /
     oracle): one key per (row block, col block) tile, folded from the
     linear tile id — partition-invariant over rows AND cols."""
     R, Cl = x2d.shape
@@ -257,22 +268,17 @@ def _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
     nbc = Cl // bc  # bc divides every (global or shard) col extent
     rpad = nbr * br - R  # ceil grid: masked tail rows, like the kernel
     base = jax.random.fold_in(jax.random.PRNGKey(0), seed[0])
-    inv = jnp.asarray(1.0 - rate, x2d.dtype)
 
-    def one(lin_id, xt):
+    def one(lin_id):
         k = jax.random.fold_in(base, lin_id)
-        keep = jax.random.bernoulli(k, 1.0 - rate, (br, bc))
-        return jnp.where(keep, xt / inv, jnp.zeros_like(xt))
+        return jax.random.bernoulli(k, 1.0 - rate, (br, bc))
 
-    xp = jnp.pad(x2d, ((0, rpad), (0, 0))) if rpad else x2d
-    tiles = xp.reshape(nbr, br, nbc, bc).transpose(0, 2, 1, 3) \
-        .reshape(nbr * nbc, br, bc)
     ids = ((row_blk_off + jnp.arange(nbr, dtype=jnp.int32))[:, None] * ncb_g
            + (col_blk_off + jnp.arange(nbc, dtype=jnp.int32))[None, :]
            ).reshape(-1)
-    out = jax.vmap(one)(ids, tiles) \
+    out = jax.vmap(one)(ids).astype(jnp.uint8) \
         .reshape(nbr, nbc, br, bc).transpose(0, 2, 1, 3) \
-        .reshape(nbr * br, Cl).astype(x2d.dtype)
+        .reshape(nbr * br, Cl)
     return out[:R] if rpad else out
 
 
@@ -283,18 +289,17 @@ def _kernel_backend() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def _blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g,
-             r2d=None):
+def _blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc, ncb_g):
     if _kernel_backend():
         return _kernel2d(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
-                         ncb_g, interpret=False, r2d=r2d)
-    y = _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
-                     ncb_g)
-    return y if r2d is None else y + r2d
+                         ncb_g, interpret=False)
+    return _ref_blocked(x2d, seed, row_blk_off, col_blk_off, rate, br, bc,
+                        ncb_g)
 
 
 # ------------------------------------------------------------------ #
-# the partitionable op: canonical 2D view, statics (rate, br, bc, ncb_g)
+# the partitionable MASK op: canonical 2D view, statics
+# (rate, br, bc, ncb_g) — returns the uint8 keep-mask for x2d's view
 # ------------------------------------------------------------------ #
 @functools.partial(custom_partitioning, static_argnums=(2, 3, 4, 5))
 def _dp2d(x2d, seed, rate, br, bc, ncb_g):
@@ -330,10 +335,10 @@ def _shard_count_and_offset(spec_entry, m, extent, block):
     return spec_entry, off
 
 
-def _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, with_res):
-    """Shared GSPMD partition rule for the dropout op and its fused
-    residual-add variant — ONE implementation of the shard-offset
-    lowering so the mask keying cannot fork between the two."""
+def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
+    """GSPMD partition rule for the mask op: each shard generates
+    exactly ITS global tiles (offsets from mesh coordinates), so any
+    tile-aligned row/col sharding yields the identical global mask."""
     x_info = arg_shapes[0]
     x_sh = x_info.sharding
     m = x_sh.mesh
@@ -344,22 +349,10 @@ def _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, with_res):
     canon = NamedSharding(m, P(rows_spec, cols_spec))
     seed_sh = NamedSharding(m, P(None))
 
-    if with_res:
-        def lower(xs, rs, seed):
-            return _blocked(xs, seed, row_off(), col_off(), rate, br, bc,
-                            ncb_g, r2d=rs)
-
-        # the residual is elementwise-aligned with x: same canon
-        return mesh, lower, canon, (canon, canon, seed_sh)
-
     def lower(xs, seed):
         return _blocked(xs, seed, row_off(), col_off(), rate, br, bc, ncb_g)
 
     return mesh, lower, canon, (canon, seed_sh)
-
-
-def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
-    return _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, False)
 
 
 _dp2d.def_partition(
@@ -372,34 +365,22 @@ _dp2d.def_partition(
 )
 
 
-@functools.partial(custom_partitioning, static_argnums=(3, 4, 5, 6))
-def _dpadd2d(x2d, r2d, seed, rate, br, bc, ncb_g):
-    z = jnp.int32(0)
-    return _blocked(x2d, seed, z, z, rate, br, bc, ncb_g, r2d=r2d)
-
-
-def _dpadd2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
-    return _partition_impl(rate, br, bc, ncb_g, mesh, arg_shapes, True)
-
-
-_dpadd2d.def_partition(
-    _dpadd2d_partition,
-    infer_sharding_from_operands=None,
-    sharding_rule="i j, i j, k -> i j",
-    need_replication_factors=("k",),
-)
-
-
 def _canonical_2d(x):
-    """(x2d, restore_fn, br, bc, ncb_g) — THE canonical view both `_apply` and
-    `_run` share (the geometry is part of the mask; it is a pure
-    function of the GLOBAL shape+dtype).
+    """(x2d, restore_fn, br, bc, ncb_g) — THE canonical view
+    `dropout_mask` and `_run` share (the geometry is part of the mask;
+    it is a pure function of the GLOBAL shape+dtype).
 
     Arrays with a healthy last dim keep it as the column axis (pad to a
     128 multiple; sharding-friendly: leading dims stay the row axis).
     Small or badly ragged last dims (< 128, or needing > Cl/8 padding)
-    FLATTEN first — per-row padding there would inflate HBM traffic up
-    to 128x, defeating the bandwidth-floor point of the kernel."""
+    FLATTEN first — per-row padding there would inflate the mask (and
+    its apply traffic) up to 128x.
+
+    Tile-CLEAN shapes (every transformer site) return a bitcast view of
+    x — free.  Padded/flattened shapes materialize the view as a real
+    copy to feed the sharding-carrier operand; acceptable on these cold
+    paths, and no worse than the pre-r5 apply-in-kernel design which
+    consumed the same padded operand."""
     Cl = x.shape[-1] if x.ndim >= 2 else x.size
     pad = (-Cl) % 128
     if x.ndim >= 2 and Cl >= 128 and pad * 8 <= Cl:
@@ -424,74 +405,60 @@ def _canonical_2d(x):
             cols // bc)
 
 
-def _apply(x, seed, rate):
-    """Canonical 2D view -> partitionable blocked dropout -> restore."""
-    x2, restore, br, bc, ncb_g = _canonical_2d(x)
-    y2 = _dp2d(x2, seed, float(rate), int(br), int(bc), int(ncb_g))
-    return restore(y2)
+def dropout_mask(x, seed, rate: float):
+    """The uint8 keep-mask for ``x``'s canonical 2D view, restored to
+    ``x.shape`` — a pure function of (seed, global shape, x.dtype,
+    rate); dtype enters through the tile geometry, so a mask drawn for
+    a bf16 array does NOT match an fp32 array of the same shape.  The
+    mask generation never reads x's values (the operand only carries
+    sharding for the GSPMD rule — stop_gradient keeps autodiff from
+    tracing into the partitioned primitive); the mask is a constant to
+    autodiff."""
+    x2, restore, br, bc, ncb_g = _canonical_2d(jax.lax.stop_gradient(x))
+    m2 = _dp2d(x2, seed, float(rate), int(br), int(bc), int(ncb_g))
+    return restore(m2)
 
 
 def _run(x, seed, rate, interpret):
     """Direct kernel runner (interpret-mode testing): same canonical
-    view as `_apply`, global row-block offset 0, no partitioning rule."""
+    view as `dropout_mask` + the same XLA apply, global tile offset 0,
+    no partitioning rule."""
     x2, restore, br, bc, ncb_g = _canonical_2d(x)
     z = jnp.int32(0)
-    y2 = _kernel2d(x2, seed, z, z, rate, br, bc, ncb_g, interpret)
-    return restore(y2)
+    m = restore(_kernel2d(x2, seed, z, z, rate, br, bc, ncb_g, interpret))
+    return _apply_mask(x, m, rate)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _apply_mask(x, mask, rate):
+    scale = jnp.asarray(1.0 / (1.0 - rate), x.dtype)
+    return jnp.where(mask != 0, x * scale, jnp.zeros_like(x))
+
+
 def fused_dropout(x, seed, rate: float):
-    """Dropout with in-kernel PRNG mask. ``seed``: (1,) int32 array —
-    derive it from the step key via `random.key_to_seed`; same seed →
-    same mask (what makes the zero-memory backward exact).  Safe under
-    GSPMD: ANY row and/or column sharding aligned to the global tile
-    grid preserves the global mask bit-for-bit."""
+    """Dropout with in-kernel TPU-PRNG mask. ``seed``: (1,) int32 array
+    — derive it from the step key via `random.key_to_seed`; same seed →
+    same mask.  Safe under GSPMD: ANY row and/or column sharding
+    aligned to the global tile grid yields the global mask bit-for-bit.
+
+    r5 design: the Pallas kernel emits only the uint8 keep-mask (HBM
+    write at the mask's byte size, no x read); the apply is ordinary
+    XLA (`where(mask, x*scale, 0)`) that fuses into the surrounding
+    fusions — the profiled A/B showed apply-in-kernel broke producer/
+    consumer fusion and stalled async copies for ~2x the kernel's own
+    cost.  Backward is automatic: the saved mask IS the forward mask,
+    so fwd/bwd identity holds by construction (and the bwd apply fuses
+    the same way)."""
     if rate >= 1.0:  # degenerate: drop everything (threefry-path parity)
         return jnp.zeros_like(x)
-    if x.size == 0:  # empty ragged tail batch: nothing to mask
-        return x
-    return _apply(x, seed, rate)
-
-
-def _fwd(x, seed, rate):
-    return fused_dropout(x, seed, rate), seed
-
-
-def _bwd(rate, seed, dy):
-    # regenerate the identical mask: dx = mask * scale * dy — exactly
-    # the forward applied to dy
-    return fused_dropout(dy, seed, rate), None
-
-
-fused_dropout.defvjp(_fwd, _bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_dropout_add(x, res, seed, rate: float):
-    """``res + dropout(x)`` in one kernel pass — the transformer
-    post-sublayer pattern fused so the dropped activation never makes
-    an extra HBM round trip between the dropout and the residual add.
-    Mask bits are IDENTICAL to ``fused_dropout(x, seed, rate)`` (same
-    canonical view, tile geometry, and seed words), so the zero-memory
-    backward regenerates them exactly; same GSPMD partitioning rule."""
-    if rate >= 1.0:
-        return res + jnp.zeros_like(x)
     if rate <= 0.0 or x.size == 0:
-        return x + res
-    y2, restore, br, bc, ncb_g = _canonical_2d(x)
-    r2, _, _, _, _ = _canonical_2d(res)
-    out2 = _dpadd2d(y2, r2, seed, float(rate), int(br), int(bc), int(ncb_g))
-    return restore(out2)
+        return x
+    return _apply_mask(x, dropout_mask(x, seed, rate), rate)
 
 
-def _add_fwd(x, res, seed, rate):
-    return fused_dropout_add(x, res, seed, rate), seed
-
-
-def _add_bwd(rate, seed, dy):
-    # d_x = mask*scale*dy (regenerated); d_res = dy (pass-through)
-    return fused_dropout(dy, seed, rate), dy, None
-
-
-fused_dropout_add.defvjp(_add_fwd, _add_bwd)
+def fused_dropout_add(x, res, seed, rate: float):
+    """``res + dropout(x)`` — the transformer post-sublayer pattern.
+    Literally ``res + fused_dropout(...)`` (one definition, so the mask
+    bits and degenerate-rate guards can never fork); the add rides the
+    same XLA fusion as the apply, so no extra HBM pass exists between
+    the dropout and the residual."""
+    return res + fused_dropout(x, seed, rate)

@@ -111,7 +111,19 @@ def step_key():
     inside the jitted program — fresh randomness per step with zero
     eager RNG ops (the r1 bench's per-step `split` cost ~3ms/step of
     relay dispatch).
+
+    Provider-aware (r5 fix): when a TraceKeyProvider is active we are
+    INSIDE another cached program's trace (a hybridized child called
+    from a hybridized parent's apply_fn).  Reading the global state
+    there would bake the CONCRETE (key, counter) into the parent's
+    jaxpr as constants — every replay of the parent program would
+    reuse the same dropout masks (measured: nested-block dropout was
+    step-constant).  Drawing from the provider instead yields a key
+    derived from the parent's TRACED key, so the composed program
+    stays key-parametric end to end.
     """
+    if _STATE.provider is not None:
+        return _STATE.provider.next_key(), 0
     _STATE.step_counter = getattr(_STATE, "step_counter", 0) + 1
     return _STATE.key, _STATE.step_counter
 
