@@ -1,0 +1,88 @@
+"""Per-HLO-op device profile of the flagship BERT train step.
+
+The harness behind the r5 mask-split dropout decision
+(docs/performance.md): run the EXACT bench.py configuration through the
+public Gluon path, trace 8 steady-state steps with `mx.profiler`, and
+print the per-op table + category rollup.  Compare dropout on/off:
+
+    python benchmark/bert_profile.py 0.1
+    python benchmark/bert_profile.py 0.0
+
+The dropout A/B is read from the CATEGORY deltas (the per-op rows are
+dominated by async copy-starts whose durations include dependency
+waits, not transfer time — only `copy-done` entries are real stalls).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+
+V, D, DFF, L, H, B, T = 30522, 1024, 4096, 24, 16, 32, 128
+
+
+def main():
+    dropout = float(sys.argv[1]) if len(sys.argv) > 1 else 0.1
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+    from incubator_mxnet_tpu.models import bert
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    class PretrainWithLoss(HybridBlock):
+        def __init__(self, net_, **kw):
+            super().__init__(**kw)
+            self.net = net_
+            self.mlm_loss = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def forward(self, tokens, labels):
+            mlm_logits, nsp_logits = self.net(tokens)
+            mlm = self.mlm_loss(mlm_logits, labels).mean()
+            nsp_logp = mx.nd.log_softmax(nsp_logits.astype("float32"))
+            return mlm - nsp_logp[:, 0].mean()
+
+    mx.random.seed(0)
+    net = bert.BERTForPretraining(vocab_size=V, units=D, hidden_size=DFF,
+                                  num_layers=L, num_heads=H, dropout=dropout)
+    net.initialize()
+    net(NDArray(jnp.ones((B, T), jnp.int32)))
+    net.cast("bfloat16")
+    model = PretrainWithLoss(net)
+    model.hybridize()
+    trainer = Trainer(model.collect_params(), "sgd",
+                      {"learning_rate": 1e-3, "momentum": 0.9,
+                       "multi_precision": True}, keep_grads=False)
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    tokens = NDArray(jax.random.randint(kx, (B, T), 0, V, dtype=jnp.int32))
+    labels = NDArray(jax.random.randint(ky, (B, T), 0, V, dtype=jnp.int32))
+
+    def step():
+        with autograd.record():
+            loss = model(tokens, labels)
+        loss.backward()
+        trainer.step(1)
+        return loss
+
+    for _ in range(5):
+        loss = step()
+    float(loss.asnumpy())
+
+    mx.profiler.start()
+    for _ in range(8):
+        loss = step()
+    float(loss.asnumpy())
+    mx.profiler.stop()
+    print(f"=== dropout={dropout} per-op table (8 steps) ===")
+    print(mx.profiler.device_op_table(top=25))
+    print("=== category rollup ===")
+    for row in mx.profiler.device_op_summary():
+        print(f"  {row['category']:<28} {row['total_us']/8000:8.2f} ms/step "
+              f"x{row['occurrences'] // 8}")
+
+
+if __name__ == "__main__":
+    main()

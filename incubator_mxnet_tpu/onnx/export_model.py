@@ -27,6 +27,24 @@ _NP2ONNX = {"float32": FLOAT, "int64": INT64, "int32": INT32,
             "bool": INT32, "bfloat16": BFLOAT16}
 
 
+def _is_key(v) -> bool:
+    """True for typed-PRNG-key avals/constants (`key<fry>` dtypes) —
+    THE predicate every key-plumbing special case shares."""
+    dt = getattr(getattr(v, "aval", v), "dtype", "")
+    return str(dt).startswith("key")
+
+
+def _node_checked(op, inputs, outs, attrs=None):
+    """Node constructor for the direct-append sites (Loop/If/Split):
+    same None-input guard as `_Ctx.node` — a None name is the
+    key-plumbing sentinel and must fail loudly with the op named."""
+    if any(i is None for i in inputs):
+        raise NotImplementedError(
+            f"ONNX export: op {op!r} consumes a PRNG-derived value "
+            f"(live randomness has no ONNX mapping)")
+    return Node(op, inputs, outs, attrs=attrs or {})
+
+
 class _Ctx:
     def __init__(self, graph: Graph):
         self.g = graph
@@ -39,6 +57,10 @@ class _Ctx:
         if isinstance(var, Literal):
             return self.add_const(onp.asarray(var.val))
         if var not in self.names:
+            if _is_key(var):
+                # a key whose producer was DCE'd: never mint a dangling
+                # tensor name — None propagates to the node guard below
+                return None
             self.counter += 1
             self.names[var] = f"t{self.counter}"
         return self.names[var]
@@ -62,6 +84,14 @@ class _Ctx:
         return name
 
     def node(self, op, inputs, n_out=1, attrs=None, outputs=None):
+        if any(i is None for i in inputs):
+            # a None input name is the key-plumbing sentinel — reaching
+            # a real node means live inference-time randomness, which
+            # has no ONNX mapping.  Fail HERE with the op named, not in
+            # serde with an AttributeError.
+            raise NotImplementedError(
+                f"ONNX export: op {op!r} consumes a PRNG-derived value "
+                f"(live randomness has no ONNX mapping)")
         outs = outputs or [self.fresh(op.lower()) for _ in range(n_out)]
         self.g.nodes.append(Node(op, inputs, outs, attrs=attrs or {}))
         return outs[0] if n_out == 1 else outs
@@ -139,7 +169,7 @@ def _translate_eqn(ctx: _Ctx, eqn):
         sizes = ctx.add_const(onp.asarray(p["sizes"], "int64"))
         outs_names = [ctx.names.setdefault(o, ctx.fresh("split"))
                       for o in outs]
-        ctx.g.nodes.append(Node("Split", [I(0), sizes], outs_names,
+        ctx.g.nodes.append(_node_checked("Split", [I(0), sizes], outs_names,
                                 attrs={"axis": int(p["axis"])}))
         return
     if prim == "reduce_window_max" or prim == "reduce_window_sum":
@@ -349,6 +379,18 @@ def _translate_eqn(ctx: _Ctx, eqn):
     if prim == "cond":
         _translate_cond(ctx, eqn)
         return
+    if prim in ("random_wrap", "random_unwrap", "random_fold_in",
+                "random_seed", "random_split"):
+        # PRNG-key plumbing: inference-dead by construction
+        # (training=False short-circuits every dropout), but the
+        # unwrap/wrap pairs jax inserts at nested-jit boundaries carry
+        # keys as plain uint32, so dtype-based DCE can't always cut the
+        # chain.  Wire the outputs to None — the established convention
+        # for key operands; a REAL consumer would fail loudly on the
+        # None name downstream.
+        for ov in eqn.outvars:
+            ctx.names[ov] = None
+        return
     if prim in ("pjit", "jit", "closed_call", "core_call", "custom_jvp_call",
                 "custom_vjp_call", "custom_jvp_call_jaxpr", "remat",
                 "checkpoint", "custom_vjp_call_jaxpr"):
@@ -366,8 +408,7 @@ def _translate_eqn(ctx: _Ctx, eqn):
         from jax._src.core import Literal
 
         outer_in_names = [
-            None if str(getattr(iv.aval, "dtype", "")).startswith("key")
-            else ctx.name_of(outer)
+            None if _is_key(iv) else ctx.name_of(outer)
             for iv, outer in zip(inner.invars, ins[:len(inner.invars)])]
         saved_names = ctx.names
         ctx.names = {}
@@ -375,7 +416,7 @@ def _translate_eqn(ctx: _Ctx, eqn):
             ctx.names[iv] = nm
         for cv, c in zip(inner.constvars, consts):
             ctx.names[cv] = ctx.add_const(onp.asarray(c)) \
-                if not str(getattr(c, "dtype", "")).startswith("key") else None
+                if not _is_key(c) else None
         live_out = [v for v in inner.outvars if not isinstance(v, Literal)]
         for sub_eqn in _live_eqns(inner, live_out):
             _translate_eqn(ctx, sub_eqn)
@@ -481,7 +522,7 @@ def _translate_scan(ctx, eqn):
         # ONNX stacks scan-outputs in ITERATION order; jax stacks ys at
         # their xs positions — un-reverse after the Loop
         raw_y_outs = [ctx.fresh("yrev") for _ in raw_y_outs]
-    ctx.g.nodes.append(Node("Loop", [trip, cond0] + carry_names,
+    ctx.g.nodes.append(_node_checked("Loop", [trip, cond0] + carry_names,
                             loop_outs[:ncar] + raw_y_outs,
                             attrs={"body": body}))
     if reverse and loop_outs[ncar:]:
@@ -522,7 +563,7 @@ def _translate_while(ctx, eqn):
     _close_subgraph(ctx, saved)
 
     loop_outs = [ctx.names.setdefault(o, ctx.fresh("while")) for o in outs]
-    ctx.g.nodes.append(Node("Loop", ["", c0] + init, loop_outs,
+    ctx.g.nodes.append(_node_checked("Loop", ["", c0] + init, loop_outs,
                             attrs={"body": body}))
 
 
@@ -559,7 +600,7 @@ def _translate_cond(ctx, eqn):
     else_g = branch_graph(branches[0], "else_branch")
     then_g = branch_graph(branches[1], "then_branch")
     if_outs = [ctx.names.setdefault(o, ctx.fresh("if")) for o in outs]
-    ctx.g.nodes.append(Node("If", [pred_b], if_outs,
+    ctx.g.nodes.append(_node_checked("If", [pred_b], if_outs,
                             attrs={"then_branch": then_g,
                                    "else_branch": else_g}))
 
@@ -569,7 +610,14 @@ def _live_eqns(jx, live_out):
     the model outputs.  Kills inference-dead chains wholesale — notably
     the typed-PRNG-key plumbing a hybridized block carries for dropout
     (random_seed/random_wrap/fold_in have no ONNX mapping and no effect
-    with training=False)."""
+    with training=False).
+
+    Liveness never propagates THROUGH key-typed inputs: a nested cached
+    program (child pjit) takes its rng key as an operand even when
+    training=False leaves it unused inside — the pjit translator wires
+    key-typed inputs to None, so the key-producing chain
+    (random_wrap/fold_in) must stay dead here or it reaches
+    _translate_eqn, which has no mapping for it."""
     live = set(live_out)
     keep = []
     for eqn in reversed(jx.eqns):
@@ -578,7 +626,7 @@ def _live_eqns(jx, live_out):
             from jax._src.core import Literal
 
             for iv in eqn.invars:
-                if not isinstance(iv, Literal):
+                if not isinstance(iv, Literal) and not _is_key(iv):
                     live.add(iv)
     keep.reverse()
     return keep
@@ -599,7 +647,7 @@ def export_jaxpr(closed_jaxpr, arg_names: List[str], out_names: List[str],
         # lazily materialized: dead constvars (e.g. PRNG keys) never
         # become initializers — and typed key arrays cannot anyway
         ctx.names[cv] = ctx.add_const(onp.asarray(c)) \
-            if not str(getattr(c, "dtype", "")).startswith("key") else None
+            if not _is_key(c) else None
     out_vars = [v for v in jx.outvars if not isinstance(v, Literal)]
     for eqn in _live_eqns(jx, out_vars):
         _translate_eqn(ctx, eqn)

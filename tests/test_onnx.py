@@ -159,3 +159,29 @@ def test_export_import_model_zoo(name, hw, ch, tmp_path):
     x = NDArray(jax.random.normal(jax.random.PRNGKey(0), (1, ch, hw, hw)))
     net.initialize()
     _roundtrip(net, x, tmp_path / "zoo.onnx", atol=1e-4)
+
+
+def test_export_live_randomness_fails_loudly(tmp_path):
+    """Inference-DEAD key plumbing exports fine (DCE'd / None-wired);
+    inference-LIVE randomness must raise NotImplementedError naming the
+    consuming op — not crash deep in serde (r5 review contract)."""
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+    from incubator_mxnet_tpu.onnx import export_block
+
+    class AlwaysDrop(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.d = nn.Dense(4, flatten=False, in_units=8)
+
+        def forward(self, x):
+            # mode="always": dropout stays active at inference time
+            return mx.nd.Dropout(self.d(x), p=0.5, mode="always")
+
+    mx.random.seed(0)
+    net = AlwaysDrop()
+    net.initialize()
+    net.hybridize()
+    x = NDArray(jnp.ones((2, 8), jnp.float32))
+    net(x)
+    with pytest.raises(NotImplementedError):
+        export_block(net, [x], str(tmp_path / "live_rng.onnx"))
