@@ -129,8 +129,8 @@ class Dropout(HybridBlock):
         self._axes = axes
 
     def forward(self, x):
-        return nd.Dropout(wrap(x), p=self._rate, axes=self._axes,
-                          training=_tape.is_training())
+        # training=None: the op follows autograd's train mode itself
+        return nd.Dropout(wrap(x), p=self._rate, axes=self._axes)
 
 
 class DropoutAdd(HybridBlock):
@@ -144,8 +144,8 @@ class DropoutAdd(HybridBlock):
         self._rate = rate
 
     def forward(self, y, residual):
-        return nd.DropoutAdd(wrap(y), wrap(residual), p=self._rate,
-                             training=_tape.is_training())
+        # training=None: the op follows autograd's train mode itself
+        return nd.DropoutAdd(wrap(y), wrap(residual), p=self._rate)
 
 
 class BatchNorm(HybridBlock):

@@ -229,11 +229,9 @@ class DropoutCell(HybridRecurrentCell):
         return []
 
     def forward(self, inputs, states):
-        from ... import _tape
-
         if self._rate > 0:
-            inputs = nd.Dropout(wrap(inputs), p=self._rate, axes=self._axes,
-                                training=_tape.is_training())
+            # training=None: the op follows autograd's train mode itself
+            inputs = nd.Dropout(wrap(inputs), p=self._rate, axes=self._axes)
         return inputs, states
 
 

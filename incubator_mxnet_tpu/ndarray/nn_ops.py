@@ -552,11 +552,20 @@ def L2Normalization(data, eps: float = 1e-10, mode: str = "instance"):
 # ---------------------------------------------------------------------- #
 # dropout — RNG threaded via mx.random's trace-aware provider
 # ---------------------------------------------------------------------- #
-def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: bool = False):
+def Dropout(data, p: float = 0.5, mode: str = "training", axes=(),
+            training=None):
     """ref: dropout.cc.  Keys come from `mx.random`'s provider, which is
     a concrete key eagerly and a traced key argument under hybridize —
     so the jitted program stays key-parametric (no baked-in constants).
+
+    ``training=None`` (default) follows `autograd`'s train mode like the
+    reference op (active inside ``record()``, identity outside); pass an
+    explicit bool to override.
     """
+    if training is None:
+        from .. import _tape
+
+        training = _tape.is_training()
     if not (training or mode == "always") or p <= 0.0:
         return wrap(data)
     from .. import random as _random
@@ -588,11 +597,16 @@ def Dropout(data, p: float = 0.5, mode: str = "training", axes=(), training: boo
 
 
 def DropoutAdd(data, residual, p: float = 0.5, mode: str = "training",
-               training: bool = False):
+               training=None):
     """``residual + Dropout(data)`` — the transformer post-sublayer
     pattern; the masked apply and the add ride one XLA fusion.  Same
-    mask bits and partitioning as `Dropout` (no-axes form); falls back
-    to the plain sum when dropout is inactive."""
+    mask bits, partitioning, AND train-mode default as `Dropout`
+    (no-axes form; ``training=None`` follows `autograd`'s train mode);
+    falls back to the plain sum when dropout is inactive."""
+    if training is None:
+        from .. import _tape
+
+        training = _tape.is_training()
     if not (training or mode == "always") or p <= 0.0:
         return wrap(data) + wrap(residual)
     from .. import random as _random
