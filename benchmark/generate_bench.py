@@ -1,0 +1,55 @@
+"""KV-cache decode throughput — single chip, one compiled program.
+
+    python benchmark/generate_bench.py [B] [P] [N]
+
+TransformerLM at the longctx-bench size (12L/1024D/V=32k); reports
+prefill+decode wall time and decoded tokens/s (the inference-side
+counterpart of `benchmark/longctx_bench.py`'s training rows).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+
+V, D, DFF, L, H = 32000, 1024, 4096, 12, 16
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    N = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    mx.random.seed(0)
+    net = TransformerLM(vocab=V, units=D, hidden_size=DFF, num_layers=L,
+                        num_heads=H, max_len=P + N, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((B, 16), jnp.int32)))
+    net.cast("bfloat16")
+
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (B, P), 0, V,
+                                dtype=jnp.int32)
+    import numpy as onp
+
+    out = net.generate(prompt, N)  # compile
+    onp.asarray(out)  # value fetch — block_until_ready is unreliable
+    reps = 3          # over this sandbox's relay
+    t0 = time.perf_counter()
+    for i in range(reps):
+        out = net.generate(prompt, N, seed=i)
+        onp.asarray(out[:, -1])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"TransformerLM {L}L/{D}D V={V} bf16, B={B} P={P} N={N}: "
+          f"{dt*1e3:.1f} ms/gen = {B*N/dt:.0f} decoded tok/s "
+          f"({dt/N*1e3:.2f} ms/token-step, batch {B})")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,7 +4,7 @@ shaped), Transformer WMT, ArcFace margin-softmax.  Vision zoo lives in
 
 
 def __getattr__(name):
-    if name in ("bert", "transformer", "arcface"):
+    if name in ("bert", "transformer", "arcface", "generation"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)

@@ -1,0 +1,237 @@
+"""Autoregressive KV-cache generation for `models.TransformerLM`.
+
+The reference ecosystem shipped decode tooling (GluonNLP
+`BeamSearchSampler` / `SequenceSampler` era [UNVERIFIED — mount
+empty]); this is its TPU-native counterpart: the ENTIRE generation —
+prompt prefill + N decode steps — compiles into ONE XLA program.
+
+TPU-first structure:
+- Static shapes everywhere: the KV cache is preallocated at
+  (B, H, P+N, D) per layer and decode attends over the full cache
+  width with an iota mask `pos <= t` — no dynamic shapes to defeat
+  XLA's tiling.
+- The token loop is `lax.scan` (compiled once, no per-token dispatch —
+  on a relay-attached chip a Python decode loop would pay ~3.5 ms of
+  dispatch per token).
+- Sampling is counter-based (`fold_in(key, t)`), so the program stays
+  key-parametric and a seeded run reproduces exactly.
+- Weights enter the program as ARGUMENTS (a pytree gathered from the
+  live Block parameters at call time — the same arrays training
+  updates), so repeated calls with updated weights reuse the compiled
+  program; it is cached per (shapes, sampling-config) signature.
+
+Numerics mirror the model's XLA attention path (scores and softmax in
+fp32, output cast back to the activation dtype), so greedy decode
+agrees with the training forward's argmax — pinned by parity tests
+prefix-by-prefix (`tests/test_generation.py`).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lm_generate"]
+
+
+def _dense(x, w, b):
+    """nn.Dense math on raw arrays: x @ W.T + b (weight is (out, in))."""
+    y = x @ w.T.astype(x.dtype)
+    return y if b is None else y + b.astype(x.dtype)
+
+
+def _ln(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)
+            * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _qkv_heads(qkv, H):
+    """(..., 3C) -> three (..., H, D) tensors, the MHA split order."""
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    D = q.shape[-1] // H
+    shp = q.shape[:-1] + (H, D)
+    return q.reshape(shp), k.reshape(shp), v.reshape(shp)
+
+
+def _gather_params(net):
+    """The weight pytree the compiled program consumes — the live raw
+    arrays of the Block's parameters, in a fixed structure."""
+    def d(layer):
+        return (layer.weight.data()._data,
+                None if layer.bias is None else layer.bias.data()._data)
+
+    layers = []
+    for lyr in net._layers:
+        layers.append({
+            "ln1": (lyr.ln1.gamma.data()._data, lyr.ln1.beta.data()._data),
+            "qkv": d(lyr.attn.qkv),
+            "proj": d(lyr.attn.proj),
+            "ln2": (lyr.ln2.gamma.data()._data, lyr.ln2.beta.data()._data),
+            "ffn1": d(lyr.ffn.ffn_dense1),
+            "ffn2": d(lyr.ffn.ffn_dense2),
+        })
+    return {
+        "embed": net.embed.weight.data()._data,
+        "pe": net._pe,
+        "ln": (net.ln.gamma.data()._data, net.ln.beta.data()._data),
+        "head": d(net.head),
+        "layers": layers,
+    }
+
+
+def _build_program(B, P, N, H, C, temperature, top_k, eos_id, acts):
+    """The (jittable) prefill+scan generation program for one static
+    signature.  `params` is `_gather_params`' pytree; `key` a PRNG key;
+    `acts` the per-layer FFN activation names (static)."""
+    D = C // H
+
+    def ffn_fwd(x, lp, act):
+        h = _dense(x, *lp["ffn1"])
+        h = jax.nn.gelu(h.astype(jnp.float32),
+                        approximate=True).astype(x.dtype) \
+            if act == "gelu" else jax.nn.relu(h)
+        return _dense(h, *lp["ffn2"])
+
+    def pick(logits, t, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits / jnp.float32(temperature)
+        if top_k > 0:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
+        return jax.random.categorical(
+            jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
+
+    def run(params, prompt, key):
+        dt = params["embed"].dtype
+        pe = params["pe"]
+
+        def logits_of(h_last):
+            return _dense(_ln(h_last, *params["ln"]),
+                          *params["head"]).astype(jnp.float32)
+
+        # ---- prefill: full-width causal attention over the prompt ----
+        h = params["embed"][prompt].astype(dt) * math.sqrt(C) \
+            + pe[:P].astype(dt)
+        kcs, vcs = [], []
+        for lp, act in zip(params["layers"], acts):
+            from ..ops.flash_attention import flash_attention
+
+            x = _ln(h, *lp["ln1"])
+            q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B, P, H, D)
+            kt = k.transpose(0, 2, 1, 3)  # (B, H, P, D) — cache layout
+            vt = v.transpose(0, 2, 1, 3)
+            # THE training path's causal attention (flash/XLA dispatch,
+            # fp32 softmax) — one kernel, one set of numerics for the
+            # greedy-parity contract, no (B, H, P, P) materialization
+            a = flash_attention(q.transpose(0, 2, 1, 3), kt, vt,
+                                causal=True).transpose(0, 2, 1, 3)
+            h = h + _dense(a.astype(dt).reshape(B, P, C), *lp["proj"])
+            h = h + ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
+            pad = ((0, 0), (0, 0), (0, N), (0, 0))
+            kcs.append(jnp.pad(kt, pad))
+            vcs.append(jnp.pad(vt, pad))
+        first = pick(logits_of(h[:, -1]), P - 1, key)
+
+        # ---- decode: one token per scan step, attending to the cache.
+        # Caches ride the carry as PER-LAYER tuples: each layer's
+        # dynamic_update_slice aliases its own buffer in place — a
+        # stacked (L, ...) cache would force a full-cache copy per step
+        # (measured 17.9 ms/token-step at B=64 before this)
+        def step(carry, t):
+            kcaches, vcaches, tok, done = carry
+            h = (params["embed"][tok].astype(dt) * math.sqrt(C)
+                 + jax.lax.dynamic_index_in_dim(pe, t,
+                                                keepdims=False).astype(dt))
+            new_k, new_v = [], []
+            for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+                x = _ln(h, *lp["ln1"])
+                q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B, H, D)
+                kc = jax.lax.dynamic_update_slice_in_dim(
+                    kcaches[li], k[:, :, None], t, axis=2)
+                vc = jax.lax.dynamic_update_slice_in_dim(
+                    vcaches[li], v[:, :, None], t, axis=2)
+                s = jnp.einsum("bhd,bhkd->bhk", q, kc,
+                               preferred_element_type=jnp.float32) \
+                    / math.sqrt(D)
+                pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+                s = jnp.where(pos <= t, s, jnp.finfo(jnp.float32).min)
+                # p stays fp32 through the PV product (the training
+                # path's softmax precision); the einsum upconverts vc
+                # lazily, no materialized fp32 cache copy
+                p = jax.nn.softmax(s, axis=-1)
+                a = jnp.einsum("bhk,bhkd->bhd", p, vc,
+                               preferred_element_type=jnp.float32).astype(dt)
+                h = h + _dense(a.reshape(B, C), *lp["proj"])
+                h = h + ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
+                new_k.append(kc)
+                new_v.append(vc)
+            nxt = pick(logits_of(h), t, key)
+            if eos_id >= 0:
+                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                done = done | (nxt == eos_id)
+            return (tuple(new_k), tuple(new_v), nxt, done), tok
+
+        done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((B,), bool)
+        if N > 1:
+            (_, _, last, _), toks = jax.lax.scan(
+                step, (tuple(kcs), tuple(vcs), first, done0),
+                jnp.arange(P, P + N - 1, dtype=jnp.int32))
+            gen = jnp.concatenate([toks.T, last[:, None]], axis=1)  # (B, N)
+        else:
+            gen = first[:, None]
+        return jnp.concatenate([prompt, gen], axis=1)
+
+    return run
+
+
+def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
+                top_k: int = 0, eos_id: int = -1, seed: int = 0):
+    """Generate `max_new_tokens` continuations of `prompt` with
+    `models.TransformerLM` `net` (initialized; generation runs in eval
+    mode — dropout off).
+
+    prompt: int32 (B, P) array/NDArray.  temperature=0 → greedy argmax;
+    temperature>0 samples (optionally top_k-truncated) with a
+    counter-based key from `seed`.  eos_id >= 0 freezes a sequence at
+    eos (further positions emit eos_id).  Returns an int32 (B, P+N)
+    jnp array — the prompt followed by the generated tokens.
+
+    The compiled program is cached on the net per
+    (B, P, N, temperature, top_k, eos_id) signature; weights are
+    arguments, so training between calls does not recompile.
+
+    ref: GluonNLP SequenceSampler/BeamSearchSampler role `[UNVERIFIED]`
+    re-designed as a single compiled prefill+scan program (SURVEY.md
+    §2.6 frontier; see module docstring).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(prompt, NDArray):
+        prompt = prompt._data
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    N = int(max_new_tokens)
+    if N < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {N}")
+    if P + N > net._max_len:
+        raise ValueError(
+            f"prompt+new = {P + N} exceeds max_len {net._max_len}")
+    H = net._layers[0].attn._num_heads
+    C = net._units
+
+    sig = (B, P, N, float(temperature), int(top_k), int(eos_id))
+    cache = getattr(net, "_gen_programs", None)
+    if cache is None:
+        cache = net._gen_programs = {}
+    fn = cache.get(sig)
+    if fn is None:
+        acts = tuple(lyr.ffn._act for lyr in net._layers)
+        run = _build_program(B, P, N, H, C, float(temperature), int(top_k),
+                             int(eos_id), acts)
+        fn = cache[sig] = jax.jit(run)
+    return fn(_gather_params(net), prompt, jax.random.PRNGKey(seed))

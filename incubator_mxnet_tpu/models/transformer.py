@@ -220,6 +220,14 @@ class TransformerLM(HybridBlock):
             h = l(h)
         return self.head(self.ln(h))
 
+    def generate(self, prompt, max_new_tokens, **kw):
+        """KV-cache autoregressive decode — one compiled prefill+scan
+        program; see `models.generation.lm_generate` for options
+        (temperature / top_k / eos_id / seed)."""
+        from .generation import lm_generate
+
+        return lm_generate(self, prompt, max_new_tokens, **kw)
+
 
 class Transformer(HybridBlock):
     def __init__(self, src_vocab=32000, tgt_vocab=32000, units=512,
