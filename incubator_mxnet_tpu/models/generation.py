@@ -32,7 +32,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["lm_generate"]
+__all__ = ["lm_generate", "lm_beam_search"]
 
 
 def _dense(x, w, b):
@@ -83,18 +83,88 @@ def _gather_params(net):
     }
 
 
-def _build_program(B, P, N, H, C, temperature, top_k, eos_id, acts):
+def _ffn_fwd(x, lp, act):
+    h = _dense(x, *lp["ffn1"])
+    h = jax.nn.gelu(h.astype(jnp.float32),
+                    approximate=True).astype(x.dtype) \
+        if act == "gelu" else jax.nn.relu(h)
+    return _dense(h, *lp["ffn2"])
+
+
+def _logits_of(params, h_last):
+    return _dense(_ln(h_last, *params["ln"]),
+                  *params["head"]).astype(jnp.float32)
+
+
+def _prefill(params, prompt, acts, H, pad_to):
+    """Run the prompt through the model with the TRAINING path's causal
+    attention; returns (h_last (B, C) activations at the final prompt
+    position, per-layer K/V caches (B, H, pad_to, D))."""
+    from ..ops.flash_attention import flash_attention
+
+    dt = params["embed"].dtype
+    B, P = prompt.shape
+    C = params["embed"].shape[1]
+    h = params["embed"][prompt].astype(dt) * math.sqrt(C) \
+        + params["pe"][:P].astype(dt)
+    kcs, vcs = [], []
+    for lp, act in zip(params["layers"], acts):
+        x = _ln(h, *lp["ln1"])
+        q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B, P, H, D)
+        kt = k.transpose(0, 2, 1, 3)  # (B, H, P, D) — cache layout
+        vt = v.transpose(0, 2, 1, 3)
+        # THE training path's causal attention (flash/XLA dispatch, fp32
+        # softmax) — one kernel, one set of numerics for the
+        # greedy-parity contract, no (B, H, P, P) materialization
+        a = flash_attention(q.transpose(0, 2, 1, 3), kt, vt,
+                            causal=True).transpose(0, 2, 1, 3)
+        h = h + _dense(a.astype(dt).reshape(B, P, C), *lp["proj"])
+        h = h + _ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
+        pad = ((0, 0), (0, 0), (0, pad_to - P), (0, 0))
+        kcs.append(jnp.pad(kt, pad))
+        vcs.append(jnp.pad(vt, pad))
+    return h[:, -1], kcs, vcs
+
+
+def _decode_token(params, acts, kcaches, vcaches, tok, t, H):
+    """One transformer step for token `tok` at position `t` against the
+    caches (per-layer (B', H, W, D)); returns (new_k, new_v, logits).
+    fp32 scores and softmax through the PV product (the training path's
+    precision); the einsums upconvert the bf16 caches lazily — no
+    materialized fp32 cache copies."""
+    dt = params["embed"].dtype
+    Bp = tok.shape[0]
+    C = params["embed"].shape[1]
+    D = C // H
+    h = (params["embed"][tok].astype(dt) * math.sqrt(C)
+         + jax.lax.dynamic_index_in_dim(params["pe"], t,
+                                        keepdims=False).astype(dt))
+    new_k, new_v = [], []
+    for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+        x = _ln(h, *lp["ln1"])
+        q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B', H, D)
+        kc = jax.lax.dynamic_update_slice_in_dim(
+            kcaches[li], k[:, :, None], t, axis=2)
+        vc = jax.lax.dynamic_update_slice_in_dim(
+            vcaches[li], v[:, :, None], t, axis=2)
+        s = jnp.einsum("bhd,bhkd->bhk", q, kc,
+                       preferred_element_type=jnp.float32) / math.sqrt(D)
+        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos <= t, s, jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhk,bhkd->bhd", p, vc,
+                       preferred_element_type=jnp.float32).astype(dt)
+        h = h + _dense(a.reshape(Bp, C), *lp["proj"])
+        h = h + _ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
+        new_k.append(kc)
+        new_v.append(vc)
+    return tuple(new_k), tuple(new_v), _logits_of(params, h)
+
+
+def _build_program(B, P, N, H, temperature, top_k, eos_id, acts):
     """The (jittable) prefill+scan generation program for one static
     signature.  `params` is `_gather_params`' pytree; `key` a PRNG key;
     `acts` the per-layer FFN activation names (static)."""
-    D = C // H
-
-    def ffn_fwd(x, lp, act):
-        h = _dense(x, *lp["ffn1"])
-        h = jax.nn.gelu(h.astype(jnp.float32),
-                        approximate=True).astype(x.dtype) \
-            if act == "gelu" else jax.nn.relu(h)
-        return _dense(h, *lp["ffn2"])
 
     def pick(logits, t, key):
         if temperature <= 0.0:
@@ -107,35 +177,9 @@ def _build_program(B, P, N, H, C, temperature, top_k, eos_id, acts):
             jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
 
     def run(params, prompt, key):
-        dt = params["embed"].dtype
-        pe = params["pe"]
-
-        def logits_of(h_last):
-            return _dense(_ln(h_last, *params["ln"]),
-                          *params["head"]).astype(jnp.float32)
-
         # ---- prefill: full-width causal attention over the prompt ----
-        h = params["embed"][prompt].astype(dt) * math.sqrt(C) \
-            + pe[:P].astype(dt)
-        kcs, vcs = [], []
-        for lp, act in zip(params["layers"], acts):
-            from ..ops.flash_attention import flash_attention
-
-            x = _ln(h, *lp["ln1"])
-            q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B, P, H, D)
-            kt = k.transpose(0, 2, 1, 3)  # (B, H, P, D) — cache layout
-            vt = v.transpose(0, 2, 1, 3)
-            # THE training path's causal attention (flash/XLA dispatch,
-            # fp32 softmax) — one kernel, one set of numerics for the
-            # greedy-parity contract, no (B, H, P, P) materialization
-            a = flash_attention(q.transpose(0, 2, 1, 3), kt, vt,
-                                causal=True).transpose(0, 2, 1, 3)
-            h = h + _dense(a.astype(dt).reshape(B, P, C), *lp["proj"])
-            h = h + ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
-            pad = ((0, 0), (0, 0), (0, N), (0, 0))
-            kcs.append(jnp.pad(kt, pad))
-            vcs.append(jnp.pad(vt, pad))
-        first = pick(logits_of(h[:, -1]), P - 1, key)
+        h_last, kcs, vcs = _prefill(params, prompt, acts, H, P + N)
+        first = pick(_logits_of(params, h_last), P - 1, key)
 
         # ---- decode: one token per scan step, attending to the cache.
         # Caches ride the carry as PER-LAYER tuples: each layer's
@@ -144,37 +188,13 @@ def _build_program(B, P, N, H, C, temperature, top_k, eos_id, acts):
         # (measured 17.9 ms/token-step at B=64 before this)
         def step(carry, t):
             kcaches, vcaches, tok, done = carry
-            h = (params["embed"][tok].astype(dt) * math.sqrt(C)
-                 + jax.lax.dynamic_index_in_dim(pe, t,
-                                                keepdims=False).astype(dt))
-            new_k, new_v = [], []
-            for li, (lp, act) in enumerate(zip(params["layers"], acts)):
-                x = _ln(h, *lp["ln1"])
-                q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B, H, D)
-                kc = jax.lax.dynamic_update_slice_in_dim(
-                    kcaches[li], k[:, :, None], t, axis=2)
-                vc = jax.lax.dynamic_update_slice_in_dim(
-                    vcaches[li], v[:, :, None], t, axis=2)
-                s = jnp.einsum("bhd,bhkd->bhk", q, kc,
-                               preferred_element_type=jnp.float32) \
-                    / math.sqrt(D)
-                pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-                s = jnp.where(pos <= t, s, jnp.finfo(jnp.float32).min)
-                # p stays fp32 through the PV product (the training
-                # path's softmax precision); the einsum upconverts vc
-                # lazily, no materialized fp32 cache copy
-                p = jax.nn.softmax(s, axis=-1)
-                a = jnp.einsum("bhk,bhkd->bhd", p, vc,
-                               preferred_element_type=jnp.float32).astype(dt)
-                h = h + _dense(a.reshape(B, C), *lp["proj"])
-                h = h + ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
-                new_k.append(kc)
-                new_v.append(vc)
-            nxt = pick(logits_of(h), t, key)
+            new_k, new_v, logits = _decode_token(params, acts, kcaches,
+                                                 vcaches, tok, t, H)
+            nxt = pick(logits, t, key)
             if eos_id >= 0:
                 nxt = jnp.where(done, jnp.int32(eos_id), nxt)
                 done = done | (nxt == eos_id)
-            return (tuple(new_k), tuple(new_v), nxt, done), tok
+            return (new_k, new_v, nxt, done), tok
 
         done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((B,), bool)
         if N > 1:
@@ -222,7 +242,6 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
         raise ValueError(
             f"prompt+new = {P + N} exceeds max_len {net._max_len}")
     H = net._layers[0].attn._num_heads
-    C = net._units
 
     sig = (B, P, N, float(temperature), int(top_k), int(eos_id))
     cache = getattr(net, "_gen_programs", None)
@@ -231,7 +250,144 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
     fn = cache.get(sig)
     if fn is None:
         acts = tuple(lyr.ffn._act for lyr in net._layers)
-        run = _build_program(B, P, N, H, C, float(temperature), int(top_k),
+        run = _build_program(B, P, N, H, float(temperature), int(top_k),
                              int(eos_id), acts)
         fn = cache[sig] = jax.jit(run)
     return fn(_gather_params(net), prompt, jax.random.PRNGKey(seed))
+
+
+# --------------------------------------------------------------------- #
+# beam search
+# --------------------------------------------------------------------- #
+_NEG = jnp.float32(-1e9)
+
+
+def _build_beam_program(B, P, N, K, H, eos_id, alpha, acts):
+    """Beam-search decode for one static signature: standard K-beam
+    expansion over K·V candidates per step, per-layer caches reordered
+    by beam parent each step, sequences reconstructed by a REVERSE scan
+    over the (token, parent) trace — everything one compiled program."""
+
+    def run(params, prompt):
+        h_last, kcs, vcs = _prefill(params, prompt, acts, H, P + N)
+        logp0 = jax.nn.log_softmax(_logits_of(params, h_last))  # (B, V)
+        V = logp0.shape[-1]
+        scores0, tok0 = jax.lax.top_k(logp0, K)                 # (B, K)
+        tok0 = tok0.astype(jnp.int32)
+        # beams live as (B*K, ...): tile the prompt caches K-fold
+        kcs = tuple(jnp.repeat(c, K, axis=0) for c in kcs)
+        vcs = tuple(jnp.repeat(c, K, axis=0) for c in vcs)
+        done0 = (tok0 == eos_id) if eos_id >= 0 \
+            else jnp.zeros((B, K), bool)
+        lens0 = jnp.ones((B, K), jnp.int32)  # generated tokens so far
+
+        def step(carry, t):
+            kc, vc, scores, tok, done, lens = carry
+            new_k, new_v, logits = _decode_token(
+                params, acts, kc, vc, tok.reshape(B * K), t, H)
+            logp = jax.nn.log_softmax(logits).reshape(B, K, V)
+            if eos_id >= 0:
+                # a finished beam may only extend with eos, at no cost —
+                # its score and length freeze
+                frozen = jnp.full((V,), _NEG).at[eos_id].set(0.0)
+                logp = jnp.where(done[..., None], frozen, logp)
+            cand = scores[..., None] + logp              # (B, K, V)
+            new_scores, idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+            parent = idx // V                            # (B, K)
+            nxt = (idx % V).astype(jnp.int32)
+            gidx = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
+            new_k = tuple(c[gidx] for c in new_k)
+            new_v = tuple(c[gidx] for c in new_v)
+            pdone = jnp.take_along_axis(done, parent, axis=1)
+            plens = jnp.take_along_axis(lens, parent, axis=1)
+            if eos_id >= 0:
+                ndone = pdone | (nxt == eos_id)
+                nlens = jnp.where(pdone, plens, plens + 1)
+            else:
+                ndone, nlens = pdone, plens + 1
+            return (new_k, new_v, new_scores, nxt, ndone, nlens), \
+                (nxt, parent)
+
+        if N > 1:
+            carry0 = (kcs, vcs, scores0, tok0, done0, lens0)
+            (_, _, scores, _, _, lens), (toks, parents) = jax.lax.scan(
+                step, carry0, jnp.arange(P, P + N - 1, dtype=jnp.int32))
+
+            # ---- backtrack: walk the parent pointers from the final
+            # beams to the first expansion (reverse scan; ys stay
+            # position-aligned) ----
+            def back(ptr, xs):
+                tk, par = xs
+                tok_t = jnp.take_along_axis(tk, ptr, axis=1)
+                return jnp.take_along_axis(par, ptr, axis=1), tok_t
+
+            init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+            ptr0, rest = jax.lax.scan(back, init, (toks, parents),
+                                      reverse=True)
+            first_tok = jnp.take_along_axis(tok0, ptr0, axis=1)
+            gen = jnp.concatenate([first_tok[None], rest], axis=0)
+            gen = gen.transpose(1, 2, 0)                 # (B, K, N)
+        else:
+            scores, lens, gen = scores0, lens0, tok0[..., None]
+
+        # GNMT length penalty: rank by score / ((5+len)/6)^alpha
+        if alpha > 0.0:
+            norm = scores / (((5.0 + lens.astype(jnp.float32)) / 6.0)
+                             ** alpha)
+        else:
+            norm = scores
+        order = jnp.argsort(-norm, axis=1)
+        gen = jnp.take_along_axis(gen, order[..., None], axis=1)
+        norm = jnp.take_along_axis(norm, order, axis=1)
+        seqs = jnp.concatenate(
+            [jnp.broadcast_to(prompt[:, None], (B, K, P)), gen], axis=2)
+        return seqs, norm
+
+    return run
+
+
+def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
+                   eos_id: int = -1, alpha: float = 0.0):
+    """K-beam search decode for `models.TransformerLM` — the
+    TPU-native counterpart of the reference era's BeamSearchSampler
+    (GluonNLP `[UNVERIFIED — mount empty]`): prefill + the whole beam
+    loop (expansion, cache reordering, backtracking) compile into ONE
+    XLA program, cached per signature like `lm_generate`.
+
+    prompt: int32 (B, P).  Returns (sequences, scores): int32
+    (B, beam_size, P+N) sorted best-first, and f32 (B, beam_size)
+    cumulative log-probabilities (GNMT length-penalty-normalized when
+    ``alpha > 0``; eos_id >= 0 freezes finished beams' scores and
+    lengths).  beam_size=1 reproduces greedy `lm_generate` exactly.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(prompt, NDArray):
+        prompt = prompt._data
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, P = prompt.shape
+    N = int(max_new_tokens)
+    K = int(beam_size)
+    if N < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {N}")
+    if K < 1:
+        raise ValueError(f"beam_size must be >= 1, got {K}")
+    V = net.head._units
+    if K > V:
+        raise ValueError(f"beam_size {K} exceeds vocab {V}")
+    if P + N > net._max_len:
+        raise ValueError(
+            f"prompt+new = {P + N} exceeds max_len {net._max_len}")
+    H = net._layers[0].attn._num_heads
+
+    sig = ("beam", B, P, N, K, int(eos_id), float(alpha))
+    cache = getattr(net, "_gen_programs", None)
+    if cache is None:
+        cache = net._gen_programs = {}
+    fn = cache.get(sig)
+    if fn is None:
+        acts = tuple(lyr.ffn._act for lyr in net._layers)
+        run = _build_beam_program(B, P, N, K, H, int(eos_id),
+                                  float(alpha), acts)
+        fn = cache[sig] = jax.jit(run)
+    return fn(_gather_params(net), prompt)

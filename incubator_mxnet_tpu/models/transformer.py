@@ -228,6 +228,14 @@ class TransformerLM(HybridBlock):
 
         return lm_generate(self, prompt, max_new_tokens, **kw)
 
+    def beam_search(self, prompt, max_new_tokens, **kw):
+        """K-beam decode → (sequences (B, K, P+N), scores (B, K)),
+        best-first; see `models.generation.lm_beam_search` (beam_size /
+        eos_id / GNMT length-penalty alpha)."""
+        from .generation import lm_beam_search
+
+        return lm_beam_search(self, prompt, max_new_tokens, **kw)
+
 
 class Transformer(HybridBlock):
     def __init__(self, src_vocab=32000, tgt_vocab=32000, units=512,

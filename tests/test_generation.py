@@ -106,3 +106,94 @@ def test_max_new_tokens_validated():
         net.generate(onp.zeros((1, 3), "int32"), 0)
     with pytest.raises(ValueError):
         net.generate(onp.zeros((1, 3), "int32"), -2)
+
+
+# ------------------------------------------------------------------ #
+# beam search
+# ------------------------------------------------------------------ #
+def _seq_logprob(net, seq, P):
+    """Cumulative log-prob of seq[P:] under the full teacher-forced
+    forward (the training path) — the oracle for beam scores."""
+    logits = net(NDArray(jnp.asarray(seq[None]))).asnumpy()
+    logp = onp.asarray(jax.nn.log_softmax(jnp.asarray(logits[0]), -1))
+    return float(sum(logp[t - 1, seq[t]] for t in range(P, len(seq))))
+
+
+def test_beam1_equals_greedy():
+    net = _net()
+    prompt = onp.array([[5, 9, 2]], "int32")
+    seqs, scores = net.beam_search(prompt, 6, beam_size=1)
+    greedy = onp.asarray(net.generate(prompt, 6))
+    onp.testing.assert_array_equal(onp.asarray(seqs[:, 0]), greedy)
+    assert scores.shape == (1, 1)
+
+
+def test_beam_finds_global_best_exhaustive():
+    """K = V, N = 2: the beam's K*V candidates at the second step COVER
+    the whole length-2 continuation space, so its top-1 must be the
+    global argmax — verified by brute force over all V^2 continuations
+    with the training forward as oracle."""
+    prompt = onp.array([[3, 7]], "int32")
+    small_V = 9  # tiny vocab so beam_size == V is cheap
+    mx.random.seed(1)
+    tiny = TransformerLM(vocab=small_V, units=16, hidden_size=32,
+                         num_layers=1, num_heads=2, max_len=16,
+                         dropout=0.0)
+    tiny.initialize()
+    tiny(NDArray(jnp.ones((1, 2), jnp.int32)))
+    seqs, scores = tiny.beam_search(prompt, 2, beam_size=small_V)
+
+    best, best_lp = None, -1e30
+    for a in range(small_V):
+        for b in range(small_V):
+            seq = onp.array([3, 7, a, b], "int32")
+            lp = _seq_logprob(tiny, seq, 2)
+            if lp > best_lp:
+                best, best_lp = seq, lp
+    onp.testing.assert_array_equal(onp.asarray(seqs[0, 0]), best)
+    assert abs(float(scores[0, 0]) - best_lp) < 1e-4
+
+
+def test_beam_scores_sorted_and_match_oracle():
+    net = _net()
+    prompt = onp.array([[1, 2, 3, 4]], "int32")
+    K, N = 4, 5
+    seqs, scores = net.beam_search(prompt, N, beam_size=K)
+    assert seqs.shape == (1, K, 4 + N) and scores.shape == (1, K)
+    s = onp.asarray(scores[0])
+    assert (s[:-1] >= s[1:] - 1e-6).all(), "beams not sorted best-first"
+    # every beam's reported score is the true cumulative log-prob of
+    # its sequence under the training forward
+    for j in range(K):
+        lp = _seq_logprob(net, onp.asarray(seqs[0, j]), 4)
+        assert abs(lp - float(s[j])) < 1e-3, (j, lp, float(s[j]))
+    # prompt preserved on every beam
+    onp.testing.assert_array_equal(
+        onp.asarray(seqs[0, :, :4]), onp.tile(prompt, (K, 1)))
+
+
+def test_beam_eos_freezing_and_length_penalty():
+    net = _net()
+    prompt = onp.array([[2, 4, 6]], "int32")
+    # pick eos = the greedy first token so the top beam finishes at once
+    eos = int(onp.asarray(net.generate(prompt, 1))[0, -1])
+    seqs, scores = net.beam_search(prompt, 5, beam_size=3, eos_id=eos)
+    row = onp.asarray(seqs[0])
+    for j in range(3):
+        gen = row[j, 3:]
+        hits = onp.where(gen == eos)[0]
+        if hits.size:  # after first eos, everything is eos
+            assert (gen[hits[0]:] == eos).all()
+    # alpha only reorders/normalizes — shapes and sortedness hold
+    seqs2, scores2 = net.beam_search(prompt, 5, beam_size=3, eos_id=eos,
+                                     alpha=1.0)
+    s2 = onp.asarray(scores2[0])
+    assert (s2[:-1] >= s2[1:] - 1e-6).all()
+
+
+def test_beam_validation():
+    net = _net()
+    with pytest.raises(ValueError):
+        net.beam_search(onp.zeros((1, 3), "int32"), 4, beam_size=0)
+    with pytest.raises(ValueError):
+        net.beam_search(onp.zeros((1, 3), "int32"), 4, beam_size=V + 1)
