@@ -32,7 +32,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["lm_generate", "lm_beam_search"]
+__all__ = ["lm_generate", "lm_beam_search", "nmt_translate"]
 
 
 def _dense(x, w, b):
@@ -57,13 +57,16 @@ def _qkv_heads(qkv, H):
     return q.reshape(shp), k.reshape(shp), v.reshape(shp)
 
 
+def _wb(layer):
+    """(weight, bias-or-None) raw arrays of an nn.Dense layer."""
+    return (layer.weight.data()._data,
+            None if layer.bias is None else layer.bias.data()._data)
+
+
 def _gather_params(net):
     """The weight pytree the compiled program consumes — the live raw
     arrays of the Block's parameters, in a fixed structure."""
-    def d(layer):
-        return (layer.weight.data()._data,
-                None if layer.bias is None else layer.bias.data()._data)
-
+    d = _wb
     layers = []
     for lyr in net._layers:
         layers.append({
@@ -126,6 +129,33 @@ def _prefill(params, prompt, acts, H, pad_to):
     return h[:, -1], kcs, vcs
 
 
+def _cached_self_attn(lp, h, kcache, vcache, t, H):
+    """The cached one-token self-attention sub-step shared by the LM
+    and NMT decoders: pre-LN, qkv, cache write at position t, fp32
+    iota-masked scores/softmax, PV product, output projection —
+    returns (h + attn_out, new_kcache, new_vcache).  ONE definition so
+    the numerics-sensitive step can never fork between families."""
+    Bp, C = h.shape
+    D = C // H
+    dt = h.dtype
+    x = _ln(h, *lp["ln1"])
+    q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B', H, D)
+    kc = jax.lax.dynamic_update_slice_in_dim(
+        kcache, k[:, :, None], t, axis=2)
+    vc = jax.lax.dynamic_update_slice_in_dim(
+        vcache, v[:, :, None], t, axis=2)
+    s = jnp.einsum("bhd,bhkd->bhk", q, kc,
+                   preferred_element_type=jnp.float32) / math.sqrt(D)
+    pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    s = jnp.where(pos <= t, s, jnp.finfo(jnp.float32).min)
+    # p stays fp32 through the PV product (the training path's softmax
+    # precision); the einsums upconvert the bf16 caches lazily
+    p = jax.nn.softmax(s, axis=-1)
+    a = jnp.einsum("bhk,bhkd->bhd", p, vc,
+                   preferred_element_type=jnp.float32).astype(dt)
+    return h + _dense(a.reshape(Bp, C), *lp["proj"]), kc, vc
+
+
 def _decode_token(params, acts, kcaches, vcaches, tok, t, H):
     """One transformer step for token `tok` at position `t` against the
     caches (per-layer (B', H, W, D)); returns (new_k, new_v, logits).
@@ -133,39 +163,21 @@ def _decode_token(params, acts, kcaches, vcaches, tok, t, H):
     precision); the einsums upconvert the bf16 caches lazily — no
     materialized fp32 cache copies."""
     dt = params["embed"].dtype
-    Bp = tok.shape[0]
     C = params["embed"].shape[1]
-    D = C // H
     h = (params["embed"][tok].astype(dt) * math.sqrt(C)
          + jax.lax.dynamic_index_in_dim(params["pe"], t,
                                         keepdims=False).astype(dt))
     new_k, new_v = [], []
     for li, (lp, act) in enumerate(zip(params["layers"], acts)):
-        x = _ln(h, *lp["ln1"])
-        q, k, v = _qkv_heads(_dense(x, *lp["qkv"]), H)  # (B', H, D)
-        kc = jax.lax.dynamic_update_slice_in_dim(
-            kcaches[li], k[:, :, None], t, axis=2)
-        vc = jax.lax.dynamic_update_slice_in_dim(
-            vcaches[li], v[:, :, None], t, axis=2)
-        s = jnp.einsum("bhd,bhkd->bhk", q, kc,
-                       preferred_element_type=jnp.float32) / math.sqrt(D)
-        pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where(pos <= t, s, jnp.finfo(jnp.float32).min)
-        p = jax.nn.softmax(s, axis=-1)
-        a = jnp.einsum("bhk,bhkd->bhd", p, vc,
-                       preferred_element_type=jnp.float32).astype(dt)
-        h = h + _dense(a.reshape(Bp, C), *lp["proj"])
+        h, kc, vc = _cached_self_attn(lp, h, kcaches[li], vcaches[li],
+                                      t, H)
         h = h + _ffn_fwd(_ln(h, *lp["ln2"]), lp, act)
         new_k.append(kc)
         new_v.append(vc)
     return tuple(new_k), tuple(new_v), _logits_of(params, h)
 
 
-def _build_program(B, P, N, H, temperature, top_k, eos_id, acts):
-    """The (jittable) prefill+scan generation program for one static
-    signature.  `params` is `_gather_params`' pytree; `key` a PRNG key;
-    `acts` the per-layer FFN activation names (static)."""
-
+def _make_pick(temperature, top_k):
     def pick(logits, t, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -176,34 +188,55 @@ def _build_program(B, P, N, H, temperature, top_k, eos_id, acts):
         return jax.random.categorical(
             jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
 
+    return pick
+
+
+def _greedy_loop(first_logits, state0, step_fn, pick, key, t0, N, B,
+                 eos_id):
+    """Generic greedy/sampling token loop: emit N tokens at positions
+    t0..t0+N-1, the first from `first_logits`, the rest by scanning
+    `step_fn(state, tok, t) -> (state, logits)`.  The decode state is
+    an arbitrary pytree riding the scan carry (per-layer cache tuples:
+    each dynamic_update_slice aliases its buffer in place — a stacked
+    cache copied itself every step, 17.9 -> 11.8 ms/token-step at
+    B=64).  Returns (B, N) int32."""
+    first = pick(first_logits, t0 - 1, key)
+
+    def step(carry, t):
+        state, tok, done = carry
+        state, logits = step_fn(state, tok, t)
+        nxt = pick(logits, t, key)
+        if eos_id >= 0:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        return (state, nxt, done), tok
+
+    done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((B,), bool)
+    if N == 1:
+        return first[:, None]
+    (_, last, _), toks = jax.lax.scan(
+        step, (state0, first, done0),
+        jnp.arange(t0, t0 + N - 1, dtype=jnp.int32))
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+def _build_program(B, P, N, H, temperature, top_k, eos_id, acts):
+    """The (jittable) prefill+scan generation program for one static
+    signature.  `params` is `_gather_params`' pytree; `key` a PRNG key;
+    `acts` the per-layer FFN activation names (static)."""
+    pick = _make_pick(temperature, top_k)
+
     def run(params, prompt, key):
-        # ---- prefill: full-width causal attention over the prompt ----
         h_last, kcs, vcs = _prefill(params, prompt, acts, H, P + N)
-        first = pick(_logits_of(params, h_last), P - 1, key)
 
-        # ---- decode: one token per scan step, attending to the cache.
-        # Caches ride the carry as PER-LAYER tuples: each layer's
-        # dynamic_update_slice aliases its own buffer in place — a
-        # stacked (L, ...) cache would force a full-cache copy per step
-        # (measured 17.9 ms/token-step at B=64 before this)
-        def step(carry, t):
-            kcaches, vcaches, tok, done = carry
-            new_k, new_v, logits = _decode_token(params, acts, kcaches,
-                                                 vcaches, tok, t, H)
-            nxt = pick(logits, t, key)
-            if eos_id >= 0:
-                nxt = jnp.where(done, jnp.int32(eos_id), nxt)
-                done = done | (nxt == eos_id)
-            return (new_k, new_v, nxt, done), tok
+        def step_fn(state, tok, t):
+            new_k, new_v, logits = _decode_token(params, acts, state[0],
+                                                 state[1], tok, t, H)
+            return (new_k, new_v), logits
 
-        done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((B,), bool)
-        if N > 1:
-            (_, _, last, _), toks = jax.lax.scan(
-                step, (tuple(kcs), tuple(vcs), first, done0),
-                jnp.arange(P, P + N - 1, dtype=jnp.int32))
-            gen = jnp.concatenate([toks.T, last[:, None]], axis=1)  # (B, N)
-        else:
-            gen = first[:, None]
+        gen = _greedy_loop(_logits_of(params, h_last),
+                           (tuple(kcs), tuple(vcs)), step_fn, pick, key,
+                           P, N, B, eos_id)
         return jnp.concatenate([prompt, gen], axis=1)
 
     return run
@@ -262,83 +295,96 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
 _NEG = jnp.float32(-1e9)
 
 
+def _beam_loop(first_logits, state0, step_fn, t0, N, B, K, eos_id, alpha):
+    """Generic K-beam token loop: standard K·V candidate expansion per
+    step, the decode-state pytree reordered by beam parent each step,
+    sequences reconstructed by a REVERSE scan over the (token, parent)
+    trace.  `state0` is the batch-B decode state (tiled K-fold here;
+    `step_fn` runs at batch B*K); emits N tokens at positions
+    t0..t0+N-1.  Returns (gen (B, K, N) best-first, normalized scores
+    (B, K))."""
+    logp0 = jax.nn.log_softmax(first_logits)         # (B, V)
+    V = logp0.shape[-1]
+    scores0, tok0 = jax.lax.top_k(logp0, K)          # (B, K)
+    tok0 = tok0.astype(jnp.int32)
+    # beams live as (B*K, ...): tile the state K-fold
+    state0 = jax.tree_util.tree_map(
+        lambda c: jnp.repeat(c, K, axis=0), state0)
+    done0 = (tok0 == eos_id) if eos_id >= 0 else jnp.zeros((B, K), bool)
+    lens0 = jnp.ones((B, K), jnp.int32)  # generated tokens so far
+
+    def step(carry, t):
+        state, scores, tok, done, lens = carry
+        state, logits = step_fn(state, tok.reshape(B * K), t)
+        logp = jax.nn.log_softmax(logits).reshape(B, K, V)
+        if eos_id >= 0:
+            # a finished beam may only extend with eos, at no cost —
+            # its score and length freeze
+            frozen = jnp.full((V,), _NEG).at[eos_id].set(0.0)
+            logp = jnp.where(done[..., None], frozen, logp)
+        cand = scores[..., None] + logp              # (B, K, V)
+        new_scores, idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        parent = idx // V                            # (B, K)
+        nxt = (idx % V).astype(jnp.int32)
+        gidx = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
+        state = jax.tree_util.tree_map(lambda c: c[gidx], state)
+        pdone = jnp.take_along_axis(done, parent, axis=1)
+        plens = jnp.take_along_axis(lens, parent, axis=1)
+        if eos_id >= 0:
+            ndone = pdone | (nxt == eos_id)
+            nlens = jnp.where(pdone, plens, plens + 1)
+        else:
+            ndone, nlens = pdone, plens + 1
+        return (state, new_scores, nxt, ndone, nlens), (nxt, parent)
+
+    if N > 1:
+        carry0 = (state0, scores0, tok0, done0, lens0)
+        (_, scores, _, _, lens), (toks, parents) = jax.lax.scan(
+            step, carry0, jnp.arange(t0, t0 + N - 1, dtype=jnp.int32))
+
+        # ---- backtrack: walk the parent pointers from the final beams
+        # to the first expansion (reverse scan; ys stay
+        # position-aligned) ----
+        def back(ptr, xs):
+            tk, par = xs
+            tok_t = jnp.take_along_axis(tk, ptr, axis=1)
+            return jnp.take_along_axis(par, ptr, axis=1), tok_t
+
+        init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+        ptr0, rest = jax.lax.scan(back, init, (toks, parents),
+                                  reverse=True)
+        first_tok = jnp.take_along_axis(tok0, ptr0, axis=1)
+        gen = jnp.concatenate([first_tok[None], rest], axis=0)
+        gen = gen.transpose(1, 2, 0)                 # (B, K, N)
+    else:
+        scores, lens, gen = scores0, lens0, tok0[..., None]
+
+    # GNMT length penalty: rank by score / ((5+len)/6)^alpha
+    if alpha > 0.0:
+        norm = scores / (((5.0 + lens.astype(jnp.float32)) / 6.0) ** alpha)
+    else:
+        norm = scores
+    order = jnp.argsort(-norm, axis=1)
+    gen = jnp.take_along_axis(gen, order[..., None], axis=1)
+    norm = jnp.take_along_axis(norm, order, axis=1)
+    return gen, norm
+
+
 def _build_beam_program(B, P, N, K, H, eos_id, alpha, acts):
-    """Beam-search decode for one static signature: standard K-beam
-    expansion over K·V candidates per step, per-layer caches reordered
-    by beam parent each step, sequences reconstructed by a REVERSE scan
-    over the (token, parent) trace — everything one compiled program."""
+    """Beam-search decode for one static signature — `_beam_loop` over
+    the LM's cached decode step, everything one compiled program."""
 
     def run(params, prompt):
         h_last, kcs, vcs = _prefill(params, prompt, acts, H, P + N)
-        logp0 = jax.nn.log_softmax(_logits_of(params, h_last))  # (B, V)
-        V = logp0.shape[-1]
-        scores0, tok0 = jax.lax.top_k(logp0, K)                 # (B, K)
-        tok0 = tok0.astype(jnp.int32)
-        # beams live as (B*K, ...): tile the prompt caches K-fold
-        kcs = tuple(jnp.repeat(c, K, axis=0) for c in kcs)
-        vcs = tuple(jnp.repeat(c, K, axis=0) for c in vcs)
-        done0 = (tok0 == eos_id) if eos_id >= 0 \
-            else jnp.zeros((B, K), bool)
-        lens0 = jnp.ones((B, K), jnp.int32)  # generated tokens so far
 
-        def step(carry, t):
-            kc, vc, scores, tok, done, lens = carry
-            new_k, new_v, logits = _decode_token(
-                params, acts, kc, vc, tok.reshape(B * K), t, H)
-            logp = jax.nn.log_softmax(logits).reshape(B, K, V)
-            if eos_id >= 0:
-                # a finished beam may only extend with eos, at no cost —
-                # its score and length freeze
-                frozen = jnp.full((V,), _NEG).at[eos_id].set(0.0)
-                logp = jnp.where(done[..., None], frozen, logp)
-            cand = scores[..., None] + logp              # (B, K, V)
-            new_scores, idx = jax.lax.top_k(cand.reshape(B, K * V), K)
-            parent = idx // V                            # (B, K)
-            nxt = (idx % V).astype(jnp.int32)
-            gidx = (jnp.arange(B)[:, None] * K + parent).reshape(B * K)
-            new_k = tuple(c[gidx] for c in new_k)
-            new_v = tuple(c[gidx] for c in new_v)
-            pdone = jnp.take_along_axis(done, parent, axis=1)
-            plens = jnp.take_along_axis(lens, parent, axis=1)
-            if eos_id >= 0:
-                ndone = pdone | (nxt == eos_id)
-                nlens = jnp.where(pdone, plens, plens + 1)
-            else:
-                ndone, nlens = pdone, plens + 1
-            return (new_k, new_v, new_scores, nxt, ndone, nlens), \
-                (nxt, parent)
+        def step_fn(state, tok, t):
+            new_k, new_v, logits = _decode_token(params, acts, state[0],
+                                                 state[1], tok, t, H)
+            return (new_k, new_v), logits
 
-        if N > 1:
-            carry0 = (kcs, vcs, scores0, tok0, done0, lens0)
-            (_, _, scores, _, _, lens), (toks, parents) = jax.lax.scan(
-                step, carry0, jnp.arange(P, P + N - 1, dtype=jnp.int32))
-
-            # ---- backtrack: walk the parent pointers from the final
-            # beams to the first expansion (reverse scan; ys stay
-            # position-aligned) ----
-            def back(ptr, xs):
-                tk, par = xs
-                tok_t = jnp.take_along_axis(tk, ptr, axis=1)
-                return jnp.take_along_axis(par, ptr, axis=1), tok_t
-
-            init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
-            ptr0, rest = jax.lax.scan(back, init, (toks, parents),
-                                      reverse=True)
-            first_tok = jnp.take_along_axis(tok0, ptr0, axis=1)
-            gen = jnp.concatenate([first_tok[None], rest], axis=0)
-            gen = gen.transpose(1, 2, 0)                 # (B, K, N)
-        else:
-            scores, lens, gen = scores0, lens0, tok0[..., None]
-
-        # GNMT length penalty: rank by score / ((5+len)/6)^alpha
-        if alpha > 0.0:
-            norm = scores / (((5.0 + lens.astype(jnp.float32)) / 6.0)
-                             ** alpha)
-        else:
-            norm = scores
-        order = jnp.argsort(-norm, axis=1)
-        gen = jnp.take_along_axis(gen, order[..., None], axis=1)
-        norm = jnp.take_along_axis(norm, order, axis=1)
+        gen, norm = _beam_loop(_logits_of(params, h_last),
+                               (tuple(kcs), tuple(vcs)), step_fn,
+                               P, N, B, K, eos_id, alpha)
         seqs = jnp.concatenate(
             [jnp.broadcast_to(prompt[:, None], (B, K, P)), gen], axis=2)
         return seqs, norm
@@ -391,3 +437,212 @@ def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
                                   float(alpha), acts)
         fn = cache[sig] = jax.jit(run)
     return fn(_gather_params(net), prompt)
+
+
+# --------------------------------------------------------------------- #
+# NMT (encoder-decoder Transformer) translation
+# --------------------------------------------------------------------- #
+def _gather_nmt_params(net):
+    """Decoder-side weight pytree for `models.Transformer` (the encoder
+    runs through the PUBLIC block — training numerics — outside the
+    decode program)."""
+    def d(layer):
+        return (layer.weight.data()._data,
+                None if layer.bias is None else layer.bias.data()._data)
+
+    layers = []
+    for lyr in net.decoder._layers:
+        layers.append({
+            "ln1": (lyr.ln1.gamma.data()._data, lyr.ln1.beta.data()._data),
+            "qkv": d(lyr.self_attn.qkv),
+            "proj": d(lyr.self_attn.proj),
+            "ln2": (lyr.ln2.gamma.data()._data, lyr.ln2.beta.data()._data),
+            "xq": d(lyr.cross_attn.q_proj),
+            "xkv": d(lyr.cross_attn.kv_proj),
+            "xproj": d(lyr.cross_attn.proj),
+            "ln3": (lyr.ln3.gamma.data()._data, lyr.ln3.beta.data()._data),
+            "ffn1": d(lyr.ffn.ffn_dense1),
+            "ffn2": d(lyr.ffn.ffn_dense2),
+        })
+    return {
+        "embed": net.tgt_embed.weight.data()._data,
+        "ln": (net.decoder.ln.gamma.data()._data,
+               net.decoder.ln.beta.data()._data),
+        "head": d(net.out_proj),
+        "layers": layers,
+    }
+
+
+def _nmt_decode_token(params, acts, pe, kcaches, vcaches, xks, xvs,
+                      mem_mask, tok, t, H):
+    """One decoder step at target position `t`: pre-LN self-attention
+    against the cache, cross-attention over the precomputed encoder
+    K/V (fp32 scores/softmax, the training path's numerics), FFN."""
+    dt = params["embed"].dtype
+    Bp = tok.shape[0]
+    C = params["embed"].shape[1]
+    D = C // H
+    h = (params["embed"][tok].astype(dt) * math.sqrt(C)
+         + jax.lax.dynamic_index_in_dim(pe, t, keepdims=False).astype(dt))
+    new_k, new_v = [], []
+    for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+        # self-attention with KV cache (the shared sub-step)
+        h, kc, vc = _cached_self_attn(lp, h, kcaches[li], vcaches[li],
+                                      t, H)
+        # cross-attention over the fixed encoder memory
+        x = _ln(h, *lp["ln2"])
+        qx = _dense(x, *lp["xq"]).reshape(Bp, H, D)
+        s = jnp.einsum("bhd,bhkd->bhk", qx.astype(jnp.float32),
+                       xks[li].astype(jnp.float32)) / math.sqrt(D)
+        if mem_mask is not None:
+            s = jnp.where(mem_mask[:, None, :].astype(bool), s,
+                          jnp.finfo(jnp.float32).min)
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhk,bhkd->bhd", p,
+                       xvs[li].astype(jnp.float32)).astype(dt)
+        h = h + _dense(a.reshape(Bp, C), *lp["xproj"])
+        h = h + _ffn_fwd(_ln(h, *lp["ln3"]), lp, act)
+        new_k.append(kc)
+        new_v.append(vc)
+    logits = _dense(_ln(h, *params["ln"]), *params["head"])
+    return tuple(new_k), tuple(new_v), logits.astype(jnp.float32)
+
+
+def _build_nmt_program(B, S, N, K, H, eos_id, bos_id, alpha, temperature,
+                       top_k, acts, masked):
+    """Translate program: BOS step → `_greedy_loop` (K=1) or
+    `_beam_loop` over the decoder's cached step; the encoder memory and
+    its per-layer cross K/V enter as traced arguments."""
+    pick = _make_pick(temperature, top_k)
+
+    def run(params, mem, mem_mask, pe, key):
+        dt = params["embed"].dtype
+        C = params["embed"].shape[1]
+        D = C // H
+        # per-layer cross-attention K/V from the encoder memory (once)
+        xks, xvs = [], []
+        for lp in params["layers"]:
+            kv = _dense(mem.astype(dt), *lp["xkv"])
+            kx, vx = jnp.split(kv, 2, axis=-1)
+            xks.append(kx.reshape(B, S, H, D).transpose(0, 2, 1, 3))
+            xvs.append(vx.reshape(B, S, H, D).transpose(0, 2, 1, 3))
+        L = len(acts)
+        kcs = tuple(jnp.zeros((B, H, N + 1, D), dt) for _ in range(L))
+        vcs = tuple(jnp.zeros((B, H, N + 1, D), dt) for _ in range(L))
+        bos = jnp.full((B,), bos_id, jnp.int32)
+
+        if K == 1:
+            def step_fn(state, tok, t):
+                kc, vc = state
+                kc, vc, logits = _nmt_decode_token(
+                    params, acts, pe, kc, vc, tuple(xks), tuple(xvs),
+                    mem_mask if masked else None, tok, t, H)
+                return (kc, vc), logits
+
+            (kcs, vcs), logits0 = step_fn((kcs, vcs), bos, jnp.int32(0))
+            gen = _greedy_loop(logits0, (kcs, vcs), step_fn, pick, key,
+                               1, N, B, eos_id)
+            return gen, None
+
+        # beam: cross K/V and the mask are per-BEAM constants — tile
+        # them once to batch B*K (the state pytree only carries the
+        # self-attention caches)
+        xks_t = tuple(jnp.repeat(x, K, axis=0) for x in xks)
+        xvs_t = tuple(jnp.repeat(x, K, axis=0) for x in xvs)
+        mm_t = jnp.repeat(mem_mask, K, axis=0) if masked else None
+
+        def step0(state, tok, t):
+            kc, vc, logits = _nmt_decode_token(
+                params, acts, pe, state[0], state[1], tuple(xks),
+                tuple(xvs), mem_mask if masked else None, tok, t, H)
+            return (kc, vc), logits
+
+        def step_fn(state, tok, t):
+            kc, vc, logits = _nmt_decode_token(
+                params, acts, pe, state[0], state[1], xks_t, xvs_t,
+                mm_t, tok, t, H)
+            return (kc, vc), logits
+
+        (kcs, vcs), logits0 = step0((kcs, vcs), bos, jnp.int32(0))
+        gen, norm = _beam_loop(logits0, (kcs, vcs), step_fn, 1, N, B, K,
+                               eos_id, alpha)
+        return gen, norm
+
+    return run
+
+
+def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
+                  eos_id: int = -1, bos_id: int = 0, alpha: float = 0.0,
+                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                  src_valid_length=None):
+    """Translate `src` with `models.Transformer` (encoder-decoder):
+    the ENCODER runs through the public block (training numerics), the
+    decoder runs the compiled KV-cache loop — greedy/sampling when
+    ``beam_size == 1`` (returns int32 (B, max_len) target tokens, BOS
+    excluded), K-beam otherwise (returns (sequences (B, K, max_len),
+    scores (B, K)) best-first, GNMT length penalty via ``alpha``).
+
+    ``bos_id`` seeds the decoder (the training convention prepends
+    BOS=0); ``eos_id >= 0`` freezes finished rows/beams.
+    ref: GluonNLP BeamSearchTranslator role `[UNVERIFIED — mount
+    empty]`, one compiled program per signature.
+    """
+    from ..ndarray.ndarray import NDArray
+    from .transformer import positional_encoding
+
+    if isinstance(src, NDArray):
+        src = src._data
+    src = jnp.asarray(src, jnp.int32)
+    B, S = src.shape
+    N = int(max_len)
+    K = int(beam_size)
+    if N < 1:
+        raise ValueError(f"max_len must be >= 1, got {N}")
+    if K < 1:
+        raise ValueError(f"beam_size must be >= 1, got {K}")
+    V = net.out_proj._units
+    if K > V:
+        raise ValueError(f"beam_size {K} exceeds vocab {V}")
+    if K > 1 and (temperature > 0.0 or top_k > 0):
+        raise ValueError(
+            "beam search is deterministic — temperature/top_k only "
+            "apply at beam_size=1")
+    H = net.decoder._layers[0].self_attn._num_heads
+
+    # encoder through the PUBLIC blocks — exact training numerics
+    mask_nd = None
+    mem_mask = jnp.ones((B, S), jnp.float32)
+    masked = src_valid_length is not None
+    if masked:
+        vl = jnp.asarray(src_valid_length).reshape(-1)
+        mem_mask = (jnp.arange(S)[None, :] < vl[:, None]).astype(jnp.float32)
+        mask_nd = NDArray(mem_mask)
+    mem = net.encoder(net._embed(net.src_embed, NDArray(src)),
+                      mask_nd)._data
+
+    # sampling params are inert at K>1 (validated above): keep them out
+    # of the beam cache key so a sweep cannot trigger recompiles
+    samp = (float(temperature), int(top_k)) if K == 1 else (0.0, 0)
+    sig = ("nmt", B, S, N, K, int(eos_id), int(bos_id), float(alpha),
+           samp, masked)
+    cache = getattr(net, "_gen_programs", None)
+    if cache is None:
+        cache = net._gen_programs = {}
+    fn = cache.get(sig)
+    if fn is None:
+        acts = tuple(lyr.ffn._act for lyr in net.decoder._layers)
+        run = _build_nmt_program(B, S, N, K, H, int(eos_id), int(bos_id),
+                                 float(alpha), samp[0], samp[1], acts,
+                                 masked)
+        fn = cache[sig] = jax.jit(run)
+    # pe table built ONCE per width and cached on the net (an eager
+    # rebuild per call would pay table construction + h2d every batch)
+    pe_cache = getattr(net, "_pe_cache", None)
+    if pe_cache is None:
+        pe_cache = net._pe_cache = {}
+    pe = pe_cache.get(N + 1)
+    if pe is None:
+        pe = pe_cache[N + 1] = positional_encoding(N + 1, net._units)
+    gen, scores = fn(_gather_nmt_params(net), mem, mem_mask, pe,
+                     jax.random.PRNGKey(seed))
+    return gen if K == 1 else (gen, scores)

@@ -261,6 +261,15 @@ class Transformer(HybridBlock):
         pe = NDArray(positional_encoding(T, self._units))
         return self.drop(x + pe)
 
+    def translate(self, src, max_len, **kw):
+        """KV-cache incremental translation — encoder once (public
+        block), decoder as one compiled loop; greedy by default,
+        K-beam via ``beam_size=K``.  See
+        `models.generation.nmt_translate` for all options."""
+        from .generation import nmt_translate
+
+        return nmt_translate(self, src, max_len, **kw)
+
     def forward(self, src_tokens, tgt_tokens, src_valid_length=None):
         src = self._embed(self.src_embed, src_tokens)
         mask = None
