@@ -60,7 +60,11 @@ def measure(T: int, B: int, dropout: float = 0.1):
             return self.loss(self.net(tokens), labels).mean()
 
     model = LMWithLoss(net)
-    model.hybridize()
+    # beyond T=32k the saved-activation set (12 layers of (1, T, 4096)
+    # bf16 FFN hiddens alone = T/32k * 6 GB) exceeds one chip's HBM:
+    # rematerialize the forward inside the backward (docs/long_context.md
+    # §3) — FLOPs for memory, the standard long-context trade
+    model.hybridize(remat_backward=T > 32768)
     trainer = Trainer(model.collect_params(), "sgd",
                       {"learning_rate": 1e-3, "momentum": 0.9,
                        "multi_precision": True}, keep_grads=False)

@@ -63,7 +63,22 @@ def _wb(layer):
             None if layer.bias is None else layer.bias.data()._data)
 
 
-def _gather_params(net):
+def _pe_table(net, width):
+    """Eagerly-built positional-encoding table of `width` rows, cached
+    per width on the net (the compiled decode programs consume pe as an
+    argument, so only the rows they read are ever built)."""
+    cache = getattr(net, "_pe_cache", None)
+    if cache is None:
+        cache = net._pe_cache = {}
+    pe = cache.get(width)
+    if pe is None:
+        from .transformer import positional_encoding
+
+        pe = cache[width] = positional_encoding(width, net._units)
+    return pe
+
+
+def _gather_params(net, pe_width):
     """The weight pytree the compiled program consumes — the live raw
     arrays of the Block's parameters, in a fixed structure."""
     d = _wb
@@ -77,9 +92,14 @@ def _gather_params(net):
             "ffn1": d(lyr.ffn.ffn_dense1),
             "ffn2": d(lyr.ffn.ffn_dense2),
         })
+    # long-context nets (_pe=None) get an eagerly-built table of just
+    # the width this program needs, cached on the net — pe enters the
+    # compiled program as an ARGUMENT here, so the giant-constant
+    # problem the in-program forward avoids does not apply
+    pe = net._pe if net._pe is not None else _pe_table(net, pe_width)
     return {
         "embed": net.embed.weight.data()._data,
-        "pe": net._pe,
+        "pe": pe,
         "ln": (net.ln.gamma.data()._data, net.ln.beta.data()._data),
         "head": d(net.head),
         "layers": layers,
@@ -286,7 +306,8 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
         run = _build_program(B, P, N, H, float(temperature), int(top_k),
                              int(eos_id), acts)
         fn = cache[sig] = jax.jit(run)
-    return fn(_gather_params(net), prompt, jax.random.PRNGKey(seed))
+    return fn(_gather_params(net, P + N), prompt,
+              jax.random.PRNGKey(seed))
 
 
 # --------------------------------------------------------------------- #
@@ -436,7 +457,7 @@ def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
         run = _build_beam_program(B, P, N, K, H, int(eos_id),
                                   float(alpha), acts)
         fn = cache[sig] = jax.jit(run)
-    return fn(_gather_params(net), prompt)
+    return fn(_gather_params(net, P + N), prompt)
 
 
 # --------------------------------------------------------------------- #
@@ -637,12 +658,7 @@ def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
         fn = cache[sig] = jax.jit(run)
     # pe table built ONCE per width and cached on the net (an eager
     # rebuild per call would pay table construction + h2d every batch)
-    pe_cache = getattr(net, "_pe_cache", None)
-    if pe_cache is None:
-        pe_cache = net._pe_cache = {}
-    pe = pe_cache.get(N + 1)
-    if pe is None:
-        pe = pe_cache[N + 1] = positional_encoding(N + 1, net._units)
+    pe = _pe_table(net, N + 1)
     gen, scores = fn(_gather_nmt_params(net), mem, mem_mask, pe,
                      jax.random.PRNGKey(seed))
     return gen if K == 1 else (gen, scores)

@@ -23,6 +23,11 @@ __all__ = ["Transformer", "TransformerEncoder", "TransformerDecoder",
            "LabelSmoothedCELoss"]
 
 
+# above this max_len, TransformerLM computes pe in-program instead of
+# precomputing a table (see __init__)
+_PE_TABLE_MAX = 8192
+
+
 def positional_encoding(T, C, dtype=jnp.float32):
     pos = jnp.arange(T)[:, None].astype(jnp.float32)
     dim = jnp.arange(0, C, 2).astype(jnp.float32)
@@ -203,9 +208,17 @@ class TransformerLM(HybridBlock):
             self._layers.append(l)
         self.ln = nn.LayerNorm(in_channels=units)
         self.head = nn.Dense(vocab, flatten=False, in_units=units)
-        # built once: rebuilding the (max_len, units) table per forward
-        # would pay an 8 MB host->device transfer every eager step
-        self._pe = positional_encoding(max_len, units)
+        # Small max_len: build the table once (rebuilding per EAGER
+        # forward costs several dispatches per step).  Long-context
+        # models (max_len > _PE_TABLE_MAX) compute pe IN-PROGRAM
+        # instead: the closed-over table would otherwise embed an
+        # O(max_len*units) fp32 CONSTANT into every compiled program —
+        # at max_len=65536 that is 256 MB of HLO literal, which this
+        # sandbox's compile relay rejects outright (HTTP 413) and any
+        # deployment pays in program size; sin/cos over the slice is
+        # VPU noise under jit.
+        self._pe = positional_encoding(max_len, units) \
+            if max_len <= _PE_TABLE_MAX else None
 
     def forward(self, tokens):
         tokens = wrap(tokens)
@@ -214,8 +227,13 @@ class TransformerLM(HybridBlock):
             raise ValueError(f"sequence {T} exceeds max_len {self._max_len}")
         h = self.embed(tokens) * math.sqrt(self._units)
         pe = self._pe
+        C = self._units
 
-        h = apply_op(lambda r: r + pe[:T].astype(r.dtype), h)
+        if pe is None:
+            h = apply_op(
+                lambda r: r + positional_encoding(T, C).astype(r.dtype), h)
+        else:
+            h = apply_op(lambda r: r + pe[:T].astype(r.dtype), h)
         for l in self._layers:
             h = l(h)
         return self.head(self.ln(h))

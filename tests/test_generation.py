@@ -312,3 +312,44 @@ def test_nmt_beam_sampling_conflict_raises():
     src = onp.ones((1, 4), "int32")
     with pytest.raises(ValueError):
         net.translate(src, 3, beam_size=2, temperature=0.7)
+
+
+def test_long_maxlen_in_program_pe():
+    """max_len > _PE_TABLE_MAX: the forward computes pe IN-PROGRAM (no
+    O(max_len*units) constant in the compiled program — the r5 fix for
+    the 256 MB HLO literal at max_len=65536) and generate takes the
+    width-keyed eager table path.  Parity vs a small-max_len twin with
+    identical weights pins both branches."""
+    from incubator_mxnet_tpu.models.transformer import _PE_TABLE_MAX
+
+    mx.random.seed(4)
+    big = TransformerLM(vocab=61, units=16, hidden_size=32, num_layers=1,
+                        num_heads=2, max_len=_PE_TABLE_MAX + 1,
+                        dropout=0.0)
+    big.initialize()
+    big(NDArray(jnp.ones((1, 4), jnp.int32)))
+    assert big._pe is None  # in-program regime
+    mx.random.seed(4)
+    small = TransformerLM(vocab=61, units=16, hidden_size=32,
+                          num_layers=1, num_heads=2, max_len=64,
+                          dropout=0.0)
+    small.initialize()
+    small(NDArray(jnp.ones((1, 4), jnp.int32)))
+    assert small._pe is not None  # table regime
+
+    toks = onp.array(jax.random.randint(jax.random.PRNGKey(8), (2, 9),
+                                        0, 61), dtype="int32")
+    a = big(NDArray(jnp.asarray(toks))).asnumpy()
+    b = small(NDArray(jnp.asarray(toks))).asnumpy()
+    onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                rtol=2e-5, atol=2e-5)
+    # hybridized parity too (the compiled program carries no pe table)
+    big.hybridize()
+    c = big(NDArray(jnp.asarray(toks))).asnumpy()
+    onp.testing.assert_allclose(onp.asarray(c), onp.asarray(a),
+                                rtol=2e-5, atol=2e-5)
+    # generate on the long-max_len net: width-keyed eager pe path
+    out = onp.asarray(big.generate(toks[:, :5], 3))
+    want = onp.asarray(small.generate(toks[:, :5], 3))
+    onp.testing.assert_array_equal(out, want)
+    assert set(big._pe_cache) == {8}  # only the P+N rows were built
