@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Static gates: tpulint (JAX/TPU tracing-hazard analyzer, tools/tpulint/)
+# over the whole package in --strict mode (every suppression must carry a
+# reason), plus a bytecode compile of package + tools as a syntax gate.
+# Exits non-zero on any finding. See docs/static_analysis.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "tpulint: analyzing incubator_mxnet_tpu/"
+python -m tools.tpulint incubator_mxnet_tpu/ --strict
+
+echo "compileall: incubator_mxnet_tpu/ tools/ tests/"
+python -m compileall -q incubator_mxnet_tpu/ tools/ tests/
+
+echo "lint gates: OK"

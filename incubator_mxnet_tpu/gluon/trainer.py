@@ -37,7 +37,7 @@ def _wait_or_surface(leaf) -> None:
     already consumed (benign), but a REAL async execution error (e.g.
     device OOM) must not be silently dropped."""
     try:
-        jax.block_until_ready(leaf)
+        jax.block_until_ready(leaf)  # tpulint: disable=TPU002 -- deliberate backpressure sync: bounds run-ahead to the throttle window
     except RuntimeError as e:
         if "deleted" not in str(e):
             raise

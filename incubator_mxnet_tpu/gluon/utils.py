@@ -126,20 +126,28 @@ def split_and_load(data, ctx_list: Optional[List[Context]] = None,
 
 
 def clip_global_norm(arrays: List[NDArray], max_norm: float, check_isfinite: bool = True):
-    """Rescale arrays so the joint L2 norm ≤ max_norm; returns the norm."""
+    """Rescale arrays so the joint L2 norm ≤ max_norm; returns the norm.
+
+    With ``check_isfinite=False`` the clip stays entirely on device (no
+    host sync; returns the norm as a lazy NDArray).  The default pulls
+    the norm to the host for the finiteness warning and returns a float.
+    """
     if not arrays:
         raise ValueError("arrays must not be empty")
     total = jnp.sqrt(sum(jnp.sum(jnp.square(raw(a).astype(jnp.float32))) for a in arrays))
-    total_f = float(total)
-    if check_isfinite and not math.isfinite(total_f):
+    scale = max_norm / (total + 1e-8)
+    # nan norm => scale stays 1.0, matching the old host-side `scale < 1.0`
+    scale = jnp.where(scale < 1.0, scale, 1.0)
+    for a in arrays:
+        a._data = (raw(a) * scale).astype(raw(a).dtype)
+    if not check_isfinite:
+        return NDArray(total)
+    total_f = float(total)  # tpulint: disable=TPU002 -- check_isfinite contract: host-side finiteness warning requires the value
+    if not math.isfinite(total_f):
         import warnings
 
         warnings.warn("nan or inf is detected. Clipping results will be undefined.")
-    scale = max_norm / (total_f + 1e-8)
-    if scale < 1.0:
-        for a in arrays:
-            a._data = raw(a) * scale
-    return total_f if check_isfinite else NDArray(total)
+    return total_f
 
 
 def check_sha1(filename: str, sha1_hash: str) -> bool:

@@ -465,7 +465,7 @@ def attention_bthd(q, k, v, scale: Optional[float] = None):
     return out.astype(q.dtype)
 
 
-def _use_pallas(platform, tq, tk, force_reference):
+def _use_pallas(platform, tq, tk, force_reference: bool):
     if force_reference:
         return False
     if platform == "cpu":
@@ -480,7 +480,7 @@ def _use_pallas(platform, tq, tk, force_reference):
 _PALLAS_BWD_MIN_SCORES = 512 * 512
 
 
-def _use_pallas_bwd(platform, tq, tk, force_reference):
+def _use_pallas_bwd(platform, tq, tk, force_reference: bool):
     if not _use_pallas(platform, tq, tk, force_reference):
         return False
     if platform == "cpu":
@@ -488,7 +488,8 @@ def _use_pallas_bwd(platform, tq, tk, force_reference):
     return tq * tk >= _PALLAS_BWD_MIN_SCORES
 
 
-def _dispatch_fwd(q, k, v, causal, scale, block_q, block_k, force_reference):
+def _dispatch_fwd(q, k, v, causal, scale, block_q, block_k,
+                  force_reference: bool):
     """Returns (out, lse); lse is None on the reference path."""
     platform = jax.default_backend()
     if _use_pallas(platform, q.shape[2], k.shape[2], force_reference):

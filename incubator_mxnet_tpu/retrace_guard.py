@@ -1,0 +1,170 @@
+"""Runtime guard against recompilation storms.
+
+JAX silently retraces a jitted callable whenever it sees a new
+combination of input shapes/dtypes or static-argument values.  On TPU a
+single compile costs seconds; a training loop that perturbs shapes every
+step (python-int batch sizes, growing pad lengths, fresh closures per
+iteration) turns into a compile-bound crawl without any error.  This
+module makes that failure loud.
+
+:class:`RetraceGuard` counts compilations per callable *name* while
+active and raises :class:`RetraceError` when any watched name exceeds
+its budget.  Counting hooks into JAX's compile logging (the
+``jax._src.interpreters.pxla`` logger emits ``"Compiling <name> with
+global shapes and types ..."`` at DEBUG for every cache miss), so no JAX
+internals are monkeypatched and jitted code runs unmodified.
+
+Names are the only identity the log line carries, so counting is coarse:
+two different closures both called ``raw_fn`` share one counter.  Budget
+accordingly (one compile per distinct shape signature per callable is
+legitimate) or pass ``watch=`` to restrict counting to the program names
+you care about.
+
+Usage::
+
+    with RetraceGuard(budget=8, watch={"train_step"}) as guard:
+        for batch in loader:
+            train_step(params, batch)
+    # raises RetraceError on exit if train_step compiled > 8 times
+
+The test suite activates a guard around every test via an autouse
+fixture in ``tests/conftest.py`` (budget ``MXTPU_RETRACE_BUDGET``,
+opt-out ``MXTPU_RETRACE_GUARD=0``).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import Counter
+from typing import Dict, Iterable, Optional, Set
+
+from .base import MXNetError
+
+__all__ = ["RetraceError", "RetraceGuard", "DEFAULT_BUDGET", "PROGRAM_NAMES"]
+
+# Loggers that announce a compilation.  pxla carries the callable name in
+# args[0]; dispatch only carries elapsed times, so pxla is the one we tap.
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_MSG_PREFIX = "Compiling "
+
+DEFAULT_BUDGET = int(os.environ.get("MXTPU_RETRACE_BUDGET", "64"))
+
+# The package's jitted program entry points (gluon/block.py _program_jits
+# and the Trainer fused steps).  The conftest guard watches only these:
+# jax-internal primitive jits (broadcast_in_dim, convert_element_type,
+# ...) legitimately compile once per shape and would swamp a global count.
+PROGRAM_NAMES: Set[str] = {
+    "raw_fn", "grad_fn", "fwd_record_fn",       # hybridized block programs
+    "chain", "chain_unrolled",                  # fused optimizer chains
+    "stacked_with_sync", "full",                # fused train steps
+    "_flash_core",                              # flash-attention kernel jit
+}
+
+
+class RetraceError(MXNetError):
+    """A watched callable recompiled more often than its budget allows."""
+
+
+class _CompileCounter(logging.Handler):
+    """Logging handler feeding compile events into a RetraceGuard."""
+
+    def __init__(self, guard: "RetraceGuard"):
+        super().__init__(level=logging.DEBUG)
+        self._guard = guard
+
+    def emit(self, record: logging.LogRecord) -> None:  # pragma: no branch
+        try:
+            if (isinstance(record.msg, str)
+                    and record.msg.startswith(_COMPILE_MSG_PREFIX)
+                    and record.args):
+                self._guard._record(str(record.args[0]))
+        except Exception:
+            # never let accounting break the compile it observes
+            pass
+
+
+class RetraceGuard:
+    """Context manager that raises when compilations exceed a budget.
+
+    Parameters
+    ----------
+    budget : int
+        Max compilations allowed per watched name while the guard is
+        active.  Defaults to ``MXTPU_RETRACE_BUDGET`` (64).
+    watch : iterable of str, optional
+        If given, only these callable names count toward the budget;
+        all names are still tallied in :attr:`counts` for diagnosis.
+    exempt : iterable of str, optional
+        Names never counted toward the budget (applied after ``watch``).
+    """
+
+    def __init__(self, budget: Optional[int] = None,
+                 watch: Optional[Iterable[str]] = None,
+                 exempt: Iterable[str] = ()):
+        self.budget = DEFAULT_BUDGET if budget is None else int(budget)
+        self.watch = None if watch is None else set(watch)
+        self.exempt = set(exempt)
+        self.counts: Counter = Counter()
+        self._lock = threading.Lock()
+        self._handler: Optional[_CompileCounter] = None
+        self._prev_level: Optional[int] = None
+        self._prev_propagate: bool = True
+
+    # -- accounting --------------------------------------------------
+    def _record(self, name: str) -> None:
+        with self._lock:
+            self.counts[name] += 1
+
+    def _counted(self, name: str) -> bool:
+        if name in self.exempt:
+            return False
+        return self.watch is None or name in self.watch
+
+    def violations(self) -> Dict[str, int]:
+        """Watched names whose compile count exceeds the budget."""
+        with self._lock:
+            return {n: c for n, c in self.counts.items()
+                    if self._counted(n) and c > self.budget}
+
+    def check(self) -> None:
+        """Raise :class:`RetraceError` if any watched name is over budget."""
+        bad = self.violations()
+        if bad:
+            detail = ", ".join(f"{n}: {c} compiles"
+                               for n, c in sorted(bad.items()))
+            raise RetraceError(
+                f"retrace budget exceeded (budget={self.budget}): {detail}. "
+                "Likely causes: shape-unstable inputs (pad to fixed shapes), "
+                "python scalars that vary per step (pass arrays or mark "
+                "static), or re-creating jitted closures inside the loop. "
+                "Raise MXTPU_RETRACE_BUDGET if the workload legitimately "
+                "needs more compilations.")
+
+    # -- context management ------------------------------------------
+    def __enter__(self) -> "RetraceGuard":
+        logger = logging.getLogger(_COMPILE_LOGGER)
+        self._handler = _CompileCounter(self)
+        # the compile line is emitted at DEBUG unless jax_log_compiles is
+        # set; lower the logger (not the root) so it reaches our handler,
+        # and stop propagation so the records we forced into existence
+        # don't spam the root handlers
+        if logger.getEffectiveLevel() > logging.DEBUG:
+            self._prev_level = logger.level
+            self._prev_propagate = logger.propagate
+            logger.propagate = False
+            logger.setLevel(logging.DEBUG)
+        logger.addHandler(self._handler)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        logger = logging.getLogger(_COMPILE_LOGGER)
+        if self._handler is not None:
+            logger.removeHandler(self._handler)
+            self._handler = None
+        if self._prev_level is not None:
+            logger.setLevel(self._prev_level)
+            logger.propagate = self._prev_propagate
+            self._prev_level = None
+        if exc_type is None:
+            self.check()

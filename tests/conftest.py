@@ -18,7 +18,30 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+if os.environ.get("MXTPU_CHECK_TRACER_LEAKS") == "1":
+    # surfaces tracers that escape their trace (stashed on self, returned
+    # through closures); ~2x tracing overhead, so opt-in
+    jax.config.update("jax_check_tracer_leaks", True)
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _retrace_guard(request):
+    """Fail any test whose watched programs recompile beyond the budget.
+
+    Counting is keyed by callable name (the only identity JAX's compile
+    log carries), so the guard watches only the package's jitted program
+    names and the budget is per-test.  MXTPU_RETRACE_GUARD=0 disables;
+    MXTPU_RETRACE_BUDGET overrides the default of 64.
+    """
+    if os.environ.get("MXTPU_RETRACE_GUARD", "1") == "0":
+        yield
+        return
+    from incubator_mxnet_tpu.retrace_guard import PROGRAM_NAMES, RetraceGuard
+
+    with RetraceGuard(watch=PROGRAM_NAMES) as guard:
+        yield guard
 
 
 @pytest.fixture

@@ -1,0 +1,20 @@
+"""Reachability fixture: the SAME hazardous call is flagged only on the
+jit-reachable path, never in host-only code."""
+import jax
+import numpy as np
+
+
+def _kernel(x):
+    return np.log(x)       # POSITIVE: build_jitted hands this to jax.jit
+
+
+def host_helper(x):
+    return np.log(x)       # negative: only host_entry calls this
+
+
+def host_entry(x):
+    return host_helper(x)
+
+
+def build_jitted():
+    return jax.jit(_kernel)

@@ -1,0 +1,18 @@
+"""Suppression fixture: inline disables with and without a reason."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def suppressed(x):
+    return np.tanh(x)  # tpulint: disable=TPU001 -- fixture: documented exemption
+
+
+@jax.jit
+def no_reason(x):
+    return np.log1p(x)  # tpulint: disable=TPU001
+
+
+@jax.jit
+def unsuppressed(x):
+    return np.exp(x)   # POSITIVE: no suppression comment

@@ -1,0 +1,18 @@
+"""TPU001 fixture: host numpy under trace vs host-only / jnp usage."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_tanh(x):
+    return np.tanh(x)          # POSITIVE: host numpy under jit
+
+
+@jax.jit
+def good_tanh(x):
+    return jnp.tanh(x)         # negative: jax.numpy is trace-safe
+
+
+def host_stats(batch):
+    return np.mean(batch)      # negative: host-only code, out of trace scope

@@ -1,0 +1,23 @@
+"""TPU002 fixture: implicit host syncs in trace-reachable and per-step code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_item(x):
+    return x.sum().item()      # POSITIVE: .item() drains the device queue
+
+
+class LoopTrainer:
+    def step(self, grads):
+        total = jnp.sum(grads)
+        return float(total)    # POSITIVE: float() in per-step code
+
+
+@jax.jit
+def good_sum(x):
+    return x.sum()             # negative: stays on device
+
+
+def log_metrics(x):
+    return float(x)            # negative: host-only code

@@ -1,0 +1,25 @@
+"""TPU003 fixture: PRNG key reuse vs properly split keys."""
+import jax
+
+
+def reused_key(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)   # POSITIVE: key consumed twice
+    return a + b
+
+
+def split_key(shape):
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)     # negative: fresh subkey per draw
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def loop_reuse(shape):
+    key = jax.random.PRNGKey(0)
+    out = []
+    for _ in range(3):
+        out.append(jax.random.normal(key, shape))  # POSITIVE: reused per iter
+    return out

@@ -1,0 +1,22 @@
+"""TPU004 fixture: Python control flow on tracer values vs static metadata."""
+import jax
+
+
+@jax.jit
+def bad_branch(x):
+    if x.sum() > 0:            # POSITIVE: tracer truthiness under jit
+        return x
+    return -x
+
+
+@jax.jit
+def good_branch(x):
+    if x.ndim == 2:            # negative: aval metadata is trace-static
+        return x.sum(axis=1)
+    return x
+
+
+def host_branch(x):
+    if x.sum() > 0:            # negative: host-only code may branch freely
+        return x
+    return -x

@@ -1,0 +1,26 @@
+"""TPU005 fixture: side effects under jit vs local accumulation."""
+import jax
+
+_TRACE_LOG = []
+_STEP = 0
+
+
+@jax.jit
+def bad_effects(x):
+    print("tracing", x)        # POSITIVE: runs once per compile, not per call
+    _TRACE_LOG.append(x)       # POSITIVE: tracer leaks into a host container
+    return x * 2
+
+
+@jax.jit
+def bad_global(x):
+    global _STEP               # POSITIVE: trace-time rebind
+    _STEP += 1
+    return x
+
+
+@jax.jit
+def good_effects(x):
+    parts = []
+    parts.append(x * 2)        # negative: local accumulator is fine
+    return parts[0]

@@ -1,0 +1,17 @@
+"""TPU006 fixture: mutable defaults on Block subclass signatures."""
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+
+class BadBlock(HybridBlock):
+    def __init__(self, layers=[]):     # POSITIVE: shared across instances
+        self.layers = layers
+
+
+class GoodBlock(HybridBlock):
+    def __init__(self, layers=None):   # negative
+        self.layers = layers or []
+
+
+class PlainConfig:
+    def __init__(self, items=[]):      # negative: not a Block subclass
+        self.items = items

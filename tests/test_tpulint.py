@@ -1,0 +1,112 @@
+"""tpulint acceptance tests: every rule fires on its fixture positive and
+stays silent on the negative; suppression and trace-reachability work; the
+shipped package itself lints clean in --strict."""
+import os
+import subprocess
+import sys
+
+from tools.tpulint.cli import run
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures", "tpulint")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(name, **kw):
+    project, findings = run([os.path.join(FIXDIR, name)], **kw)
+    assert not project.errors, project.errors
+    return findings
+
+
+def lines(findings, code):
+    return sorted(f.line for f in findings if f.code == code)
+
+
+def functions(findings):
+    # Finding.function is module-qualified ("tpu001_case.bad_tanh")
+    return {f.function.split(".", 1)[1] for f in findings}
+
+
+def test_tpu001_host_numpy_under_trace():
+    findings = lint("tpu001_case.py")
+    assert lines(findings, "TPU001") == [9]
+    assert functions(findings) == {"bad_tanh"}        # jnp + host fn silent
+
+
+def test_tpu002_host_sync_trace_and_perstep():
+    findings = lint("tpu002_case.py")
+    assert lines(findings, "TPU002") == [8, 14]
+    assert functions(findings) == {"bad_item", "LoopTrainer.step"}
+
+
+def test_tpu003_key_reuse():
+    findings = lint("tpu003_case.py")
+    assert lines(findings, "TPU003") == [8, 24]
+    assert "split_key" not in functions(findings)
+
+
+def test_tpu004_tracer_control_flow():
+    findings = lint("tpu004_case.py")
+    assert lines(findings, "TPU004") == [7]
+    # static-metadata branch and host-only branch both silent
+    assert functions(findings) == {"bad_branch"}
+
+
+def test_tpu005_side_effects_under_jit():
+    findings = lint("tpu005_case.py")
+    assert lines(findings, "TPU005") == [10, 11, 17]
+    assert "good_effects" not in functions(findings)
+
+
+def test_tpu006_mutable_block_defaults():
+    findings = lint("tpu006_case.py")
+    assert lines(findings, "TPU006") == [6]
+    assert functions(findings) == {"BadBlock.__init__"}
+
+
+def test_suppression_comment_silences_finding():
+    findings = lint("suppression_case.py")
+    # suppressed + no_reason are silenced; only the bare positive remains
+    assert lines(findings, "TPU001") == [18]
+
+
+def test_strict_requires_reason_on_suppressions():
+    findings = lint("suppression_case.py", strict=True)
+    codes = {f.code for f in findings}
+    assert codes == {"TPU000", "TPU001"}
+    # the reason-less disable on no_reason is the TPU000
+    assert lines(findings, "TPU000") == [13]
+
+
+def test_trace_reachability_separates_host_from_jit():
+    findings = lint("reachability_case.py")
+    # identical np.log call: flagged in the jit-reachable kernel only
+    assert functions(findings) == {"_kernel"}
+    assert lines(findings, "TPU001") == [8]
+
+
+def test_select_and_ignore_filter_rules():
+    findings = lint("tpu005_case.py", select=["TPU001"])
+    assert findings == []
+    findings = lint("tpu005_case.py", ignore=["TPU005"])
+    assert findings == []
+
+
+def test_package_lints_clean_strict():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "incubator_mxnet_tpu/",
+         "--strict"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_codes_and_format():
+    bad = os.path.join(FIXDIR, "tpu001_case.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", bad], cwd=REPO,
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "TPU001" in proc.stdout and ":9:" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--select", "NOPE", bad],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 2

@@ -1,0 +1,34 @@
+"""tpulint — static analysis for JAX/TPU tracing hazards.
+
+The reference MXNet project kept its correctness tooling in CI
+(sanitizer builds over `src/engine`); tpulint is the Python/JAX
+equivalent for this repo: an AST-based analyzer that makes silent
+TPU-throughput hazards build-breaking.
+
+Rules (see docs/static_analysis.md for the full catalogue):
+
+  TPU001  host-numpy call in trace-reachable code
+  TPU002  implicit host sync in trace-reachable / per-step code
+  TPU003  PRNG key reuse without an intervening split
+  TPU004  Python control flow on tracer-derived values under trace
+  TPU005  side effect under jit (print / closure mutation / global write)
+  TPU006  mutable default argument in a Block subclass signature
+
+Trace-reachability is computed by a conservative call-graph walk seeded
+at jit entry points (`hybrid_forward`/`forward` of Block subclasses,
+functions passed to `jax.jit`/`pjit`/`shard_map`/`pallas_call` — also
+transitively, through helpers that jit their own function arguments,
+e.g. `_program_jits`).  Host-only code (dataloaders, recordio, tools)
+is deliberately out of scope for the trace rules.
+
+Suppression: ``# tpulint: disable=TPU001,TPU004 -- reason`` on the
+offending line (or ``disable-next=`` on the line above, or
+``disable-file=`` anywhere in the file).  ``--strict`` requires every
+suppression to carry a ``-- reason``.
+
+Usage: ``python -m tools.tpulint incubator_mxnet_tpu/ --strict``
+"""
+from .analyzer import Project, Finding
+from .cli import main, run
+
+__all__ = ["Project", "Finding", "main", "run"]

@@ -1,0 +1,623 @@
+"""Project indexing + trace-reachability for tpulint.
+
+The analyzer is a whole-project pass, not a per-file one: rule scopes
+depend on *reachability* ("can a jit trace reach this function?"),
+which needs imports, the class hierarchy and a call graph across every
+analyzed module.
+
+Pipeline:
+
+1. index every ``*.py`` file into a :class:`ModuleInfo` (import alias
+   table, classes, functions — including nested defs);
+2. resolve the class hierarchy to find ``Block``/``HybridBlock``
+   subclasses (their ``forward``/``hybrid_forward`` run under
+   ``jax.jit`` once hybridized — the CachedOp equivalence);
+3. fixpoint over *jit wrappers*: ``jax.jit``/``pjit``/``shard_map``/
+   ``pallas_call``/``lax.scan`` etc. seed the set; any analyzed
+   function that passes one of its own parameters to a known wrapper
+   becomes a wrapper itself (this is how ``_program_jits(raw_fn)``
+   marks every ``raw_fn`` closure as a jit entry point);
+4. BFS over call edges from the seeds → ``trace_reachable`` set, and a
+   second BFS from per-step seeds (``Trainer.step``/``Optimizer.update``)
+   → ``perstep_reachable`` set.
+
+Resolution is deliberately conservative in BOTH directions: bare names
+only resolve within the module (or explicit imports), ``self.m()``
+resolves through the declared ancestry — so host-only code (io,
+recordio, tools) never gets dragged into trace scope, and trace scope
+never silently loses a hop that a simple name lookup can prove.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# data model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+    function: str = ""
+
+    def key(self) -> Tuple[str, int, int, str]:
+        return (self.path, self.line, self.col, self.code)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.code} {self.message}"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    bases: List[str] = field(default_factory=list)   # dotted, resolved where possible
+    methods: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+    is_block: bool = False       # descends from Block (TPU006 scope)
+    is_hybrid: bool = False      # descends from HybridBlock (forward is traced)
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.module.name}.{self.name}"
+
+
+@dataclass
+class FunctionInfo:
+    module: "ModuleInfo"
+    qualname: str                      # "Class.method" / "outer.inner" / "func"
+    name: str
+    node: ast.FunctionDef
+    cls: Optional[ClassInfo] = None
+    trace_reachable: bool = False
+    perstep_reachable: bool = False
+    is_jit_wrapper: bool = False
+    trace_reason: str = ""             # why it entered trace scope (diagnostics)
+    # params declared static at the jit boundary (static_argnums/
+    # static_argnames) — host values by contract, excluded from taint
+    static_params: Set[str] = field(default_factory=set)
+    # statics this function forwards to jit when IT is a wrapper
+    wrapper_statics: Optional[Tuple[Tuple[int, ...], Tuple[str, ...]]] = None
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.module.name}.{self.qualname}"
+
+
+@dataclass
+class ModuleInfo:
+    name: str                          # dotted module name
+    path: str
+    tree: ast.Module
+    source: str
+    aliases: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+
+
+# jit entry wrappers: calling one of these with a function argument
+# makes that function's body run under trace.
+JIT_WRAPPERS = {
+    "jax.jit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pallas.pallas_call",
+    "jax.eval_shape", "jax.make_jaxpr",
+    "jax.vjp", "jax.jvp", "jax.grad", "jax.value_and_grad",
+    "jax.vmap", "jax.pmap",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.cond",
+    "jax.lax.switch", "jax.lax.fori_loop", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+}
+
+# methods whose bodies run once per training step (host code, but on
+# the step critical path — explicit syncs there serialize the device
+# queue).  Scoped to optimizer/trainer-like classes, see _perstep_seed.
+PERSTEP_METHOD_NAMES = {"step", "update", "update_multi_precision"}
+PERSTEP_CLASS_HINTS = ("Trainer", "Optimizer", "Updater", "KVStore", "LRScheduler")
+# free functions documented as per-iteration utilities
+PERSTEP_FUNCTION_NAMES = {"clip_global_norm", "allreduce_grads"}
+
+BLOCK_ROOT_NAMES = {"Block", "HybridBlock", "SymbolBlock"}
+# only these roots put `forward` under jit (plain eager Blocks —
+# dataloader transforms etc. — are host-only by design)
+HYBRID_ROOT_NAMES = {"HybridBlock", "SymbolBlock"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+class _Indexer(ast.NodeVisitor):
+    """One pass per module: aliases, classes, functions (incl. nested)."""
+
+    def __init__(self, mod: ModuleInfo, pkg_parts: List[str]):
+        self.mod = mod
+        self.pkg_parts = pkg_parts      # package path of the module, for relative imports
+        self.scope: List[str] = []      # qualname parts
+        self.cls_stack: List[Optional[ClassInfo]] = []
+
+    # -- imports --------------------------------------------------------- #
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.mod.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0])
+            if a.asname:
+                self.mod.aliases[a.asname] = a.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.level:
+            base_parts = self.pkg_parts[: len(self.pkg_parts) - (node.level - 1)]
+            base = ".".join(base_parts + ([node.module] if node.module else []))
+        else:
+            base = node.module or ""
+        for a in node.names:
+            if a.name == "*":
+                continue
+            target = f"{base}.{a.name}" if base else a.name
+            self.mod.aliases[a.asname or a.name] = target
+
+    # -- defs ------------------------------------------------------------- #
+    def visit_ClassDef(self, node: ast.ClassDef):
+        info = ClassInfo(name=node.name, module=self.mod, node=node)
+        for b in node.bases:
+            d = dotted_name(b)
+            if d:
+                info.bases.append(d)
+        self.mod.classes[node.name] = info
+        self.scope.append(node.name)
+        self.cls_stack.append(info)
+        self.generic_visit(node)
+        self.cls_stack.pop()
+        self.scope.pop()
+
+    def _visit_func(self, node):
+        qual = ".".join(self.scope + [node.name])
+        cls = self.cls_stack[-1] if self.cls_stack else None
+        info = FunctionInfo(module=self.mod, qualname=qual, name=node.name,
+                            node=node, cls=cls)
+        self.mod.functions[qual] = info
+        if cls is not None and len(self.scope) and self.scope[-1] == cls.name:
+            cls.methods[node.name] = info
+        self.scope.append(node.name)
+        self.cls_stack.append(None)     # nested defs are not methods
+        self.generic_visit(node)
+        self.cls_stack.pop()
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+# ---------------------------------------------------------------------------
+# project
+# ---------------------------------------------------------------------------
+
+
+class Project:
+    """The analyzed file set plus all derived graphs."""
+
+    def __init__(self, paths: List[str]):
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[str] = []
+        for f in self._collect_files(paths):
+            self._index_file(f)
+        self._resolve_block_classes()
+        self._compute_jit_wrappers()
+        self._compute_reachability()
+
+    # -- file discovery --------------------------------------------------- #
+    @staticmethod
+    def _collect_files(paths: List[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for root, dirs, files in os.walk(p):
+                    dirs[:] = sorted(d for d in dirs
+                                     if d not in ("__pycache__", ".git"))
+                    for fn in sorted(files):
+                        if fn.endswith(".py"):
+                            out.append(os.path.join(root, fn))
+            elif p.endswith(".py"):
+                out.append(p)
+        return out
+
+    @staticmethod
+    def _module_name(path: str) -> Tuple[str, List[str]]:
+        """Dotted module name from the filesystem (walk up __init__.py)."""
+        ap = os.path.abspath(path)
+        parts = [os.path.splitext(os.path.basename(ap))[0]]
+        d = os.path.dirname(ap)
+        while os.path.exists(os.path.join(d, "__init__.py")):
+            parts.append(os.path.basename(d))
+            d = os.path.dirname(d)
+        parts.reverse()
+        if parts[-1] == "__init__":
+            parts.pop()
+        name = ".".join(parts)
+        pkg_parts = parts if path.endswith("__init__.py") else parts[:-1]
+        return name, pkg_parts
+
+    def _index_file(self, path: str):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            self.errors.append(f"{path}: {e}")
+            return
+        name, pkg_parts = self._module_name(path)
+        mod = ModuleInfo(name=name, path=path, tree=tree, source=src)
+        _Indexer(mod, pkg_parts).visit(tree)
+        self.modules[name] = mod
+
+    # -- resolution helpers ----------------------------------------------- #
+    def resolve(self, mod: ModuleInfo, dotted: str) -> str:
+        """Expand the leading alias of a dotted path via the module's
+        import table ('onp.asarray' → 'numpy.asarray')."""
+        head, _, rest = dotted.partition(".")
+        target = mod.aliases.get(head)
+        if target is None:
+            return dotted
+        return f"{target}.{rest}" if rest else target
+
+    def lookup_function(self, full: str) -> Optional[FunctionInfo]:
+        """FunctionInfo for a fully resolved dotted path, if analyzed."""
+        modname, _, qual = full.rpartition(".")
+        while modname:
+            m = self.modules.get(modname)
+            if m is not None:
+                return m.functions.get(qual)
+            modname, _, head = modname.rpartition(".")
+            qual = f"{head}.{qual}"
+        return None
+
+    def lookup_class(self, full: str) -> Optional[ClassInfo]:
+        modname, _, cname = full.rpartition(".")
+        m = self.modules.get(modname)
+        if m is not None:
+            return m.classes.get(cname)
+        # re-exported through a package __init__? follow one alias hop.
+        if m is None and modname:
+            pkg = self.modules.get(modname) or self.modules.get(modname + ".__init__")
+            if pkg is not None:
+                tgt = pkg.aliases.get(cname)
+                if tgt and tgt != full:
+                    return self.lookup_class(tgt)
+        return None
+
+    def _class_ancestry(self, cls: ClassInfo, seen=None) -> List[ClassInfo]:
+        if seen is None:
+            seen = set()
+        out = []
+        for b in cls.bases:
+            resolved = self.resolve(cls.module, b)
+            cand = self.lookup_class(resolved) or cls.module.classes.get(b)
+            if cand is not None and id(cand) not in seen:
+                seen.add(id(cand))
+                out.append(cand)
+                out.extend(self._class_ancestry(cand, seen))
+        return out
+
+    # -- block subclasses -------------------------------------------------- #
+    def _resolve_block_classes(self):
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules.values():
+                for cls in mod.classes.values():
+                    for b in cls.bases:
+                        resolved = self.resolve(mod, b)
+                        tail = resolved.rpartition(".")[2]
+                        base_cls = self.lookup_class(resolved) or mod.classes.get(b)
+                        if not cls.is_block and (
+                                tail in BLOCK_ROOT_NAMES
+                                or (base_cls is not None and base_cls.is_block)):
+                            cls.is_block = True
+                            changed = True
+                        if not cls.is_hybrid and (
+                                tail in HYBRID_ROOT_NAMES
+                                or (base_cls is not None and base_cls.is_hybrid)):
+                            cls.is_hybrid = True
+                            changed = True
+
+    # -- jit wrapper fixpoint ---------------------------------------------- #
+    def _iter_calls(self, fn: FunctionInfo):
+        """Call nodes in fn's own body (nested defs excluded — they have
+        their own FunctionInfo; lambdas stay with the parent)."""
+        skip: Set[int] = set()
+        for child in ast.walk(fn.node):
+            if child is fn.node:
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(child):
+                    skip.add(id(sub))
+        for child in ast.walk(fn.node):
+            if isinstance(child, ast.Call) and id(child) not in skip:
+                yield child
+
+    def iter_own_nodes(self, fn: FunctionInfo):
+        """All AST nodes belonging to fn's own body (nested defs excluded)."""
+        skip: Set[int] = set()
+        for child in ast.walk(fn.node):
+            if child is fn.node:
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(child):
+                    skip.add(id(sub))
+        for child in ast.walk(fn.node):
+            if id(child) not in skip:
+                yield child
+
+    def is_jit_wrapper_call(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        d = dotted_name(call.func)
+        if d is None:
+            return False
+        resolved = self.resolve(mod, d)
+        if resolved in JIT_WRAPPERS:
+            return True
+        target = self.lookup_function(resolved)
+        return target is not None and target.is_jit_wrapper
+
+    @staticmethod
+    def _call_arg_names(call: ast.Call) -> List[str]:
+        names = [a.id for a in call.args if isinstance(a, ast.Name)]
+        names += [kw.value.id for kw in call.keywords
+                  if isinstance(kw.value, ast.Name)]
+        return names
+
+    @staticmethod
+    def _extract_statics(call: ast.Call) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+        """(static_argnums, static_argnames) constants from a jit call."""
+
+        def consts(node, typ):
+            if isinstance(node, ast.Constant) and isinstance(node.value, typ):
+                return (node.value,)
+            if isinstance(node, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in node.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, typ))
+            return ()
+
+        nums: Tuple[int, ...] = ()
+        names: Tuple[str, ...] = ()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                nums = consts(kw.value, int)
+            elif kw.arg == "static_argnames":
+                names = consts(kw.value, str)
+        return nums, names
+
+    @staticmethod
+    def _apply_statics(fn: "FunctionInfo",
+                       nums: Tuple[int, ...], names: Tuple[str, ...]):
+        pos = fn.node.args.posonlyargs + fn.node.args.args
+        for i in nums:
+            if 0 <= i < len(pos):
+                fn.static_params.add(pos[i].arg)
+        all_names = {a.arg for a in pos + fn.node.args.kwonlyargs}
+        fn.static_params.update(set(names) & all_names)
+
+    def _local_fn_aliases(self, fn: FunctionInfo) -> Dict[str, str]:
+        """Local `x = some_fn` / `x = functools.partial(some_fn, ...)`
+        bindings — so `pl.pallas_call(kernel, ...)` seeds the kernel def
+        even when it went through a local variable or a partial."""
+        out: Dict[str, str] = {}
+        for node in self.iter_own_nodes(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            v = node.value
+            tname = None
+            if isinstance(v, ast.Name):
+                tname = v.id
+            elif isinstance(v, ast.Call):
+                d = dotted_name(v.func)
+                if d is not None and self.resolve(fn.module, d) in (
+                        "functools.partial", "partial") and v.args:
+                    tname = dotted_name(v.args[0])
+            if tname is not None:
+                out[node.targets[0].id] = tname
+        return out
+
+    def _candidate_fn_args(self, fn: FunctionInfo, call: ast.Call) -> List[str]:
+        """Names plausibly naming a function among a call's arguments —
+        bare names plus the inner target of inline functools.partial."""
+        names = self._call_arg_names(call)
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(a, ast.Call):
+                d = dotted_name(a.func)
+                if d is not None and self.resolve(fn.module, d) in (
+                        "functools.partial", "partial") and a.args:
+                    inner = dotted_name(a.args[0])
+                    if inner is not None:
+                        names.append(inner)
+        return names
+
+    def _compute_jit_wrappers(self):
+        """f is a jit wrapper iff it passes one of its own parameters to
+        a known wrapper — transitive (`_program_jits(raw_fn)` chains)."""
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules.values():
+                for fn in mod.functions.values():
+                    if fn.is_jit_wrapper:
+                        continue
+                    params = {a.arg for a in (fn.node.args.posonlyargs
+                                              + fn.node.args.args
+                                              + fn.node.args.kwonlyargs)}
+                    for call in self._iter_calls(fn):
+                        if not self.is_jit_wrapper_call(mod, call):
+                            continue
+                        if any(n in params for n in self._call_arg_names(call)):
+                            fn.is_jit_wrapper = True
+                            fn.wrapper_statics = self._extract_statics(call)
+                            changed = True
+                            break
+
+    # -- seeds + reachability ---------------------------------------------- #
+    def _decorator_seeds(self, fn: FunctionInfo) -> bool:
+        for dec in fn.node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = dotted_name(target)
+            if d and self.resolve(fn.module, d) in JIT_WRAPPERS:
+                if isinstance(dec, ast.Call):
+                    self._apply_statics(fn, *self._extract_statics(dec))
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+            if isinstance(dec, ast.Call) and d is not None:
+                r = self.resolve(fn.module, d)
+                if r in ("functools.partial", "partial") and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    if inner and self.resolve(fn.module, inner) in JIT_WRAPPERS:
+                        self._apply_statics(fn, *self._extract_statics(dec))
+                        return True
+        return False
+
+    def _seed_functions(self) -> List[FunctionInfo]:
+        seeds: List[FunctionInfo] = []
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                if fn.cls is not None and (
+                        (fn.cls.is_hybrid and fn.name == "forward")
+                        or (fn.cls.is_block and fn.name == "hybrid_forward")):
+                    fn.trace_reason = "Block forward (runs under jit when hybridized)"
+                    seeds.append(fn)
+                elif self._decorator_seeds(fn):
+                    fn.trace_reason = "jit-decorated"
+                    seeds.append(fn)
+        # functions passed (by name, local alias, or inline partial) to a
+        # jit wrapper call anywhere
+        for mod in self.modules.values():
+            for caller in mod.functions.values():
+                local_aliases = None
+                for call in self._iter_calls(caller):
+                    if not self.is_jit_wrapper_call(mod, call):
+                        continue
+                    d = dotted_name(call.func)
+                    resolved_w = self.resolve(mod, d) if d else None
+                    if resolved_w in JIT_WRAPPERS:
+                        statics = self._extract_statics(call)
+                    else:
+                        wfn = self.lookup_function(resolved_w) if resolved_w else None
+                        statics = (wfn.wrapper_statics or ((), ())) if wfn else ((), ())
+                    if local_aliases is None:
+                        local_aliases = self._local_fn_aliases(caller)
+                    for n in self._candidate_fn_args(caller, call):
+                        n = local_aliases.get(n, n)
+                        target = (mod.functions.get(f"{caller.qualname}.{n}")
+                                  or mod.functions.get(n))
+                        if target is None:
+                            resolved = self.resolve(mod, n)
+                            target = self.lookup_function(resolved)
+                        if target is not None and not target.trace_reason:
+                            target.trace_reason = (
+                                f"passed to jit wrapper in {caller.qualname}")
+                            self._apply_statics(target, *statics)
+                            seeds.append(target)
+        return seeds
+
+    def _perstep_seeds(self) -> List[FunctionInfo]:
+        seeds = []
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                if fn.cls is None:
+                    if fn.name in PERSTEP_FUNCTION_NAMES:
+                        seeds.append(fn)
+                    continue
+                if fn.name not in PERSTEP_METHOD_NAMES:
+                    continue
+                names = [fn.cls.name] + [c.name for c in self._class_ancestry(fn.cls)]
+                if any(h in n for n in names for h in PERSTEP_CLASS_HINTS):
+                    seeds.append(fn)
+        return seeds
+
+    def _callees(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        out: List[FunctionInfo] = []
+        mod = fn.module
+        for call in self._iter_calls(fn):
+            d = dotted_name(call.func)
+            if d is None:
+                continue
+            if "." not in d:
+                # bare name: nested def, module-level def, or import
+                target = (mod.functions.get(f"{fn.qualname}.{d}")
+                          or mod.functions.get(d))
+                if target is None:
+                    resolved = self.resolve(mod, d)
+                    if resolved != d:
+                        target = self.lookup_function(resolved)
+                if target is not None:
+                    out.append(target)
+                continue
+            head, _, rest = d.partition(".")
+            if head == "self" and fn.cls is not None and "." not in rest:
+                target = fn.cls.methods.get(rest)
+                if target is None:
+                    for anc in self._class_ancestry(fn.cls):
+                        target = anc.methods.get(rest)
+                        if target is not None:
+                            break
+                if target is not None:
+                    out.append(target)
+                continue
+            resolved = self.resolve(mod, d)
+            target = self.lookup_function(resolved)
+            if target is not None:
+                out.append(target)
+        return out
+
+    def _compute_reachability(self):
+        seeds = self._seed_functions()
+        work = list(seeds)
+        for fn in work:
+            fn.trace_reachable = True
+        while work:
+            fn = work.pop()
+            for callee in self._callees(fn):
+                if not callee.trace_reachable:
+                    callee.trace_reachable = True
+                    callee.trace_reason = callee.trace_reason or (
+                        f"called from {fn.full_name}")
+                    work.append(callee)
+        work = self._perstep_seeds()
+        for fn in work:
+            fn.perstep_reachable = True
+        while work:
+            fn = work.pop()
+            for callee in self._callees(fn):
+                if not callee.perstep_reachable and not callee.trace_reachable:
+                    callee.perstep_reachable = True
+                    work.append(callee)
+
+    # -- public ------------------------------------------------------------ #
+    def iter_functions(self):
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                yield fn
+
+    def trace_reachable_functions(self) -> List[FunctionInfo]:
+        return [f for f in self.iter_functions() if f.trace_reachable]

@@ -1,0 +1,77 @@
+"""tpulint command line: ``python -m tools.tpulint <paths> [--strict]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage / analysis errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .analyzer import Project
+from .rules import ALL_RULES, run_rules
+from .suppressions import apply_suppressions
+
+
+def run(paths: List[str], select: Optional[List[str]] = None,
+        ignore: Optional[List[str]] = None, strict: bool = False):
+    """Analyze `paths`; returns (project, findings-after-suppression)."""
+    project = Project(paths)
+    active = set(select) if select else set(ALL_RULES)
+    if ignore:
+        active -= set(ignore)
+    findings = run_rules(project, active)
+    sources = {m.path: m.source for m in project.modules.values()}
+    findings = apply_suppressions(findings, sources, strict=strict)
+    return project, findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="Static analyzer for JAX/TPU tracing hazards "
+                    "(TPU001-TPU006; see docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="require a `-- reason` on every suppression")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule codes to run (default: all)")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated rule codes to skip")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-reachable", action="store_true",
+                    help="dump the trace-reachable function set and exit")
+    args = ap.parse_args(argv)
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    for code in (select or []) + (ignore or []):
+        if code not in ALL_RULES:
+            print(f"tpulint: unknown rule code {code!r}", file=sys.stderr)
+            return 2
+
+    project, findings = run(args.paths, select, ignore, args.strict)
+
+    if project.errors:
+        for e in project.errors:
+            print(f"tpulint: parse error: {e}", file=sys.stderr)
+        return 2
+
+    if args.show_reachable:
+        for fn in sorted(project.trace_reachable_functions(),
+                         key=lambda f: f.full_name):
+            print(f"{fn.full_name}  [{fn.trace_reason}]")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n_mod = len(project.modules)
+        n_reach = len(project.trace_reachable_functions())
+        tail = (f"tpulint: {len(findings)} finding(s) in {n_mod} module(s) "
+                f"({n_reach} trace-reachable functions)")
+        print(tail, file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,599 @@
+"""tpulint rules TPU001–TPU006.
+
+Each rule is a function ``(project, fn_info) -> [Finding]`` over one
+analyzed function.  Scope discipline:
+
+* TPU001/TPU002/TPU004/TPU005 need trace context — they only fire in
+  ``trace_reachable`` functions (TPU002 additionally in
+  ``perstep_reachable`` ones, explicit-sync patterns only);
+* TPU003 (key reuse) and TPU006 (mutable defaults) are correctness
+  bugs anywhere — they run unconditionally.
+
+The shared taint engine marks values derived from the function's array
+parameters; static metadata (``x.shape``/``x.ndim``/``x.dtype``/
+``len(x)``/``is None``) is explicitly *untainted* so shape-polymorphic
+Python (ubiquitous in Gluon forwards) stays quiet.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .analyzer import Finding, FunctionInfo, Project, dotted_name
+
+# parameters that are flags/contexts by convention, never arrays
+NEVER_TAINTED_PARAMS = {"self", "cls", "F", "training", "mode", "ctx",
+                        "context", "deterministic", "axis", "name", "prefix"}
+
+# attribute reads that are static under trace (aval metadata)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                "itemsize", "nbytes", "weak_type"}
+
+# calls whose result is host-static even on tracer args
+STATIC_FUNCS = {"len", "isinstance", "type", "hasattr", "id", "callable",
+                "getattr", "repr"}
+
+# method calls that launder a tracer into a host value — TPU002's job,
+# not TPU004's (flagging the branch too would double-report)
+SYNC_METHODS = {"item", "asnumpy", "tolist", "wait_to_read",
+                "block_until_ready"}
+
+SYNC_FUNCS = {"jax.device_get", "jax.block_until_ready"}
+
+MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop", "clear",
+                   "update", "setdefault", "popitem", "add", "discard",
+                   "appendleft", "extendleft"}
+
+# jax.random producers (return keys) vs everything else (consume keys)
+KEY_PRODUCERS = {"PRNGKey", "key", "split", "fold_in", "wrap_key_data",
+                 "clone", "key_data"}
+
+
+def _fn_params(node: ast.FunctionDef):
+    return node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+
+
+# annotations that prove a parameter is a host value, not an array
+_HOST_ANNOTATIONS = {"int", "bool", "str", "float", "bytes", "Callable",
+                     "Mesh", "Path"}
+
+
+def _host_annotation(ann) -> bool:
+    if ann is None:
+        return False
+    name = None
+    if isinstance(ann, ast.Name):
+        name = ann.id
+    elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value
+    if name is None:
+        return False
+    # `cfg: HybridConfig`-style hyperparameter bundles are host objects
+    return name in _HOST_ANNOTATIONS or name.endswith(("Config", "Settings"))
+
+
+def _is_numpy(project: Project, fn: FunctionInfo, d: str) -> Optional[str]:
+    """Resolved dotted path if `d` is a host-numpy reference, else None."""
+    resolved = project.resolve(fn.module, d)
+    if resolved == "numpy" or resolved.startswith("numpy."):
+        return resolved
+    return None
+
+
+# ---------------------------------------------------------------------------
+# taint engine (shared by TPU002 / TPU004)
+# ---------------------------------------------------------------------------
+
+
+class Taint:
+    """Per-function forward taint over array-valued names.
+
+    Seeds: positional parameters without defaults (conventional flag
+    names excluded).  ``*args`` is a *container* — the tuple itself is
+    host-static (its length is fixed at trace time) but its elements
+    are tainted.
+    """
+
+    def __init__(self, project: Project, fn: FunctionInfo):
+        self.project = project
+        self.fn = fn
+        args = fn.node.args
+        pos = args.posonlyargs + args.args
+        n_defaults = len(args.defaults)
+        seeded = pos[: len(pos) - n_defaults] if n_defaults else pos
+        self.tainted: Set[str] = {
+            a.arg for a in seeded
+            if a.arg not in NEVER_TAINTED_PARAMS
+            and not _host_annotation(a.annotation)}
+        if fn.cls is not None and pos and pos[0].arg in self.tainted:
+            self.tainted.discard(pos[0].arg)
+        # static_argnums/static_argnames at the jit boundary are host
+        # values by contract
+        self.tainted -= fn.static_params
+        self.containers: Set[str] = set()
+        if args.vararg is not None:
+            self.containers.add(args.vararg.arg)
+        # `args`/`kwargs` as PLAIN params are tuple/dict containers by
+        # convention: host-static themselves, tainted elements
+        for a in pos:
+            if a.arg in ("args", "kwargs"):
+                self.tainted.discard(a.arg)
+                self.containers.add(a.arg)
+
+    # -- expression taint -------------------------------------------------- #
+    def expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in self.containers:
+                return True
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            return self.call(node)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are host-static identity checks
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            # comparisons against string constants are host dispatch
+            # (`s.op == "_group"`) — tracers never compare to strings
+            if all(isinstance(c, ast.Constant) and isinstance(c.value, str)
+                   for c in node.comparators):
+                return False
+            return self.expr(node.left) or any(self.expr(c)
+                                               for c in node.comparators)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.test) or self.expr(node.body) \
+                or self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            # a comprehension over tainted data yields tainted elements
+            return self.expr(node.elt) or any(self.expr(g.iter)
+                                              for g in node.generators)
+        if isinstance(node, ast.DictComp):
+            return self.expr(node.key) or self.expr(node.value) \
+                or any(self.expr(g.iter) for g in node.generators)
+        return False
+
+    def call(self, node: ast.Call) -> bool:
+        d = dotted_name(node.func)
+        if d is not None:
+            resolved = self.project.resolve(self.fn.module, d)
+            if resolved in STATIC_FUNCS or d in STATIC_FUNCS:
+                return False
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in SYNC_METHODS:
+                return False        # host value — TPU002 territory
+            if node.func.attr in STATIC_ATTRS:
+                return False
+            if self.expr(node.func.value):
+                return True         # method on a tainted value
+        return any(self.expr(a) for a in node.args) \
+            or any(self.expr(kw.value) for kw in node.keywords)
+
+    # -- statement walk ----------------------------------------------------- #
+    def assign(self, target: ast.AST, value_tainted: bool):
+        if isinstance(target, ast.Name):
+            if value_tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, value_tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, value_tainted)
+        # attribute/subscript writes don't create new taintable names
+
+    def process_stmt(self, stmt: ast.stmt):
+        """Propagate taint through one statement (no recursion into
+        compound bodies — the rule drivers own the traversal order)."""
+        if isinstance(stmt, ast.Assign):
+            t = self.expr(stmt.value)
+            for tgt in stmt.targets:
+                self.assign(tgt, t)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self.assign(stmt.target, self.expr(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.target, ast.Name):
+                if self.expr(stmt.value) or stmt.target.id in self.tainted:
+                    self.tainted.add(stmt.target.id)
+        elif isinstance(stmt, ast.For):
+            if self.expr(stmt.iter) or (
+                    isinstance(stmt.iter, ast.Name)
+                    and stmt.iter.id in self.containers):
+                self.assign(stmt.target, True)
+
+
+def _walk_stmts(body: List[ast.stmt]):
+    """Statements in execution-ish order, descending into compound
+    statements but NOT into nested function/class defs."""
+    for stmt in body:
+        yield stmt
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if sub and not isinstance(stmt, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef,
+                                             ast.ClassDef)):
+                yield from _walk_stmts(sub)
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from _walk_stmts(h.body)
+
+
+def _own_exprs(stmt: ast.stmt):
+    """Expression nodes evaluated directly by `stmt` — excludes nested
+    statements (they are visited on their own by `_walk_stmts`) and
+    nested function/class defs."""
+
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler,
+                                  ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            yield child
+            yield from rec(child)
+
+    yield from rec(stmt)
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — host numpy under trace
+# ---------------------------------------------------------------------------
+
+
+def check_tpu001(project: Project, fn: FunctionInfo,
+                 claimed: Set[int]) -> List[Finding]:
+    if not fn.trace_reachable:
+        return []
+    out = []
+    for node in project.iter_own_nodes(fn):
+        if not isinstance(node, ast.Call) or id(node) in claimed:
+            continue
+        d = dotted_name(node.func)
+        if d is None:
+            continue
+        resolved = _is_numpy(project, fn, d)
+        if resolved is not None:
+            out.append(Finding(
+                "TPU001",
+                f"host-numpy call `{d}` (→ {resolved}) in trace-reachable "
+                f"code — constant-folds at trace time or breaks on tracers; "
+                f"use jax.numpy",
+                fn.module.path, node.lineno, node.col_offset, fn.full_name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — implicit host sync
+# ---------------------------------------------------------------------------
+
+
+def check_tpu002(project: Project, fn: FunctionInfo,
+                 claimed: Set[int]) -> List[Finding]:
+    in_trace = fn.trace_reachable
+    in_step = fn.perstep_reachable
+    if not (in_trace or in_step):
+        return []
+    out: List[Finding] = []
+    where = "trace-reachable" if in_trace else "per-step"
+    taint = Taint(project, fn)
+
+    def add(node, what):
+        out.append(Finding(
+            "TPU002",
+            f"implicit host sync `{what}` in {where} code — forces the "
+            f"device queue to drain (tens of ms on TPU); keep values on "
+            f"device or move the sync off the step path",
+            fn.module.path, node.lineno, node.col_offset, fn.full_name))
+
+    for stmt in _walk_stmts(fn.node.body):
+        for node in _own_exprs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            # .item() / .asnumpy() / .tolist() / .wait_to_read()
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_METHODS:
+                add(node, f".{node.func.attr}()")
+                claimed.add(id(node))
+                continue
+            if d is not None:
+                resolved = project.resolve(fn.module, d)
+                if resolved in SYNC_FUNCS:
+                    add(node, d)
+                    claimed.add(id(node))
+                    continue
+                if in_trace and resolved in ("numpy.asarray", "numpy.array"):
+                    add(node, d)
+                    claimed.add(id(node))
+                    continue
+            # float(x)/int(x)/bool(x) on array-derived values
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int", "bool") \
+                    and len(node.args) == 1 and taint.expr(node.args[0]):
+                add(node, f"{node.func.id}(...)")
+                claimed.add(id(node))
+        taint.process_stmt(stmt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+class _KeyState:
+    __slots__ = ("uses",)
+
+    def __init__(self):
+        self.uses: Dict[str, List[int]] = {}   # key var -> consume line numbers
+
+
+def check_tpu003(project: Project, fn: FunctionInfo) -> List[Finding]:
+    """Linear abstract interpretation: loop bodies run twice so a key
+    consumed once-per-iteration still counts as reused."""
+    out: List[Finding] = []
+    reported: Set[int] = set()
+
+    def is_random_call(node: ast.Call) -> Optional[str]:
+        d = dotted_name(node.func)
+        if d is None:
+            return None
+        resolved = project.resolve(fn.module, d)
+        if resolved.startswith("jax.random."):
+            return resolved.rpartition(".")[2]
+        return None
+
+    def scan(body: List[ast.stmt], uses: Dict[str, int]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            # expression-level: find consumes and producers in eval order
+            for node in _own_exprs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = is_random_call(node)
+                if tail is None or tail in KEY_PRODUCERS:
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name):
+                    k = node.args[0].id
+                    if k not in uses:
+                        continue
+                    uses[k] += 1
+                    if uses[k] > 1 and node.lineno not in reported:
+                        reported.add(node.lineno)
+                        out.append(Finding(
+                            "TPU003",
+                            f"PRNG key `{k}` consumed more than once without "
+                            f"an intervening jax.random.split — identical "
+                            f"random draws; split the key per use",
+                            fn.module.path, node.lineno, node.col_offset,
+                            fn.full_name))
+            # assignments from producers (re)arm tracking
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                produced = False
+                if isinstance(value, ast.Call):
+                    tail = is_random_call(value)
+                    produced = tail in KEY_PRODUCERS if tail else False
+                if isinstance(value, ast.Subscript) \
+                        and isinstance(value.value, ast.Call):
+                    tail = is_random_call(value.value)
+                    produced = produced or (tail in KEY_PRODUCERS
+                                            if tail else False)
+                for tgt in targets:
+                    names = []
+                    if isinstance(tgt, ast.Name):
+                        names = [tgt.id]
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        names = [e.id for e in tgt.elts
+                                 if isinstance(e, ast.Name)]
+                    for n in names:
+                        if produced:
+                            uses[n] = 0
+                        else:
+                            uses.pop(n, None)
+            # control flow
+            if isinstance(stmt, (ast.For, ast.While)):
+                for _ in range(2):          # two symbolic iterations
+                    scan(stmt.body, uses)
+                scan(stmt.orelse, uses)
+            elif isinstance(stmt, ast.If):
+                left = dict(uses)
+                scan(stmt.body, left)
+                right = dict(uses)
+                scan(stmt.orelse, right)
+                for k in set(left) | set(right):
+                    uses[k] = max(left.get(k, 0), right.get(k, 0))
+            elif isinstance(stmt, ast.Try):
+                scan(stmt.body, uses)
+                for h in stmt.handlers:
+                    scan(h.body, uses)
+                scan(stmt.finalbody, uses)
+            elif isinstance(stmt, ast.With):
+                scan(stmt.body, uses)
+
+    scan(fn.node.body, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU004 — Python control flow on tracers
+# ---------------------------------------------------------------------------
+
+
+def check_tpu004(project: Project, fn: FunctionInfo) -> List[Finding]:
+    if not fn.trace_reachable:
+        return []
+    out: List[Finding] = []
+    taint = Taint(project, fn)
+
+    def flag(node, kind):
+        out.append(Finding(
+            "TPU004",
+            f"Python `{kind}` on a tracer-derived value in trace-reachable "
+            f"code — raises TracerBoolConversionError under jit (or bakes "
+            f"in one branch); use jax.lax.cond/select or jnp.where",
+            fn.module.path, node.lineno, node.col_offset, fn.full_name))
+
+    for stmt in _walk_stmts(fn.node.body):
+        if isinstance(stmt, ast.If) and taint.expr(stmt.test):
+            flag(stmt, "if")
+        elif isinstance(stmt, ast.While) and taint.expr(stmt.test):
+            flag(stmt, "while")
+        elif isinstance(stmt, ast.Assert) and taint.expr(stmt.test):
+            flag(stmt, "assert")
+        taint.process_stmt(stmt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU005 — side effects under jit
+# ---------------------------------------------------------------------------
+
+
+def _local_names(fn: FunctionInfo) -> Set[str]:
+    """Names assigned in fn's own body (params excluded on purpose:
+    mutating an argument container under jit is still a side effect)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn.node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn.node:
+            out.add(node.name)
+            continue
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        elif isinstance(node, ast.For):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for sub in ast.walk(item.optional_vars):
+                        if isinstance(sub, ast.Name):
+                            out.add(sub.id)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                for sub in ast.walk(gen.target):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+    return out
+
+
+def check_tpu005(project: Project, fn: FunctionInfo) -> List[Finding]:
+    if not fn.trace_reachable:
+        return []
+    out: List[Finding] = []
+    local = _local_names(fn)
+
+    def flag(node, msg):
+        out.append(Finding("TPU005", msg, fn.module.path, node.lineno,
+                           node.col_offset, fn.full_name))
+
+    for node in project.iter_own_nodes(fn):
+        if isinstance(node, ast.Global):
+            flag(node, "`global` write under jit — the rebind happens at "
+                       "trace time, not per call; thread state through "
+                       "function arguments instead")
+        elif isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d == "print":
+                flag(node, "`print` under jit runs at trace time only "
+                           "(once per compilation); use jax.debug.print "
+                           "for per-call output")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in MUTATOR_METHODS
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id not in local):
+                n = node.func.value.id
+                flag(node, f"mutation of non-local `{n}.{node.func.attr}()` "
+                           f"under jit — appending/assigning tracers into "
+                           f"host containers leaks tracers out of the trace")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU006 — mutable defaults on Block signatures
+# ---------------------------------------------------------------------------
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        return d in ("list", "dict", "set", "bytearray",
+                     "collections.OrderedDict", "OrderedDict",
+                     "collections.defaultdict", "defaultdict")
+    return False
+
+
+def check_tpu006(project: Project, fn: FunctionInfo) -> List[Finding]:
+    if fn.cls is None or not fn.cls.is_block:
+        return []
+    out = []
+    args = fn.node.args
+    for default in list(args.defaults) + [d for d in args.kw_defaults
+                                          if d is not None]:
+        if _is_mutable_default(default):
+            out.append(Finding(
+                "TPU006",
+                f"mutable default argument in Block subclass method "
+                f"`{fn.qualname}` — shared across every instance (and "
+                f"every retrace); default to None and create inside",
+                fn.module.path, default.lineno, default.col_offset,
+                fn.full_name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+ALL_RULES = ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006")
+
+
+def run_rules(project: Project, select: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    active = set(select) if select else set(ALL_RULES)
+    for fn in project.iter_functions():
+        claimed: Set[int] = set()
+        if "TPU002" in active:
+            findings.extend(check_tpu002(project, fn, claimed))
+        if "TPU001" in active:
+            findings.extend(check_tpu001(project, fn, claimed))
+        if "TPU003" in active:
+            findings.extend(check_tpu003(project, fn))
+        if "TPU004" in active:
+            findings.extend(check_tpu004(project, fn))
+        if "TPU005" in active:
+            findings.extend(check_tpu005(project, fn))
+        if "TPU006" in active:
+            findings.extend(check_tpu006(project, fn))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings

@@ -1,0 +1,87 @@
+"""Suppression directives for tpulint.
+
+Syntax (all forms take a comma-separated code list, or no list to
+suppress every rule; the ``-- reason`` tail is free text, REQUIRED
+under ``--strict``):
+
+    x = onp.dot(a, b)   # tpulint: disable=TPU001 -- host fallback, tiny
+    # tpulint: disable-next=TPU002,TPU004 -- deliberate sync point
+    y = float(loss)
+    # tpulint: disable-file=TPU005 -- this module is a debug shim
+
+Directive parsing is line-based on the raw source (AST nodes drop
+comments), so a directive also covers findings whose node *starts* on
+the directive's line — multi-line statements suppress at the line the
+finding points at.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+from .analyzer import Finding
+
+_DIRECTIVE = re.compile(
+    r"#\s*tpulint:\s*(?P<kind>disable(?:-next|-file)?)"
+    r"(?:\s*=\s*(?P<codes>[A-Z0-9, ]+))?"
+    r"(?P<reason>\s*--\s*\S.*)?")
+
+ALL = "ALL"
+
+
+class Suppressions:
+    """Per-file directive table + bookkeeping for `--strict` checks."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.line_codes: Dict[int, Set[str]] = {}
+        self.file_codes: Set[str] = set()
+        self.missing_reason: List[Tuple[int, str]] = []
+        self.used: Set[int] = set()
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _DIRECTIVE.search(line)
+            if m is None:
+                continue
+            codes = {c.strip() for c in (m.group("codes") or ALL).split(",")
+                     if c.strip()}
+            kind = m.group("kind")
+            if m.group("reason") is None:
+                self.missing_reason.append((i, kind))
+            if kind == "disable":
+                self.line_codes.setdefault(i, set()).update(codes)
+            elif kind == "disable-next":
+                self.line_codes.setdefault(i + 1, set()).update(codes)
+            elif kind == "disable-file":
+                self.file_codes.update(codes)
+
+    def suppresses(self, finding: Finding) -> bool:
+        if ALL in self.file_codes or finding.code in self.file_codes:
+            return True
+        codes = self.line_codes.get(finding.line)
+        if codes is not None and (ALL in codes or finding.code in codes):
+            self.used.add(finding.line)
+            return True
+        return False
+
+    def strict_findings(self) -> List[Finding]:
+        """TPU000 diagnostics: suppressions without a reason."""
+        return [
+            Finding("TPU000",
+                    f"`# tpulint: {kind}` without a `-- reason` "
+                    f"(required in --strict mode)",
+                    self.path, line, 0)
+            for line, kind in self.missing_reason
+        ]
+
+
+def apply_suppressions(findings: List[Finding],
+                       sources: Dict[str, str],
+                       strict: bool = False) -> List[Finding]:
+    tables = {path: Suppressions(path, src) for path, src in sources.items()}
+    kept = [f for f in findings
+            if f.path not in tables or not tables[f.path].suppresses(f)]
+    if strict:
+        for t in tables.values():
+            kept.extend(t.strict_findings())
+        kept.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return kept
