@@ -9,7 +9,16 @@ cd "$(dirname "$0")/.."
 echo "tpulint: analyzing incubator_mxnet_tpu/"
 python -m tools.tpulint incubator_mxnet_tpu/ --strict
 
-echo "compileall: incubator_mxnet_tpu/ tools/ tests/"
-python -m compileall -q incubator_mxnet_tpu/ tools/ tests/
+# the telemetry package carries the no-host-sync contract (its spans
+# and metric updates run inside trace-reachable hot paths) — lint it
+# explicitly so a path-scoped invocation can never silently skip it
+echo "tpulint: analyzing incubator_mxnet_tpu/telemetry/"
+python -m tools.tpulint incubator_mxnet_tpu/telemetry/ --strict
+
+echo "compileall: incubator_mxnet_tpu/ tools/ tests/ ci/"
+python -m compileall -q incubator_mxnet_tpu/ tools/ tests/ ci/
+
+echo "telemetry smoke: 3-step train with MXTPU_TELEMETRY_DUMP=1"
+JAX_PLATFORMS=cpu python ci/telemetry_smoke.py
 
 echo "lint gates: OK"

@@ -1,0 +1,93 @@
+"""CI smoke: a 3-step tiny train with MXTPU_TELEMETRY_DUMP=1 must
+produce a parseable Prometheus dump containing the acceptance series
+(trainer_step_seconds buckets, kvstore_push_bytes_total,
+retraces_total), a valid JSONL, and a merged chrome trace with Trainer
+spans nested under the step span.
+
+Run as `python ci/telemetry_smoke.py` (ci/lint.sh invokes it).
+"""
+import json
+import os
+import sys
+import tempfile
+
+# runnable as `python ci/telemetry_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# env must be set BEFORE the package import: _configure_from_env reads
+# it at import time (this is exactly the user-facing flow under test)
+_DIR = tempfile.mkdtemp(prefix="mxtpu_tel_smoke_")
+os.environ["MXTPU_TELEMETRY_DUMP"] = "1"
+os.environ["MXTPU_TELEMETRY_DIR"] = _DIR
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import autograd, telemetry  # noqa: E402
+from incubator_mxnet_tpu.gluon import Trainer, nn  # noqa: E402
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray  # noqa: E402
+
+
+def main() -> int:
+    assert telemetry.enabled(), "MXTPU_TELEMETRY_DUMP=1 did not enable"
+
+    mx.random.seed(0)
+    net = nn.Dense(4)
+    net.initialize()
+    # fuse_step=False drives the kvstore push/pull path
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      fuse_step=False)
+    x = NDArray(jnp.ones((2, 3)))
+    for _ in range(3):
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        trainer.step(2)
+
+    paths = telemetry.dump()  # the atexit dump would fire too; be explicit
+
+    # -- Prometheus text: required series present and well-formed ------- #
+    prom = open(paths["prom"]).read()
+    for needle in ("trainer_step_seconds_bucket{le=",
+                   'trainer_step_seconds_bucket{le="+Inf"}',
+                   "trainer_step_seconds_count 3",
+                   "kvstore_push_bytes_total",
+                   "retraces_total"):
+        if needle not in prom:
+            print(f"FAIL: {needle!r} missing from {paths['prom']}")
+            return 1
+    for line in prom.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        float(value)  # every sample line must end in a number
+        assert name_part, line
+
+    # -- JSONL: every line parses --------------------------------------- #
+    n = 0
+    with open(paths["jsonl"]) as f:
+        for raw in f:
+            rec = json.loads(raw)
+            assert "name" in rec and "type" in rec, rec
+            n += 1
+    assert n > 0, "empty JSONL"
+
+    # -- chrome trace: Trainer spans nested under trainer/step ---------- #
+    trace = json.load(open(paths["trace"]))
+    evs = trace["traceEvents"]
+    assert any(e["name"] == "trainer/step" for e in evs), "no step span"
+    nested = [e for e in evs
+              if e.get("args", {}).get("parent") == "trainer/step"]
+    assert nested, "no span nested under trainer/step"
+
+    print(f"telemetry smoke: OK ({n} jsonl metrics, {len(evs)} trace "
+          f"events, dir {_DIR})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

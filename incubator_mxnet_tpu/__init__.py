@@ -19,6 +19,7 @@ __version__ = "0.1.0"
 
 from . import base
 from .base import MXNetError
+from . import telemetry
 from .context import Context, cpu, cpu_pinned, current_context, gpu, num_gpus, num_tpus, tpu
 from . import ndarray
 from . import ndarray as nd
@@ -67,7 +68,7 @@ __all__ = [
     "lr_scheduler", "initializer", "init", "metric", "kvstore", "kv", "io",
     "recordio", "image", "profiler", "amp", "parallel", "ops", "models",
     "runtime", "module", "mod", "random", "callback", "test_utils",
-    "visualization", "viz", "mon",
+    "visualization", "viz", "mon", "telemetry",
     "Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
     "num_gpus", "num_tpus", "NDArray", "MXNetError",
 ]

@@ -7,6 +7,8 @@ import collections
 import logging
 import time
 
+from . import telemetry
+
 __all__ = ["BatchEndParam", "Speedometer", "MFUMeter", "do_checkpoint",
            "log_train_metric", "LogValidationMetricsCallback",
            "module_checkpoint"]
@@ -34,7 +36,15 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                elapsed = time.time() - self.tic
+                speed = self.frequent * self.batch_size / elapsed
+                if telemetry.enabled():
+                    # same numbers the log line prints, as metrics; the
+                    # printed output stays byte-identical
+                    telemetry.gauge("speedometer_samples_per_sec") \
+                        .set(speed)
+                    telemetry.histogram("speedometer_step_seconds") \
+                        .observe(elapsed / self.frequent)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

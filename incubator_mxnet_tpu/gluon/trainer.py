@@ -21,12 +21,14 @@ optimizer runs per-parameter — identical observable semantics.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Union
 
 import jax
 
 from .. import kvstore as kvs_mod
 from .. import optimizer as opt_mod
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray.ndarray import raw
 from .parameter import Parameter, ParameterDict
@@ -180,6 +182,7 @@ class Trainer:
 
         return jax.tree_util.tree_map(put, state)
 
+    @telemetry.span("trainer/shard_inputs")
     def _shard_inputs(self, input_raws):
         """Place uncommitted/unsharded batch inputs on the data axis.
 
@@ -419,9 +422,14 @@ class Trainer:
         Blocks on the (max_inflight)-steps-old leaf; a leaf that was
         donated into a later step is already consumed — skip it."""
         self._inflight.append(leaf)
-        while len(self._inflight) > self._max_inflight:
-            old = self._inflight.popleft()
-            _wait_or_surface(old)
+        if telemetry.enabled():
+            telemetry.gauge("trainer_inflight_steps") \
+                .set(len(self._inflight))
+        if len(self._inflight) > self._max_inflight:
+            with telemetry.span("trainer/throttle"):
+                while len(self._inflight) > self._max_inflight:
+                    old = self._inflight.popleft()
+                    _wait_or_surface(old)
 
     def _throttle_bytes(self, leaf, held_bytes: int):
         """Byte-budgeted run-ahead bound for the one-program step.
@@ -435,6 +443,13 @@ class Trainer:
         programs drain HALF the queue with ONE sync every depth/2 steps
         instead of paying one sync per step."""
         self._inflight.append(leaf)
+        if telemetry.enabled():
+            # host ints only (held_bytes comes from aval metadata) — the
+            # run-ahead HBM pressure this throttle exists to bound
+            telemetry.gauge("throttle_held_bytes") \
+                .set(int(held_bytes) * len(self._inflight))
+            telemetry.gauge("trainer_inflight_steps") \
+                .set(len(self._inflight))
         depth = max(2, self._max_inflight_bytes // max(int(held_bytes), 1))
         if self._user_inflight_cap is not None:
             depth = min(depth, self._user_inflight_cap)
@@ -447,10 +462,11 @@ class Trainer:
                 self._inflight.popleft()
             return
         if len(self._inflight) >= depth:
-            last = None
-            while len(self._inflight) > depth // 2:
-                last = self._inflight.popleft()
-            _wait_or_surface(last)
+            with telemetry.span("trainer/throttle"):
+                last = None
+                while len(self._inflight) > depth // 2:
+                    last = self._inflight.popleft()
+                _wait_or_surface(last)
 
     # ------------------------------------------------------------------ #
     # multi-step chaining (chain_steps > 1): K canonical steps buffered
@@ -614,6 +630,12 @@ class Trainer:
             "failed; the step never executed (see the raised flush error)")
 
     def _flush_chain(self):
+        if not self._chain_buf:
+            return
+        with telemetry.span("trainer/chain_flush"):
+            self._flush_chain_impl()
+
+    def _flush_chain_impl(self):
         buf, st = self._chain_buf, self._chain_state
         if not buf:
             return
@@ -752,6 +774,7 @@ class Trainer:
         pending.bwd_done = True
         pending.pullback = None
 
+    @telemetry.span("trainer/fused_step")
     def _fused_step(self):
         opt = self._optimizer
         self._sync_states()
@@ -802,7 +825,26 @@ class Trainer:
     # public step API
     # ------------------------------------------------------------------ #
     def step(self, batch_size, ignore_stale_grad=False):
-        """allreduce + optimizer update; grads rescaled by 1/batch_size."""
+        """allreduce + optimizer update; grads rescaled by 1/batch_size.
+
+        With telemetry enabled, each call opens a ``trainer/step`` span
+        (sub-spans mark which path ran), advances the telemetry step
+        index, and records `trainer_step_seconds` — the HOST-side
+        dispatch latency of the step; device execution overlaps
+        asynchronously, so end-to-end step time is what the throttle
+        sub-span absorbs once run-ahead saturates (no forced sync —
+        see docs/observability.md)."""
+        if not telemetry.enabled():
+            return self._step_impl(batch_size, ignore_stale_grad)
+        telemetry.mark_step()
+        t0 = time.perf_counter()
+        with telemetry.span("trainer/step"):
+            self._step_impl(batch_size, ignore_stale_grad)
+        telemetry.histogram("trainer_step_seconds") \
+            .observe(time.perf_counter() - t0)
+        telemetry.counter("trainer_steps_total").inc()
+
+    def _step_impl(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -813,11 +855,14 @@ class Trainer:
             self._fused_step()
             return
         if self._can_fuse_packed_compression():
-            self._allreduce_grads_packed()
+            with telemetry.span("trainer/allreduce_packed"):
+                self._allreduce_grads_packed()
             self._fused_step()
             return
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with telemetry.span("trainer/allreduce"):
+            self._allreduce_grads()
+        with telemetry.span("trainer/update"):
+            self._update(ignore_stale_grad)
 
     # ------------------------------------------------------------------ #
     # single-program step: fwd + vjp + update in ONE donated jit
@@ -847,6 +892,7 @@ class Trainer:
                 return None
         return pending
 
+    @telemetry.span("trainer/full_step")
     def _try_full_step(self, pending) -> bool:
         opt = self._optimizer
         block = pending.block

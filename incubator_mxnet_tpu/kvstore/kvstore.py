@@ -21,11 +21,13 @@ pushed values; `pushpull` fuses both.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..base import MXNetError, Registry
 from ..ndarray.ndarray import NDArray, raw, wrap
 from .gradient_compression import GradientCompression
@@ -76,8 +78,23 @@ class KVStore:
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        if not telemetry.enabled():
+            return self._push_one(key, value)
+        t0 = time.perf_counter()
+        self._push_one(key, value)
+        # DISPATCH latency: collectives/compression enqueue async, the
+        # device work overlaps — no sync is forced to measure this
+        telemetry.histogram("kvstore_push_seconds") \
+            .observe(time.perf_counter() - t0)
+
+    def _push_one(self, key, value):
+        tel = telemetry.enabled()
         vals = value if isinstance(value, (list, tuple)) else [value]
         summed = _sum_values([wrap(v) for v in vals])
+        if tel:
+            # payload size from aval metadata only (shape × itemsize)
+            telemetry.counter("kvstore_push_bytes_total") \
+                .inc(telemetry.nbytes_of(summed))
         if self._is_dist and jax.process_count() > 1:
             from ..parallel import collectives
 
@@ -89,14 +106,30 @@ class KVStore:
                 from jax.experimental import multihost_utils
 
                 packed = self._compression.compress_packed(key, summed)
+                if tel:
+                    wire = telemetry.nbytes_of(packed)
+                    telemetry.counter("kvstore_wire_bytes_total").inc(wire)
+                    telemetry.gauge("kvstore_compression_ratio").set(
+                        telemetry.nbytes_of(summed) / max(wire, 1))
                 gathered = multihost_utils.process_allgather(packed)
                 summed = sum(
                     self._compression.decompress(gathered[p], summed.shape)
                     for p in range(gathered.shape[0]))
             else:
                 # cross-host reduction over the DCN data axis
+                if tel:
+                    telemetry.counter("kvstore_wire_bytes_total") \
+                        .inc(telemetry.nbytes_of(summed))
                 summed = collectives.allreduce_across_processes(summed)
         elif self._compression is not None:
+            if tel:
+                # in-process compress() returns the quantized values
+                # UNPACKED (no wire) — report the logical 2-bit ratio
+                nvals = 1
+                for d in getattr(summed, "shape", ()):
+                    nvals *= int(d)
+                telemetry.gauge("kvstore_compression_ratio").set(
+                    telemetry.nbytes_of(summed) / max(nvals // 4, 1))
             summed = self._compression.compress(key, summed)
         if self._updater is not None:
             # server-side-optimizer parity: run updater, store weights
@@ -116,12 +149,19 @@ class KVStore:
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
+        tel = telemetry.enabled()
+        t0 = time.perf_counter() if tel else 0.0
         val = self._store.get(key)
         if val is None:
             raise MXNetError(f"kvstore key {key} was not initialized")
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
             o._set_data(val.astype(o._data.dtype))
+        if tel:
+            telemetry.counter("kvstore_pull_bytes_total") \
+                .inc(telemetry.nbytes_of(val) * len(outs))
+            telemetry.histogram("kvstore_pull_seconds") \
+                .observe(time.perf_counter() - t0)
 
     def pushpull(self, key, value, out=None, priority: int = 0):
         self.push(key, value, priority)

@@ -19,6 +19,7 @@ import numpy as onp
 from . import initializer as init_mod
 from . import metric as metric_mod
 from . import optimizer as opt_mod
+from . import telemetry
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, wrap
 
@@ -181,6 +182,7 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     # -- execution ------------------------------------------------------- #
+    @telemetry.span("module/forward")
     def forward(self, data_batch, is_train=None):
         bindings = dict(self._arg_params)
         for name, arr in zip(self._data_names, data_batch.data):
@@ -195,6 +197,7 @@ class Module(BaseModule):
         self._outputs = out if isinstance(out, list) else [out]
         self._last_bindings = bindings
 
+    @telemetry.span("module/backward")
     def backward(self, out_grads=None):
         import jax
 

@@ -17,6 +17,8 @@ from __future__ import annotations
 import re
 from typing import Callable, List, Optional, Tuple
 
+from . import telemetry
+
 __all__ = ["Monitor"]
 
 
@@ -40,6 +42,8 @@ class Monitor:
         self.step = 0
         self.activated = False
         self.queue: List[Tuple[int, str, object]] = []
+        # raw captured arrays awaiting the batched host fetch in toc()
+        self._pending: List[Tuple[int, str, object]] = []
         self._installed = []
 
     # -- installation ---------------------------------------------------- #
@@ -95,11 +99,11 @@ class Monitor:
             nm = name if len(leaves) == 1 else f"{name}{i}"
             if not self.re_pattern.match(nm):
                 continue
-            try:
-                arr = leaf.asnumpy() if isinstance(leaf, NDArray) else leaf
-                self.queue.append((self.step, nm, self.stat_func(arr)))
-            except Exception:
-                pass  # lazy/aborted values never block training
+            # DEFER the host transfer: capturing only stashes the raw
+            # array (no sync mid-forward); toc() fetches every captured
+            # array in ONE jax.device_get instead of a sync per layer
+            raw = leaf._data if isinstance(leaf, NDArray) else leaf
+            self._pending.append((self.step, nm, raw))
 
     # -- control ----------------------------------------------------------- #
     def tic(self):
@@ -111,16 +115,54 @@ class Monitor:
         if self.step % self.interval == 0:
             self.activated = True
             self.queue = []
+            self._pending = []
         self.step += 1
         return self
 
     def toc(self) -> List[Tuple[int, str, object]]:
-        """Stop collecting; returns [(step, name, stat), ...]."""
+        """Stop collecting; returns [(step, name, stat), ...].
+
+        This is the ONE deliberate host sync of the monitor: all arrays
+        captured since tic() come over in a single batched
+        jax.device_get (the per-layer asnumpy() the reference did would
+        serialize the device queue once per hooked block)."""
         if not self.activated:
             return []
         self.activated = False
+        pending, self._pending = self._pending, []
         res = list(self.queue)
         self.queue = []
+        if pending:
+            import jax
+
+            try:
+                fetched = jax.device_get([r for _, _, r in pending])
+            except Exception:
+                # one bad element poisons a batched fetch — fall back to
+                # per-item so lazy/aborted values never block training
+                fetched = []
+                for _, _, r in pending:
+                    try:
+                        fetched.append(jax.device_get(r))
+                    except Exception:
+                        fetched.append(None)
+            tel = telemetry.enabled()
+            statname = "mean_abs" if self.stat_func is _default_stat \
+                else getattr(self.stat_func, "__name__", "stat")
+            for (step, nm, _), arr in zip(pending, fetched):
+                if arr is None:
+                    continue
+                try:
+                    stat = self.stat_func(arr)
+                except Exception:
+                    continue
+                res.append((step, nm, stat))
+                if tel:
+                    try:
+                        telemetry.gauge(
+                            f"monitor/{nm}/{statname}").set(float(stat))
+                    except (TypeError, ValueError):
+                        pass  # non-numeric stat_func results stay print-only
         if self.sort:
             res.sort(key=lambda t: t[1])
         return res

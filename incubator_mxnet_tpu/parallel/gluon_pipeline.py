@@ -37,10 +37,13 @@ key still advances).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from .. import telemetry
 
 __all__ = ["GluonPipeline"]
 
@@ -238,6 +241,24 @@ class GluonPipeline:
         NDArray — fetch it (`float(loss.asnumpy())`) only when you need
         the value; an unconditional per-step host sync would serialize
         the device queue (docs/performance.md)."""
+        if not telemetry.enabled():
+            return self._train_step_impl(x, targets)
+        t0 = time.perf_counter()
+        with telemetry.span("pipeline/train_step"):
+            out = self._train_step_impl(x, targets)
+        dt = time.perf_counter() - t0
+        # dispatch latency of the whole 1F1B step; multiplied by the
+        # analytic bubble fraction this gives the per-stage bubble-time
+        # estimate (exact per-tick device times live in the XLA trace —
+        # reading them here would force a sync)
+        telemetry.histogram("pipeline_train_step_seconds").observe(dt)
+        n = self._mesh.shape[self._axis]
+        frac = (n - 1) / (self._M + n - 1)
+        telemetry.gauge("pipeline_stage_bubble_seconds_est",
+                        labels={"schedule": "1f1b"}).set(dt * frac)
+        return out
+
+    def _train_step_impl(self, x, targets):
         from .. import random as _random
         from ..ndarray.ndarray import NDArray, wrap
 

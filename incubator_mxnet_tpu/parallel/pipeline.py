@@ -45,7 +45,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import telemetry
+
 __all__ = ["pipeline_forward", "pipeline_apply", "pipeline_train_1f1b"]
+
+
+def _record_schedule(schedule: str, n_stages: int, n_micro: int) -> None:
+    """Publish the schedule's analytic shape as gauges (host ints only).
+
+    The bubble is a property of the tick grid — GPipe: ``n-1`` idle
+    ticks per stage of ``M+n-1``; 1F1B: ``2(n-1)`` of ``2(M+n-1)`` —
+    so the FRACTION is exact without timing anything on device.
+    Device-side per-tick times belong to the XLA trace
+    (profiler.device_op_table); multiplying the fraction into a
+    host-measured step time is done where a step clock exists
+    (GluonPipeline.train_step)."""
+    lab = {"schedule": schedule}
+    idle_per_stage = (n_stages - 1) * (2 if schedule == "1f1b" else 1)
+    total_ticks = (n_micro + n_stages - 1) * (2 if schedule == "1f1b" else 1)
+    telemetry.gauge("pipeline_stages", labels=lab).set(n_stages)
+    telemetry.gauge("pipeline_microbatches", labels=lab).set(n_micro)
+    telemetry.gauge("pipeline_bubble_ticks", labels=lab).set(idle_per_stage)
+    telemetry.gauge("pipeline_bubble_fraction", labels=lab).set(
+        idle_per_stage / max(total_ticks, 1))
 
 
 def pipeline_forward(stage_fn: Callable, stage_params, x_microbatches,
@@ -153,7 +175,12 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
     param_spec = jax.tree_util.tree_map(lambda _: P(axis_name), all_stage_params)
     fn = shard_map(inner, mesh=mesh,
                    in_specs=(param_spec, P()), out_specs=P(), check_vma=False)
-    out = fn(all_stage_params, xm)
+    if telemetry.enabled():
+        _record_schedule("gpipe", mesh.shape[axis_name], num_microbatches)
+        with telemetry.span("pipeline/gpipe_apply"):
+            out = fn(all_stage_params, xm)
+    else:
+        out = fn(all_stage_params, xm)
     return out.reshape((B,) + out.shape[2:])
 
 
@@ -437,7 +464,12 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
     fn = shard_map(inner, mesh=mesh,
                    in_specs=(param_spec, P(), P(), lp_spec),
                    out_specs=(P(), param_spec, lp_spec, P()))
-    loss, grads, dlp, dx = fn(all_stage_params, xm, tm, lp)
+    if telemetry.enabled():
+        _record_schedule("1f1b", n_static, M)
+        with telemetry.span("pipeline/train_1f1b"):
+            loss, grads, dlp, dx = fn(all_stage_params, xm, tm, lp)
+    else:
+        loss, grads, dlp, dx = fn(all_stage_params, xm, tm, lp)
     out = (loss, grads)
     if loss_params is not None:
         out += (dlp,)

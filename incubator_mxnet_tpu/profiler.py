@@ -18,7 +18,7 @@ from typing import Dict, List, Optional
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
            "Task", "Frame", "Marker", "scope", "trace_annotation", "state",
-           "device_op_table", "device_op_summary"]
+           "device_op_table", "device_op_summary", "record_host_event"]
 
 _config = {
     "profile_all": False,
@@ -175,6 +175,20 @@ class Marker:
         _events.append({"name": self.name, "cat": "marker", "ph": "i",
                         "ts": time.perf_counter() * 1e6, "pid": os.getpid(),
                         "tid": threading.get_ident(), "s": "p"})
+
+
+def record_host_event(name: str, cat: str, t0: float, dur: float) -> None:
+    """Append a finished host-side scope into the chrome-trace stream
+    (times in perf_counter seconds).  The doorway `telemetry.span` uses
+    to merge its spans with the profiler's own Task/Frame events in ONE
+    timeline; a no-op unless the profiler is collecting."""
+    if _running or _config["aggregate_stats"]:
+        _events.append({
+            "name": name, "cat": cat, "ph": "X",
+            "ts": t0 * 1e6, "dur": dur * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+        })
+        _agg[name].append(dur)
 
 
 scope = _Scope

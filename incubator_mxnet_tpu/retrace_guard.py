@@ -41,7 +41,9 @@ from typing import Dict, Iterable, Optional, Set
 
 from .base import MXNetError
 
-__all__ = ["RetraceError", "RetraceGuard", "DEFAULT_BUDGET", "PROGRAM_NAMES"]
+__all__ = ["RetraceError", "RetraceGuard", "DEFAULT_BUDGET", "PROGRAM_NAMES",
+           "subscribe_compiles", "unsubscribe_compiles",
+           "install_telemetry_feed", "remove_telemetry_feed"]
 
 # Loggers that announce a compilation.  pxla carries the callable name in
 # args[0]; dispatch only carries elapsed times, so pxla is the one we tap.
@@ -66,22 +68,124 @@ class RetraceError(MXNetError):
     """A watched callable recompiled more often than its budget allows."""
 
 
-class _CompileCounter(logging.Handler):
-    """Logging handler feeding compile events into a RetraceGuard."""
+class _CompileLogHandler(logging.Handler):
+    """Logging handler forwarding compile events to monitor sinks."""
 
-    def __init__(self, guard: "RetraceGuard"):
+    def __init__(self, monitor: "_CompileLogMonitor"):
         super().__init__(level=logging.DEBUG)
-        self._guard = guard
+        self._monitor = monitor
 
     def emit(self, record: logging.LogRecord) -> None:  # pragma: no branch
         try:
             if (isinstance(record.msg, str)
                     and record.msg.startswith(_COMPILE_MSG_PREFIX)
                     and record.args):
-                self._guard._record(str(record.args[0]))
+                self._monitor._dispatch(str(record.args[0]))
         except Exception:
             # never let accounting break the compile it observes
             pass
+
+
+class _CompileLogMonitor:
+    """Shared tap on JAX's compile log, fanning events out to sinks.
+
+    The logger hook (handler install + level lowering) is managed
+    refcounted: installed when the first sink subscribes, restored when
+    the last unsubscribes — so a RetraceGuard and the telemetry feed
+    (`retraces_total`) can observe the same compiles concurrently
+    without fighting over the logger state.
+    """
+
+    def __init__(self):
+        self._sinks = []
+        self._lock = threading.Lock()
+        self._handler: Optional[_CompileLogHandler] = None
+        self._prev_level: Optional[int] = None
+        self._prev_propagate: bool = True
+
+    def _dispatch(self, name: str) -> None:
+        for sink in list(self._sinks):
+            try:
+                sink(name)
+            except Exception:
+                pass
+
+    def subscribe(self, sink) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+            if self._handler is not None:
+                return
+            logger = logging.getLogger(_COMPILE_LOGGER)
+            self._handler = _CompileLogHandler(self)
+            # the compile line is emitted at DEBUG unless jax_log_compiles
+            # is set; lower the logger (not the root) so it reaches our
+            # handler, and stop propagation so the records we forced into
+            # existence don't spam the root handlers
+            if logger.getEffectiveLevel() > logging.DEBUG:
+                self._prev_level = logger.level
+                self._prev_propagate = logger.propagate
+                logger.propagate = False
+                logger.setLevel(logging.DEBUG)
+            logger.addHandler(self._handler)
+
+    def unsubscribe(self, sink) -> None:
+        with self._lock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                return
+            if self._sinks or self._handler is None:
+                return
+            logger = logging.getLogger(_COMPILE_LOGGER)
+            logger.removeHandler(self._handler)
+            self._handler = None
+            if self._prev_level is not None:
+                logger.setLevel(self._prev_level)
+                logger.propagate = self._prev_propagate
+                self._prev_level = None
+
+
+_monitor = _CompileLogMonitor()
+
+
+def subscribe_compiles(sink) -> None:
+    """Register ``sink(program_name)`` for every observed compilation."""
+    _monitor.subscribe(sink)
+
+
+def unsubscribe_compiles(sink) -> None:
+    _monitor.unsubscribe(sink)
+
+
+def _telemetry_sink(name: str) -> None:
+    # lazy import: telemetry.enable() is what installs this feed, so the
+    # module is importable by the first event; the counter/gauge calls
+    # no-op if telemetry was disabled again before an event arrives
+    from . import telemetry
+
+    telemetry.counter("retraces_total").inc()
+    telemetry.gauge("retrace_compiles", labels={"program": name}).inc()
+
+
+_feed_installed = False
+
+
+def install_telemetry_feed() -> None:
+    """Feed compile counts into telemetry (`retraces_total` counter +
+    per-program `retrace_compiles` gauges) — guard-independent, so a
+    production run with telemetry enabled sees compile churn without
+    wrapping anything in a RetraceGuard."""
+    global _feed_installed
+    if not _feed_installed:
+        _feed_installed = True
+        _monitor.subscribe(_telemetry_sink)
+
+
+def remove_telemetry_feed() -> None:
+    global _feed_installed
+    if _feed_installed:
+        _feed_installed = False
+        _monitor.unsubscribe(_telemetry_sink)
 
 
 class RetraceGuard:
@@ -107,9 +211,6 @@ class RetraceGuard:
         self.exempt = set(exempt)
         self.counts: Counter = Counter()
         self._lock = threading.Lock()
-        self._handler: Optional[_CompileCounter] = None
-        self._prev_level: Optional[int] = None
-        self._prev_propagate: bool = True
 
     # -- accounting --------------------------------------------------
     def _record(self, name: str) -> None:
@@ -143,28 +244,10 @@ class RetraceGuard:
 
     # -- context management ------------------------------------------
     def __enter__(self) -> "RetraceGuard":
-        logger = logging.getLogger(_COMPILE_LOGGER)
-        self._handler = _CompileCounter(self)
-        # the compile line is emitted at DEBUG unless jax_log_compiles is
-        # set; lower the logger (not the root) so it reaches our handler,
-        # and stop propagation so the records we forced into existence
-        # don't spam the root handlers
-        if logger.getEffectiveLevel() > logging.DEBUG:
-            self._prev_level = logger.level
-            self._prev_propagate = logger.propagate
-            logger.propagate = False
-            logger.setLevel(logging.DEBUG)
-        logger.addHandler(self._handler)
+        _monitor.subscribe(self._record)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
-        logger = logging.getLogger(_COMPILE_LOGGER)
-        if self._handler is not None:
-            logger.removeHandler(self._handler)
-            self._handler = None
-        if self._prev_level is not None:
-            logger.setLevel(self._prev_level)
-            logger.propagate = self._prev_propagate
-            self._prev_level = None
+        _monitor.unsubscribe(self._record)
         if exc_type is None:
             self.check()

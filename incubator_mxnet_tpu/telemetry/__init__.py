@@ -1,0 +1,149 @@
+"""Unified telemetry: metrics registry + step-span tracer + exporters.
+
+The observability backbone every instrumented layer reports through
+(ISSUE 2 tentpole; docs/observability.md is the catalog):
+
+* ``telemetry.counter/gauge/histogram(name, labels=)`` — process-wide
+  registry handles (create once, update in the hot path);
+* ``telemetry.span(name)`` — nested host-side spans grouped into
+  per-step traces, bridged into the profiler's chrome-trace stream and
+  (while a device trace runs) the XLA TensorBoard timeline;
+* ``telemetry.dump(dir)`` — Prometheus text + JSONL + merged chrome
+  trace.
+
+OFF by default: every update checks one module flag and returns —
+instrumented hot paths (Trainer.step, KVStore push/pull) measurably
+cost nothing while disabled (the bench gate in the acceptance
+criteria).  Enable programmatically (``telemetry.enable()``) or via
+env:
+
+* ``MXTPU_TELEMETRY=1``          enable collection
+* ``MXTPU_TELEMETRY_DUMP=1``     enable + dump on process exit
+* ``MXTPU_TELEMETRY_DIR=path``   dump directory (default: cwd)
+* ``MXTPU_TELEMETRY_INTERVAL=N`` also dump every N trainer steps
+* ``MXTPU_TELEMETRY_SPAN_BUF=N`` span ring-buffer size (default 16384)
+
+THE NO-HOST-SYNC RULE: instrumentation must never force a device sync
+— record only host clocks (time.perf_counter), aval metadata
+(shape/dtype byte counts), or values that are already host data.  The
+whole package, this module included, is tpulint-gated in CI.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Dict, Optional
+
+from . import exporters, registry as _registry_mod, tracer
+from .registry import (Counter, DEFAULT_BUCKETS, Gauge, Histogram, Registry,
+                       log_buckets)
+from .tracer import SpanRecord, current_step, mark_step, span, spans
+
+__all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
+           "span", "spans", "mark_step", "current_step", "dump", "reset",
+           "get_registry", "Counter", "Gauge", "Histogram", "Registry",
+           "SpanRecord", "DEFAULT_BUCKETS", "log_buckets", "nbytes_of",
+           "exporters", "tracer"]
+
+_default_registry = Registry()
+_dump_interval = 0
+_atexit_registered = False
+
+
+def get_registry() -> Registry:
+    return _default_registry
+
+
+def enabled() -> bool:
+    return _registry_mod._enabled
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+    return _default_registry.counter(name, labels)
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+    return _default_registry.gauge(name, labels)
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None,
+              buckets=None) -> Histogram:
+    return _default_registry.histogram(name, labels, buckets=buckets)
+
+
+def nbytes_of(arr) -> int:
+    """Byte size from aval metadata only — never touches device data
+    (safe on tracers, lazy NDArrays and non-addressable global arrays)."""
+    import math as _math
+
+    shape = getattr(arr, "shape", None)
+    if shape is None:
+        return 0
+    try:
+        import numpy as onp
+
+        itemsize = int(onp.dtype(arr.dtype).itemsize)
+    except Exception:
+        itemsize = 2  # bfloat16 and friends under older numpy
+    return _math.prod(shape) * itemsize if shape else itemsize
+
+
+def _on_step(step: int) -> None:
+    if _dump_interval > 0 and step % _dump_interval == 0:
+        dump()
+
+
+def enable(dump_interval: Optional[int] = None) -> None:
+    """Turn collection on; optionally dump every `dump_interval` steps."""
+    global _dump_interval
+    _registry_mod._enabled = True
+    if dump_interval is not None:
+        _dump_interval = int(dump_interval)
+    tracer._on_step = _on_step
+    # feed compile events (retraces) into the registry
+    from .. import retrace_guard
+
+    retrace_guard.install_telemetry_feed()
+
+
+def disable() -> None:
+    _registry_mod._enabled = False
+    tracer._on_step = None
+    from .. import retrace_guard
+
+    retrace_guard.remove_telemetry_feed()
+
+
+def dump(dirpath: Optional[str] = None) -> Dict[str, str]:
+    """Write Prometheus + JSONL + merged chrome trace; returns paths."""
+    return exporters.dump(_default_registry, dirpath)
+
+
+def reset() -> None:
+    """Zero all metrics and drop collected spans (registrations stay)."""
+    _default_registry.reset()
+    tracer.clear()
+
+
+def _atexit_dump() -> None:  # pragma: no cover — exercised by ci smoke
+    try:
+        if enabled():
+            dump()
+    except Exception:
+        pass
+
+
+def _configure_from_env() -> None:
+    global _dump_interval, _atexit_registered
+    env = os.environ
+    want_dump = env.get("MXTPU_TELEMETRY_DUMP", "0") == "1"
+    want_on = env.get("MXTPU_TELEMETRY", "0") == "1" or want_dump
+    interval = int(env.get("MXTPU_TELEMETRY_INTERVAL", "0") or 0)
+    if want_on:
+        enable(dump_interval=interval)
+    if want_dump and not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_atexit_dump)
+
+
+_configure_from_env()

@@ -1,0 +1,150 @@
+"""Telemetry exporters: Prometheus text format, JSONL, chrome trace.
+
+Dump targets (all host-side, no device syncs):
+
+* ``prometheus_text()`` — the Prometheus text exposition format
+  (``*_bucket{le=...}`` / ``_sum`` / ``_count`` for histograms); metric
+  names are sanitized to the Prometheus grammar (``monitor/fc1/mean``
+  → ``monitor_fc1_mean``) with the original name preserved in a
+  ``# HELP`` line.
+* ``jsonl_lines()`` — one JSON object per metric; histograms carry
+  bucket bounds/counts AND p50/p95/p99 so downstream BENCH tooling
+  reads percentiles without re-deriving them.
+* ``chrome_trace()`` — the tracer's finished spans as chrome://tracing
+  ``X`` events, MERGED with any events the profiler collected (its
+  Task/Frame scopes share the perf_counter clock, so the two streams
+  interleave correctly in one timeline).
+* ``dump(dirpath)`` — writes all three (telemetry.prom /
+  telemetry.jsonl / telemetry_trace.json) and returns the paths.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+from typing import Dict, List, Optional
+
+from . import tracer as _tracer
+from .registry import Counter, Gauge, Histogram, Registry
+
+__all__ = ["prometheus_text", "jsonl_lines", "chrome_trace", "dump"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_labels(labels: Dict[str, str], extra: Optional[str] = None) -> str:
+    parts = [f'{_NAME_RE.sub("_", k)}="{str(v)}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return repr(float(v))
+
+
+def prometheus_text(registry: Registry) -> str:
+    lines: List[str] = []
+    seen_type = set()
+    for m in registry.metrics():
+        pname = _prom_name(m.name)
+        if pname not in seen_type:
+            seen_type.add(pname)
+            if pname != m.name:
+                lines.append(f"# HELP {pname} source metric {m.name!r}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+        if isinstance(m, Histogram):
+            snap = m.snapshot()
+            cum = 0
+            for bound, c in zip(list(m.bounds) + [math.inf],
+                                snap["buckets"]):
+                cum += c
+                le = _prom_labels(m.labels, f'le="{_fmt(bound)}"')
+                lines.append(f"{pname}_bucket{le} {cum}")
+            lab = _prom_labels(m.labels)
+            lines.append(f"{pname}_sum{lab} {_fmt(snap['sum'])}")
+            lines.append(f"{pname}_count{lab} {snap['count']}")
+        elif isinstance(m, (Counter, Gauge)):
+            lab = _prom_labels(m.labels)
+            lines.append(f"{pname}{lab} {_fmt(m.value)}")
+    return "\n".join(lines) + "\n"
+
+
+def jsonl_lines(registry: Registry) -> List[str]:
+    now = time.time()
+    step = _tracer.current_step()
+    out = []
+    for m in registry.metrics():
+        rec = {"ts": now, "step": step, "name": m.name, "type": m.kind}
+        if m.labels:
+            rec["labels"] = dict(m.labels)
+        rec.update(m.snapshot())
+        out.append(json.dumps(rec))
+    return out
+
+
+def chrome_trace() -> dict:
+    """Merged chrome://tracing dict: telemetry spans + profiler events."""
+    events = []
+    for s in _tracer.spans():
+        events.append({
+            "name": s.name, "cat": "telemetry", "ph": "X",
+            "ts": s.t0 * 1e6, "dur": s.dur * 1e6,
+            "pid": os.getpid(), "tid": s.tid,
+            "args": {"step": s.step, "depth": s.depth,
+                     **({"parent": s.parent} if s.parent else {})},
+        })
+    from .. import profiler
+
+    for ev in profiler._events:
+        # the tracer already mirrors finished spans into the profiler
+        # stream while it is recording — skip those to avoid duplicates
+        if ev.get("cat") != "telemetry":
+            events.append(dict(ev))
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def dump(registry: Registry, dirpath: Optional[str] = None) -> Dict[str, str]:
+    """Write telemetry.prom + telemetry.jsonl + telemetry_trace.json.
+
+    dirpath defaults to ``MXTPU_TELEMETRY_DIR`` (else the cwd); it is
+    created if missing.  Returns {"prom": path, "jsonl": path,
+    "trace": path}.
+    """
+    dirpath = dirpath or os.environ.get("MXTPU_TELEMETRY_DIR", ".")
+    os.makedirs(dirpath, exist_ok=True)
+    paths = {}
+
+    prom_path = os.path.join(dirpath, "telemetry.prom")
+    with open(prom_path, "w") as f:
+        f.write(prometheus_text(registry))
+    paths["prom"] = prom_path
+
+    jsonl_path = os.path.join(dirpath, "telemetry.jsonl")
+    with open(jsonl_path, "w") as f:
+        for line in jsonl_lines(registry):
+            f.write(line + "\n")
+    paths["jsonl"] = jsonl_path
+
+    trace_path = os.path.join(dirpath, "telemetry_trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(chrome_trace(), f)
+    paths["trace"] = trace_path
+    return paths

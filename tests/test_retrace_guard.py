@@ -83,3 +83,34 @@ def test_stable_training_loop_under_budget():
     # every program compiled at most a few times total, nowhere near budget
     assert watched, "guard saw no program compilations at all"
     assert all(c <= 8 for c in watched.values()), watched
+
+
+def test_telemetry_feed_counts_compiles():
+    """With telemetry on, every compile feeds retraces_total and the
+    per-program retrace_compiles gauge — concurrently with (and without
+    disturbing) the conftest guard's own subscription."""
+    from incubator_mxnet_tpu import telemetry
+
+    telemetry.enable()
+    telemetry.get_registry().clear()
+    try:
+        step = _make_step()
+        for n in range(1, 4):              # 3 distinct shapes -> 3 compiles
+            step(jnp.ones((n,)))
+        assert telemetry.counter("retraces_total").value >= 3
+        g = telemetry.get_registry().get("retrace_compiles",
+                                         {"program": "storm_step"})
+        assert g is not None and g.value >= 3
+    finally:
+        telemetry.get_registry().clear()
+        telemetry.disable()
+
+
+def test_feed_removed_with_disable():
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.retrace_guard import _monitor
+
+    telemetry.enable()
+    n_subs = len(_monitor._sinks)
+    telemetry.disable()
+    assert len(_monitor._sinks) == n_subs - 1

@@ -1,0 +1,338 @@
+"""Telemetry subsystem: registry semantics, histogram percentiles, span
+nesting, exporter formats, the disabled no-op path, and the Trainer
+integration (real step() reporting through the registry)."""
+import json
+import math
+
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, telemetry
+from incubator_mxnet_tpu.gluon import Trainer, nn
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.telemetry import exporters
+from incubator_mxnet_tpu.telemetry.registry import (Counter, Gauge, Histogram,
+                                                    Registry, log_buckets)
+
+
+@pytest.fixture
+def tel():
+    """Enabled telemetry with a clean slate, restored to OFF after."""
+    telemetry.enable()
+    telemetry.get_registry().clear()
+    telemetry.tracer.clear()
+    yield telemetry
+    telemetry.get_registry().clear()
+    telemetry.tracer.clear()
+    telemetry.disable()
+
+
+# --------------------------------------------------------------------- #
+# registry semantics
+# --------------------------------------------------------------------- #
+def test_counter_gauge_basics(tel):
+    c = tel.counter("reqs_total")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    g = tel.gauge("depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    assert g.value == 5.0
+
+
+def test_get_or_create_is_idempotent_and_label_keyed(tel):
+    a = tel.counter("x_total", labels={"k": "a"})
+    b = tel.counter("x_total", labels={"k": "b"})
+    assert a is not b
+    assert tel.counter("x_total", labels={"k": "a"}) is a
+    # label order must not matter
+    g1 = tel.gauge("y", labels={"p": "1", "q": "2"})
+    g2 = tel.gauge("y", labels={"q": "2", "p": "1"})
+    assert g1 is g2
+
+
+def test_kind_conflict_raises(tel):
+    tel.counter("dual")
+    with pytest.raises(TypeError, match="already registered as counter"):
+        tel.gauge("dual")
+
+
+def test_reset_zeroes_but_keeps_registrations(tel):
+    c = tel.counter("z_total")
+    c.inc(9)
+    tel.reset()
+    assert tel.counter("z_total") is c
+    assert c.value == 0.0
+
+
+# --------------------------------------------------------------------- #
+# histogram
+# --------------------------------------------------------------------- #
+def test_log_buckets_cover_range():
+    b = log_buckets(1e-3, 1e1, per_decade=2)
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] >= 1e1
+    assert list(b) == sorted(b)
+
+
+def test_histogram_counts_and_overflow(tel):
+    h = tel.histogram("lat", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(56.05)
+    assert h.bucket_counts() == [1, 2, 1, 1]  # last = +Inf overflow
+
+
+def test_histogram_percentiles_within_observed_range(tel):
+    h = tel.histogram("step_s")
+    vals = [0.01 * (i + 1) for i in range(100)]  # 0.01 .. 1.0
+    for v in vals:
+        h.observe(v)
+    p = h.percentiles()
+    assert 0.01 <= p["p50"] <= 1.0
+    assert p["p50"] < p["p95"] <= p["p99"]
+    # interpolation never exceeds the observed extremes
+    assert p["p99"] <= max(vals)
+    assert h.percentile(0.0) >= min(vals)
+
+
+def test_histogram_empty_is_nan(tel):
+    assert math.isnan(tel.histogram("never").percentile(0.5))
+
+
+# --------------------------------------------------------------------- #
+# disabled path is a no-op
+# --------------------------------------------------------------------- #
+def test_disabled_updates_are_dropped():
+    telemetry.disable()
+    r = Registry()
+    c = r.counter("off_total")
+    g = r.gauge("off_g")
+    h = r.histogram("off_h")
+    c.inc()
+    g.set(5)
+    h.observe(1.0)
+    assert c.value == 0.0 and g.value == 0.0 and h.count == 0
+
+
+def test_disabled_span_records_nothing():
+    telemetry.disable()
+    telemetry.tracer.clear()
+    with telemetry.span("ghost"):
+        pass
+    assert telemetry.spans() == []
+
+
+def test_decorator_bound_while_disabled_follows_toggle(tel):
+    tel.disable()
+
+    @telemetry.span("late_bind")
+    def fn():
+        return 42
+
+    assert fn() == 42
+    assert telemetry.spans() == []
+    tel.enable()
+    assert fn() == 42
+    assert [s.name for s in telemetry.spans()] == ["late_bind"]
+
+
+# --------------------------------------------------------------------- #
+# span nesting / steps
+# --------------------------------------------------------------------- #
+def test_span_nesting_depth_and_parent(tel):
+    with tel.span("outer"):
+        with tel.span("inner"):
+            pass
+    recs = {s.name: s for s in tel.spans()}
+    assert recs["inner"].depth == 1 and recs["inner"].parent == "outer"
+    assert recs["outer"].depth == 0 and recs["outer"].parent is None
+    # inner finished first, and is contained in outer's interval
+    assert recs["outer"].t0 <= recs["inner"].t0
+    assert recs["inner"].t0 + recs["inner"].dur \
+        <= recs["outer"].t0 + recs["outer"].dur + 1e-9
+
+
+def test_mark_step_groups_spans(tel):
+    tel.mark_step()
+    with tel.span("a"):
+        pass
+    tel.mark_step()
+    with tel.span("b"):
+        pass
+    assert [s.name for s in tel.spans(step=1)] == ["a"]
+    assert [s.name for s in tel.spans(step=2)] == ["b"]
+    assert tel.current_step() == 2
+
+
+# --------------------------------------------------------------------- #
+# exporters
+# --------------------------------------------------------------------- #
+def test_prometheus_text_format(tel):
+    tel.counter("bytes_total", labels={"dir": "push"}).inc(128)
+    tel.gauge("monitor/fc1/mean_abs").set(0.5)
+    h = tel.histogram("lat_s", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    text = exporters.prometheus_text(tel.get_registry())
+    assert '# TYPE bytes_total counter' in text
+    assert 'bytes_total{dir="push"} 128.0' in text
+    # slashes sanitized, original kept in HELP
+    assert "# HELP monitor_fc1_mean_abs" in text
+    assert "monitor_fc1_mean_abs 0.5" in text
+    # cumulative buckets ending at +Inf == count
+    assert 'lat_s_bucket{le="0.1"} 1' in text
+    assert 'lat_s_bucket{le="1.0"} 2' in text
+    assert 'lat_s_bucket{le="+Inf"} 2' in text
+    assert "lat_s_count 2" in text
+
+
+def test_jsonl_lines_parse_and_carry_percentiles(tel):
+    tel.counter("n_total").inc(3)
+    h = tel.histogram("d_s")
+    h.observe(0.2)
+    recs = [json.loads(l) for l in exporters.jsonl_lines(tel.get_registry())]
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["n_total"]["value"] == 3.0
+    assert by_name["d_s"]["count"] == 1
+    assert by_name["d_s"]["p50"] == pytest.approx(0.2, rel=0.3)
+
+
+def test_dump_writes_all_three_files(tel, tmp_path):
+    tel.counter("one_total").inc()
+    with tel.span("dumped"):
+        pass
+    paths = tel.dump(str(tmp_path))
+    assert "one_total 1.0" in open(paths["prom"]).read()
+    lines = [json.loads(l) for l in open(paths["jsonl"])]
+    assert lines
+    trace = json.load(open(paths["trace"]))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "dumped" in names
+
+
+def test_chrome_trace_merges_profiler_events(tel):
+    from incubator_mxnet_tpu import profiler
+
+    was = profiler._config["aggregate_stats"]
+    profiler.set_config(aggregate_stats=True)
+    try:
+        with tel.span("host_side"):
+            pass
+        profiler.record_host_event("prof_ev", "event", 0.0, 0.001)
+    finally:
+        profiler.set_config(aggregate_stats=was)
+    trace = exporters.chrome_trace()
+    cats = {e["name"]: e.get("cat") for e in trace["traceEvents"]}
+    assert cats.get("host_side") == "telemetry"
+    assert cats.get("prof_ev") == "event"  # profiler events interleave
+    # the span was mirrored into the profiler stream too — the merge
+    # must dedup it, not show it twice
+    assert sum(1 for e in trace["traceEvents"]
+               if e["name"] == "host_side") == 1
+
+
+# --------------------------------------------------------------------- #
+# integration: Trainer / Speedometer / Monitor
+# --------------------------------------------------------------------- #
+def test_trainer_step_reports_metrics_and_nested_spans(tel):
+    mx.random.seed(0)
+    net = nn.Dense(4)
+    net.initialize()
+    # fuse_step=False exercises the kvstore push/pull path
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 fuse_step=False)
+    x = NDArray(jnp.ones((2, 3)))
+    for _ in range(3):
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        tr.step(2)
+    assert tel.histogram("trainer_step_seconds").count == 3
+    assert tel.counter("trainer_steps_total").value == 3
+    assert tel.counter("kvstore_push_bytes_total").value > 0
+    assert tel.counter("kvstore_pull_bytes_total").value > 0
+    assert tel.histogram("kvstore_push_seconds").count > 0
+    by_name = {}
+    for s in tel.spans():
+        by_name.setdefault(s.name, s)
+    assert "trainer/step" in by_name
+    inner = by_name.get("trainer/allreduce") or by_name.get("trainer/update")
+    assert inner is not None and inner.parent == "trainer/step"
+    assert tel.current_step() == 3
+
+
+def test_speedometer_reports_through_telemetry(tel, caplog):
+    import collections
+    import logging
+
+    from incubator_mxnet_tpu import callback
+
+    P = collections.namedtuple("P", ["epoch", "nbatch", "eval_metric",
+                                     "locals"])
+    sp = callback.Speedometer(batch_size=8, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for i in range(1, 5):
+            sp(P(0, i, None, None))
+    g = tel.get_registry().get("speedometer_samples_per_sec")
+    assert g is not None and g.value > 0
+    h = tel.get_registry().get("speedometer_step_seconds")
+    assert h is not None and h.count == 2
+    # the printed line format is unchanged
+    assert any("Speed:" in r.message and "samples/sec" in r.message
+               for r in caplog.records)
+
+
+def test_monitor_batches_host_fetch_and_sets_gauges(tel, monkeypatch):
+    import jax
+
+    from incubator_mxnet_tpu.monitor import Monitor
+
+    calls = []
+    real = jax.device_get
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    mon = Monitor(interval=1)
+    mon.tic()
+    mon.activated = True
+    mon._capture_tree("fc1_output", NDArray(jnp.ones((2, 3))))
+    mon._capture_tree("fc2_output", NDArray(2 * jnp.ones((4,))))
+    res = mon.toc()
+    assert [(n, v) for _, n, v in res] == [("fc1_output", 1.0),
+                                           ("fc2_output", 2.0)]
+    # ONE batched transfer for both captured arrays
+    assert len(calls) == 1
+    g = tel.get_registry().get("monitor/fc1_output/mean_abs")
+    assert g is not None and g.value == pytest.approx(1.0)
+
+
+def test_pipeline_schedule_gauges(tel):
+    from incubator_mxnet_tpu.parallel.pipeline import _record_schedule
+
+    _record_schedule("gpipe", 4, 8)
+    _record_schedule("1f1b", 4, 8)
+    reg = tel.get_registry()
+    assert reg.get("pipeline_bubble_fraction",
+                   {"schedule": "gpipe"}).value == pytest.approx(3 / 11)
+    assert reg.get("pipeline_bubble_fraction",
+                   {"schedule": "1f1b"}).value == pytest.approx(6 / 22)
+    assert reg.get("pipeline_stages", {"schedule": "1f1b"}).value == 4
+    assert reg.get("pipeline_bubble_ticks",
+                   {"schedule": "1f1b"}).value == 6
+
+
+def test_nbytes_of_uses_aval_metadata_only(tel):
+    x = jnp.ones((4, 8), jnp.float32)
+    assert tel.nbytes_of(x) == 4 * 8 * 4
+    assert tel.nbytes_of(NDArray(jnp.ones((2,), jnp.bfloat16))._data) == 4
+    assert tel.nbytes_of(object()) == 0
