@@ -21,4 +21,7 @@ python -m compileall -q incubator_mxnet_tpu/ tools/ tests/ ci/
 echo "telemetry smoke: 3-step train with MXTPU_TELEMETRY_DUMP=1"
 JAX_PLATFORMS=cpu python ci/telemetry_smoke.py
 
+echo "input pipeline smoke: sync-vs-prefetched equivalence + metrics"
+JAX_PLATFORMS=cpu python ci/input_pipeline_smoke.py
+
 echo "lint gates: OK"

@@ -1,14 +1,18 @@
 """DataLoader (ref `python/mxnet/gluon/data/dataloader.py` [UNVERIFIED],
-SURVEY.md §2.5): batchify + optional thread workers.
+SURVEY.md §2.5): batchify + optional thread workers + optional
+device-feed prefetch.
 
 The reference forks worker PROCESSES and rebuilds NDArrays in shared
 memory; with JAX a forked child cannot touch the accelerator runtime,
 so parallel fetch uses a thread pool (decode/augment are
-numpy/PIL — GIL-releasing) and the final device_put happens on the main
-thread.  `num_workers` keeps its meaning as fetch parallelism.
+numpy/PIL — GIL-releasing) and the final device transfer happens off
+the consuming thread via `io.prefetcher.DevicePrefetcher` when
+``prefetch_to_device`` is set.  `num_workers` keeps its meaning as
+fetch parallelism.
 """
 from __future__ import annotations
 
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
@@ -38,10 +42,21 @@ default_mp_batchify_fn = default_batchify_fn
 
 
 class DataLoader:
+    """Loads batches from a dataset.
+
+    TPU extension — ``prefetch_to_device`` (True, or an int queue
+    depth): batches flow through `io.prefetcher.DevicePrefetcher`, so
+    host fetch/batchify, the host→device DMA, and the training step
+    overlap; batches arrive already on device and, when a mesh is
+    active (``parallel.use_mesh``) or passed as ``mesh=``, already
+    sharded on its ``data`` axis — `Trainer._shard_inputs` then sees a
+    `NamedSharding` and skips its own per-step `device_put`."""
+
     def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn: Optional[Callable] = None, num_workers=0,
-                 pin_memory=False, prefetch=None, thread_pool=False):
+                 pin_memory=False, prefetch=None, thread_pool=False,
+                 prefetch_to_device=False, mesh=None, data_axis="data"):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -59,31 +74,66 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+        # device-feed prefetch: False/0 = off, True = depth 2, int = depth
+        self._device_depth = 2 if prefetch_to_device is True \
+            else max(0, int(prefetch_to_device or 0))
+        self._mesh = mesh
+        self._data_axis = data_axis
 
-    def __iter__(self):
+    def _host_batches(self):
+        """Host-side batch stream (fetch + batchify only)."""
         if self._num_workers == 0:
             for batch_idx in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch_idx])
             return
 
-        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
-            batches = list(self._batch_sampler)
-            futures = []
-            it = iter(batches)
+        # Streaming fan-out: the sampler is consumed lazily (a streaming
+        # batch_sampler never gets materialized), at most prefetch+1
+        # batches are in flight, and an early break cancels the queued
+        # fetches instead of blocking in pool shutdown.
+        pool = ThreadPoolExecutor(max_workers=self._num_workers)
+        futures: deque = deque()
+        sampler_it = iter(self._batch_sampler)
 
-            def fetch(idxs):
-                return self._batchify_fn([self._dataset[i] for i in idxs])
+        def fetch(idxs):
+            return self._batchify_fn([self._dataset[i] for i in idxs])
 
-            # keep `prefetch` batches in flight
-            for _ in range(min(self._prefetch + 1, len(batches))):
-                futures.append(pool.submit(fetch, next(it)))
-            sent = len(futures)
-            for i in range(len(batches)):
-                batch = futures[i].result()
-                if sent < len(batches):
-                    futures.append(pool.submit(fetch, next(it)))
-                    sent += 1
+        def submit_next() -> bool:
+            try:
+                idxs = next(sampler_it)
+            except StopIteration:
+                return False
+            futures.append(pool.submit(fetch, idxs))
+            return True
+
+        try:
+            draining = False
+            for _ in range(self._prefetch + 1):
+                if not submit_next():
+                    draining = True
+                    break
+            while futures:
+                batch = futures.popleft().result()
+                if not draining:
+                    draining = not submit_next()
                 yield batch
+        finally:
+            for f in futures:
+                f.cancel()
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __iter__(self):
+        if not self._device_depth:
+            yield from self._host_batches()
+            return
+        from ...io.prefetcher import DevicePrefetcher
+
+        # one-shot source: each __iter__ builds a fresh host generator,
+        # so the prefetcher epoch consumes exactly this iteration
+        yield from DevicePrefetcher(self._host_batches(),
+                                    depth=self._device_depth,
+                                    mesh=self._mesh,
+                                    axis_name=self._data_axis)
 
     def __len__(self):
         return len(self._batch_sampler)

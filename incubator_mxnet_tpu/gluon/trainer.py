@@ -198,7 +198,9 @@ class Trainer:
         mesh = self._get_mesh()
         if mesh is None or self._data_axis not in mesh.axis_names:
             return input_raws
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import NamedSharding
+
+        from ..io.prefetcher import batch_sharding
 
         n = mesh.shape[self._data_axis]
         if n <= 1:
@@ -224,11 +226,15 @@ class Trainer:
             return input_raws
         out = []
         for r in input_raws:
+            # already-NamedSharded inputs (e.g. batches staged by the
+            # io.prefetcher pipeline, or user-placed splits) pass
+            # through untouched — prefetched feeds pay ZERO per-step
+            # device_put here
             sh = getattr(r, "sharding", None)
             if (not isinstance(sh, NamedSharding) and hasattr(r, "shape")
                     and r.ndim >= 1 and r.shape[0] == batch):
-                spec = P(self._data_axis, *([None] * (r.ndim - 1)))
-                r = jax.device_put(r, NamedSharding(mesh, spec))
+                r = jax.device_put(
+                    r, batch_sharding(mesh, r.ndim, self._data_axis))
             out.append(r)
         return tuple(out)
 

@@ -36,16 +36,20 @@ def shard_batch(data, mesh, axis_name: str = "data", batch_axis: int = 0):
     global array is assembled across processes
     (`jax.make_array_from_process_local_data`), so each worker feeds
     its own data and the returned array's batch dim is the GLOBAL
-    batch (process-local batch × #processes on the axis)."""
-    from jax.sharding import NamedSharding, PartitionSpec
+    batch (process-local batch × #processes on the axis).
+
+    The placement rule itself (batch dim on ``axis_name``) is the
+    shared `io.prefetcher.batch_sharding` — the async input pipeline
+    (`DevicePrefetcher`, `DataLoader(prefetch_to_device=)`) stages
+    batches onto exactly this sharding, so prefetched batches feed the
+    SPMD step with no per-step reshard."""
+    from ..io.prefetcher import batch_sharding
 
     data = wrap(data)
     if axis_name not in mesh.axis_names:
         raise ValueError(f"shard_batch: mesh has no '{axis_name}' axis "
                          f"(axes: {mesh.axis_names})")
-    spec = [None] * len(data.shape)
-    spec[batch_axis] = axis_name
-    sh = NamedSharding(mesh, PartitionSpec(*spec))
+    sh = batch_sharding(mesh, len(data.shape), axis_name, batch_axis)
     n_proc = len({d.process_index for d in mesh.devices.flat})
     if n_proc > 1:
         raw_arr = data._data

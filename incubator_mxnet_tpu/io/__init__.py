@@ -1,13 +1,20 @@
-"""`mx.io` — legacy data iterators.
+"""`mx.io` — legacy data iterators + the async device-feed pipeline.
 
 Re-design of `python/mxnet/io/io.py` + the C++ iterators in `src/io/`
 [UNVERIFIED] (SURVEY.md §2.5): `DataIter` protocol (`next() →
 DataBatch`, `provide_data/provide_label`, `reset`), `NDArrayIter` with
 shuffle + last-batch handling, CSVIter, and `ImageRecordIter` backed by
 the RecordIO codec + host-side decode workers.
+
+`prefetcher` is the TPU-era input pipeline (`src/io/iter_prefetcher.h`
+equivalence): `DevicePrefetcher` overlaps host fetch, sharded
+host→device transfer, and compute; `PrefetchingIter` gives the same
+overlap behind the DataIter protocol.
 """
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,
                  MNISTIter, ResizeIter, PrefetchingIter, ImageRecordIter)
+from .prefetcher import DevicePrefetcher, batch_sharding, to_device
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter"]
+           "MNISTIter", "ResizeIter", "PrefetchingIter", "ImageRecordIter",
+           "DevicePrefetcher", "batch_sharding", "to_device"]

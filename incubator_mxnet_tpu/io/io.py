@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import threading
+import time as _time
 import queue as _queue
 from collections import namedtuple
 from typing import List, Optional
@@ -245,44 +246,114 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetcher (ref: src/io/iter_prefetcher.h) —
-    overlaps host batch prep with device compute."""
+    overlaps host batch prep (and, with ``prefetch_to_device=True``,
+    the host→device transfer) with device compute.
 
-    def __init__(self, iters, rename_data=None, rename_label=None, prefetch_depth=2):
+    Shutdown is race-free by construction: every epoch owns a FRESH
+    queue + stop flag, and the worker's blocking puts observe the stop
+    flag (`prefetcher._abortable_put`), so `reset()` can always reap
+    the old thread — and even a straggler can only ever touch its own
+    (abandoned) queue, never the next epoch's.
+
+    ``prefetch_to_device=True`` moves each batch through
+    `prefetcher.to_device` on the worker thread: batches arrive
+    already on device — sharded on ``mesh``'s (or the active mesh's)
+    data axis — while the consumer computes on the previous one.
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2, prefetch_to_device=False, mesh=None,
+                 axis_name="data", device=None):
         it = iters[0] if isinstance(iters, list) else iters
         super().__init__(it.batch_size)
         self.iter = it
-        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
+        self._depth = max(1, int(prefetch_depth))
+        self._to_device = prefetch_to_device
+        self._mesh = mesh
+        self._axis_name = axis_name
+        self._device = device
+        self._queue: _queue.Queue = None
+        self._stop: threading.Event = None
         self._thread = None
         self._start()
 
     def _start(self):
-        def worker():
-            while not self._stop.is_set():
-                try:
-                    batch = self.iter.next()
-                except StopIteration:
-                    self._queue.put(None)
-                    return
-                self._queue.put(batch)
+        from . import prefetcher as _pf
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        # per-epoch queue + stop flag: the shutdown/pollution guarantee
+        q = self._queue = _queue.Queue(maxsize=self._depth)
+        stop = self._stop = threading.Event()
+        mesh = None
+        if self._to_device:
+            mesh = self._mesh if self._mesh is not None \
+                else _pf._active_mesh()
+        it, to_dev = self.iter, self._to_device
+        axis, dev = self._axis_name, self._device
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    batch = it.next()
+                    if to_dev:
+                        batch = _pf.to_device(batch, mesh, axis,
+                                              device=dev)
+                except StopIteration:
+                    _pf._abortable_put(q, None, stop)
+                    return
+                except BaseException as e:  # re-raised on the consumer
+                    _pf._abortable_put(q, _pf._Failure(e), stop)
+                    return
+                if not _pf._abortable_put(q, batch, stop):
+                    return
+
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="mxtpu-prefetching-iter")
         self._thread.start()
 
-    def reset(self):
-        self._stop.set()
+    def _shutdown(self):
+        from . import prefetcher as _pf
+
+        if self._stop is not None:
+            self._stop.set()
+        if self._queue is not None:
+            _pf._drain(self._queue)
         if self._thread is not None:
-            while not self._queue.empty():
-                self._queue.get_nowait()
             self._thread.join(timeout=5)
-        self._stop.clear()
+            self._thread = None
+
+    def reset(self):
+        self._shutdown()
         self.iter.reset()
         self._start()
 
+    def close(self):
+        """Stop the worker without restarting it (end of use)."""
+        self._shutdown()
+
     def next(self):
-        batch = self._queue.get()
+        from .. import telemetry
+
+        want_tel = telemetry.enabled()
+        t0 = _time.perf_counter() if want_tel else 0.0
+        while True:
+            try:
+                batch = self._queue.get(timeout=1.0)
+                break
+            except _queue.Empty:
+                if self._thread is None or not self._thread.is_alive():
+                    batch = None  # worker died without a sentinel
+                    break
+        if want_tel:
+            telemetry.histogram("data_wait_seconds") \
+                .observe(_time.perf_counter() - t0)
+            telemetry.gauge("prefetch_queue_depth") \
+                .set(self._queue.qsize())
         if batch is None:
             raise StopIteration
+        from .prefetcher import _Failure
+
+        if isinstance(batch, _Failure):
+            batch.reraise()
         return batch
 
     @property
@@ -307,7 +378,7 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                  preprocess_threads=4, label_width=1, round_batch=True,
                  resize=0, seed=0, use_native=True, scale=1.0,
-                 device_normalize=False, **kwargs):
+                 device_normalize=False, mesh=None, **kwargs):
         """device_normalize=True (TPU extension): the iterator emits RAW
         uint8 pixels — 4x fewer bytes over the host→device link — and
         mean/std/scale move into the compiled model via `normalize()`.
@@ -325,6 +396,9 @@ class ImageRecordIter(DataIter):
         self._resize = resize
         self._round_batch = round_batch
         self._device_normalize = device_normalize
+        # mesh= : emitted batches land batch-sharded on the mesh's data
+        # axis (prefetcher.to_device) instead of on the default device
+        self._mesh = mesh
         if device_normalize:
             # host pipeline must leave pixels raw: normalization happens
             # on device inside the traced program (see normalize())
@@ -457,13 +531,24 @@ class ImageRecordIter(DataIter):
 
         return _NormalizedNet()
 
+    def _emit(self, data_np, label_np, pad) -> DataBatch:
+        """Emit path: async `jax.device_put` through the shared staging
+        helper — counts `h2d_bytes_total` and, with ``mesh=``, places
+        the batch dim on the mesh's data axis (already-sharded emit).
+        Wrap the iterator in `PrefetchingIter(prefetch_to_device=True)`
+        to also move this transfer off the consuming thread."""
+        from .prefetcher import to_device
+
+        data = NDArray(to_device(data_np, self._mesh))
+        label = NDArray(to_device(label_np, self._mesh))
+        return DataBatch(data=[data], label=[label], pad=pad)
+
     def next(self) -> DataBatch:
         if self._native is not None:
             d, l, pad = self._native.next()
             if self._device_normalize:
                 d = d.astype("uint8")  # 4x fewer bytes to the device
-            return DataBatch(data=[NDArray(jnp.asarray(d))],
-                             label=[NDArray(jnp.asarray(l))], pad=pad)
+            return self._emit(d, l, pad)
         if getattr(self, "_padded_last", False):
             self._padded_last = False
             raise StopIteration  # the padded batch ended the epoch
@@ -496,9 +581,7 @@ class ImageRecordIter(DataIter):
         stacked = onp.stack(datas)
         if self._device_normalize:
             stacked = stacked.astype("uint8")  # raw pixels, small transfer
-        data = NDArray(jnp.asarray(stacked))
-        label = NDArray(jnp.asarray(onp.stack(labels)))
-        return DataBatch(data=[data], label=[label], pad=pad)
+        return self._emit(stacked, onp.stack(labels), pad)
 
 
 class _NativeImagePipeline:

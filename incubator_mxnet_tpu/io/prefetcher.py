@@ -1,0 +1,322 @@
+"""Async device-feed input pipeline: double-buffered host→device
+prefetch with sharded staging (ISSUE 3 tentpole).
+
+The reference keeps the accelerator fed through its async dependency
+engine plus ``src/io/iter_prefetcher.h``; here the same overlap is
+built from three pipelined host stages:
+
+1. **fetch** — a background thread pulls batches from the source
+   (dataset fetch / batchify / decode — numpy/PIL work that releases
+   the GIL);
+2. **staging** — a bounded queue decouples fetch jitter from transfer;
+3. **transfer** — a second thread calls ``jax.device_put`` with the
+   active mesh's ``NamedSharding`` (the same batch-dim placement
+   ``gluon.utils.shard_batch`` uses).  ``device_put`` only *enqueues*
+   the DMA — the consumer receives already-on-device, already-sharded
+   arrays without ever blocking on array readiness, so batch N+1's
+   host→device copy overlaps batch N's compute.
+
+The ready queue is depth-``k`` (default 2 — classic double buffering):
+the pipeline runs at ``max(fetch, transfer, compute)`` instead of
+their sum, and holds at most ``2·depth`` batches of host+device memory.
+
+Telemetry (when enabled — docs/observability.md):
+
+* ``data_wait_seconds``     histogram — time the consumer blocked
+  waiting for the next batch (the input-boundness signal);
+* ``prefetch_queue_depth``  gauge — ready batches after each get;
+* ``h2d_bytes_total``       counter — bytes submitted host→device.
+
+Consumers: ``gluon.data.DataLoader(prefetch_to_device=...)``,
+``mx.io.PrefetchingIter(prefetch_to_device=True)``, and ``bench.py``'s
+input-wait phase all feed through this module.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as onp
+
+from .. import telemetry
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DevicePrefetcher", "to_device", "batch_sharding"]
+
+# sentinel marking the end of an epoch inside the stage/ready queues
+_END = object()
+
+# how long a blocked queue put/get sleeps between stop-flag checks; the
+# granularity of worker shutdown, not of steady-state throughput (a
+# non-full/non-empty queue never waits)
+_POLL_S = 0.05
+
+
+class _Failure:
+    """An exception crossing a queue; re-raised on the consumer thread."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+    def reraise(self):
+        raise self.exc
+
+
+def batch_sharding(mesh, ndim: int, axis_name: str = "data",
+                   batch_axis: int = 0):
+    """`NamedSharding` placing dim ``batch_axis`` of an ndim-rank array
+    on ``axis_name`` — the single placement rule `gluon.utils
+    .shard_batch`, `Trainer._shard_inputs` and this prefetcher share."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = [None] * ndim
+    spec[batch_axis] = axis_name
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def _active_mesh():
+    from ..parallel import mesh as _mesh_mod
+
+    return _mesh_mod.current_mesh()
+
+
+def _put_leaf(x, mesh, axis_name, batch_axis, device):
+    """device_put one array leaf (sharded on the mesh's data axis when
+    its batch dim allows); non-array leaves pass through untouched."""
+    nd = None
+    if isinstance(x, NDArray):
+        nd, x = x, x._data
+    elif isinstance(x, onp.ndarray):
+        pass
+    elif not isinstance(x, jax.Array):
+        return nd if nd is not None else x
+    if telemetry.enabled():
+        telemetry.counter("h2d_bytes_total").inc(telemetry.nbytes_of(x))
+    if mesh is not None and axis_name in mesh.axis_names:
+        n = mesh.shape[axis_name]
+        if (getattr(x, "ndim", 0) > batch_axis
+                and x.shape[batch_axis] % n == 0):
+            sh = batch_sharding(mesh, x.ndim, axis_name, batch_axis)
+        else:
+            # batch dim absent/indivisible (odd tail batch, scalars):
+            # replicate rather than fail mid-epoch
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sh = NamedSharding(mesh, PartitionSpec())
+        out = jax.device_put(x, sh)
+    else:
+        out = jax.device_put(x, device) if device is not None \
+            else jax.device_put(x)
+    return NDArray(out) if nd is not None else out
+
+
+def to_device(batch, mesh=None, axis_name: str = "data", batch_axis: int = 0,
+              device=None):
+    """Structure-preserving async host→device transfer of one batch.
+
+    Array leaves (NDArray / numpy / jax.Array) are ``device_put``
+    (NDArray stays NDArray); containers (tuple/list/dict) and
+    ``DataBatch``-shaped objects keep their shape; everything else
+    passes through.  With a mesh, leaves whose ``batch_axis`` dim is
+    divisible by ``mesh.shape[axis_name]`` land batch-sharded on the
+    data axis (`batch_sharding`), the rest replicated."""
+    if isinstance(batch, (tuple, list)):
+        out = [to_device(b, mesh, axis_name, batch_axis, device)
+               for b in batch]
+        return type(batch)(out) if isinstance(batch, tuple) else out
+    if isinstance(batch, dict):
+        return {k: to_device(v, mesh, axis_name, batch_axis, device)
+                for k, v in batch.items()}
+    # DataBatch duck-typed (io.io.DataBatch) — shallow copy with its
+    # data/label lists transferred, pad/index/provide_* untouched
+    if hasattr(batch, "data") and hasattr(batch, "label") \
+            and hasattr(batch, "pad"):
+        import copy
+
+        nb = copy.copy(batch)
+        nb.data = to_device(batch.data, mesh, axis_name, batch_axis, device)
+        if batch.label is not None:
+            nb.label = to_device(batch.label, mesh, axis_name, batch_axis,
+                                 device)
+        return nb
+    return _put_leaf(batch, mesh, axis_name, batch_axis, device)
+
+
+def _abortable_put(q: _queue.Queue, item, stop: threading.Event) -> bool:
+    """Blocking put that observes `stop` — a worker parked on a full
+    queue can always be shut down (the PrefetchingIter.reset race)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=_POLL_S)
+            return True
+        except _queue.Full:
+            continue
+    return False
+
+
+def _abortable_get(q: _queue.Queue, stop: threading.Event):
+    """Blocking get that observes `stop`; returns _END once stopped."""
+    while not stop.is_set():
+        try:
+            return q.get(timeout=_POLL_S)
+        except _queue.Empty:
+            continue
+    return _END
+
+
+def _drain(q: _queue.Queue) -> None:
+    while True:
+        try:
+            q.get_nowait()
+        except _queue.Empty:
+            return
+
+
+class _Epoch:
+    """One epoch's private queues + threads.
+
+    Per-epoch state is the shutdown guarantee: a worker from a previous
+    epoch can only ever touch ITS OWN queues, so even a slow-to-die
+    thread cannot pollute the next epoch (it is also guaranteed to die:
+    every blocking queue op observes this epoch's stop flag)."""
+
+    def __init__(self, it, depth: int, transfer):
+        self._it = it
+        self._transfer = transfer
+        self.stop = threading.Event()
+        self.stage_q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self.ready_q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._threads = [
+            threading.Thread(target=self._fetch_loop, daemon=True,
+                             name="mxtpu-prefetch-fetch"),
+            threading.Thread(target=self._xfer_loop, daemon=True,
+                             name="mxtpu-prefetch-xfer"),
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- stage 1: host fetch/batchify ---------------------------------- #
+    def _fetch_loop(self):
+        while not self.stop.is_set():
+            try:
+                batch = next(self._it)
+            except StopIteration:
+                _abortable_put(self.stage_q, _END, self.stop)
+                return
+            except BaseException as e:  # surfaced on the consumer thread
+                _abortable_put(self.stage_q, _Failure(e), self.stop)
+                return
+            if not _abortable_put(self.stage_q, batch, self.stop):
+                return
+
+    # -- stage 3: async device_put (stage 2 is the queue between) ------ #
+    def _xfer_loop(self):
+        while not self.stop.is_set():
+            item = _abortable_get(self.stage_q, self.stop)
+            if item is _END or isinstance(item, _Failure):
+                _abortable_put(self.ready_q, item, self.stop)
+                return
+            try:
+                item = self._transfer(item)
+            except BaseException as e:
+                _abortable_put(self.ready_q, _Failure(e), self.stop)
+                return
+            if not _abortable_put(self.ready_q, item, self.stop):
+                return
+
+    def get(self):
+        """Next ready batch (raises StopIteration at epoch end)."""
+        want_tel = telemetry.enabled()
+        t0 = time.perf_counter() if want_tel else 0.0
+        while True:
+            try:
+                item = self.ready_q.get(timeout=1.0)
+                break
+            except _queue.Empty:
+                if not any(t.is_alive() for t in self._threads):
+                    item = _END  # workers died without a sentinel
+                    break
+        if want_tel:
+            telemetry.histogram("data_wait_seconds") \
+                .observe(time.perf_counter() - t0)
+            telemetry.gauge("prefetch_queue_depth") \
+                .set(self.ready_q.qsize())
+        if item is _END:
+            raise StopIteration
+        if isinstance(item, _Failure):
+            item.reraise()
+        return item
+
+    def shutdown(self, join_timeout: float = 5.0):
+        self.stop.set()
+        # unblock workers parked on a full queue, then reap them
+        _drain(self.stage_q)
+        _drain(self.ready_q)
+        for t in self._threads:
+            t.join(timeout=join_timeout)
+
+
+class DevicePrefetcher:
+    """Iterate ``source`` with fetch/transfer/compute fully overlapped.
+
+    ``source`` is any iterable of batches (a generator, a
+    ``DataLoader``'s host iterator, a ``DataIter``); each ``iter()`` of
+    this object starts a fresh epoch over ``iter(source)``.  Batches
+    come back structure-preserved with every array leaf already on
+    device (see `to_device`) — NDArray leaves stay NDArray.
+
+    ``mesh=None`` picks up the active ``parallel.use_mesh`` mesh at
+    epoch start; pass an explicit mesh (or ``mesh=False`` to force
+    single-device placement) to override.  ``depth`` is the ready-queue
+    capacity (k-deep double buffering)."""
+
+    def __init__(self, source: Iterable, depth: int = 2, mesh=None,
+                 axis_name: str = "data", batch_axis: int = 0,
+                 device=None):
+        self._source = source
+        self._depth = max(1, int(depth))
+        self._mesh = mesh
+        self._axis_name = axis_name
+        self._batch_axis = batch_axis
+        self._device = device
+        self._epoch: Optional[_Epoch] = None
+
+    def _resolve_mesh(self):
+        if self._mesh is False:
+            return None
+        return self._mesh if self._mesh is not None else _active_mesh()
+
+    def __iter__(self):
+        self.close()  # at most one live epoch per prefetcher
+        mesh = self._resolve_mesh()
+
+        def transfer(batch):
+            return to_device(batch, mesh, self._axis_name,
+                             self._batch_axis, self._device)
+
+        ep = _Epoch(iter(self._source), self._depth, transfer)
+        self._epoch = ep
+        try:
+            while True:
+                try:
+                    yield ep.get()
+                except StopIteration:
+                    return
+        finally:
+            ep.shutdown()
+            if self._epoch is ep:
+                self._epoch = None
+
+    def close(self):
+        """Stop the in-flight epoch's workers (idempotent)."""
+        ep, self._epoch = self._epoch, None
+        if ep is not None:
+            ep.shutdown()
+
+    def __len__(self):
+        return len(self._source)  # type: ignore[arg-type]

@@ -1,0 +1,326 @@
+"""Async device-feed input pipeline (io/prefetcher.py, ISSUE 3):
+overlap proof, sync-parity, sharded staging, reset/epoch behavior, and
+thread/future cleanup for all three feed paths."""
+import threading
+import time
+
+import numpy as onp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from incubator_mxnet_tpu.io.prefetcher import (DevicePrefetcher,
+                                               batch_sharding, to_device)
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.parallel import use_mesh
+
+
+def _join_threads(prefix="mxtpu-prefetch", timeout=5.0):
+    """Wait for all pipeline worker threads to exit; return stragglers."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name.startswith(prefix) and t.is_alive()]
+        if not alive:
+            return []
+        time.sleep(0.02)
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(prefix) and t.is_alive()]
+
+
+# --------------------------------------------------------------------- #
+# overlap microbenchmark (acceptance criterion)
+# --------------------------------------------------------------------- #
+def test_prefetch_overlap_pipelines_fetch_and_compute():
+    """With fetch ≈ compute ≈ 5 ms, the prefetched loop must run at
+    ≈ max(fetch, compute) per step (the sync loop pays the sum)."""
+    fetch_s, compute_s, n = 0.005, 0.005, 30
+
+    def slow_batches():
+        for i in range(n):
+            time.sleep(fetch_s)  # a stalling host dataset
+            yield onp.full((4, 4), i, dtype=onp.float32)
+
+    # sync: fetch then compute, serial
+    t0 = time.perf_counter()
+    for _ in slow_batches():
+        time.sleep(compute_s)
+    sync_t = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    seen = 0
+    for _ in DevicePrefetcher(slow_batches(), depth=2, mesh=False):
+        time.sleep(compute_s)
+        seen += 1
+    pipe_t = time.perf_counter() - t0
+
+    assert seen == n
+    ideal = n * max(fetch_s, compute_s)
+    # criterion: per-step wall ≤ max(fetch, compute) + 25% (plus a
+    # fixed 60 ms allowance for thread startup/scheduler jitter in CI)
+    assert pipe_t <= ideal * 1.25 + 0.06, \
+        f"no overlap: pipelined {pipe_t:.3f}s vs ideal {ideal:.3f}s " \
+        f"(sync {sync_t:.3f}s)"
+    # sanity: the sync loop really pays ~the sum
+    assert sync_t >= 0.8 * n * (fetch_s + compute_s)
+
+
+# --------------------------------------------------------------------- #
+# byte-parity with the synchronous paths
+# --------------------------------------------------------------------- #
+def _loader_bytes(loader):
+    return [[a.asnumpy().tobytes() for a in b] for b in loader]
+
+
+def test_dataloader_prefetch_byte_identical():
+    rng = onp.random.RandomState(0)
+    ds = ArrayDataset(rng.randn(20, 3).astype("float32"),
+                      rng.randint(0, 5, 20).astype("int32"))
+    sync = _loader_bytes(DataLoader(ds, batch_size=4))
+    for workers in (0, 2):
+        pref = _loader_bytes(DataLoader(ds, batch_size=4,
+                                        num_workers=workers,
+                                        prefetch_to_device=2, mesh=False))
+        assert pref == sync
+    assert not _join_threads()
+
+
+def test_prefetching_iter_device_parity():
+    X = onp.random.RandomState(1).randn(16, 5).astype("float32")
+    Y = onp.arange(16, dtype="float32")
+    plain = [(b.data[0].asnumpy().tobytes(), b.label[0].asnumpy().tobytes(),
+              b.pad)
+             for b in mx.io.NDArrayIter(X, Y, batch_size=4)]
+    pit = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, Y, batch_size=4),
+                                prefetch_to_device=True)
+    moved = [(b.data[0].asnumpy().tobytes(), b.label[0].asnumpy().tobytes(),
+              b.pad) for b in pit]
+    pit.close()
+    assert moved == plain
+
+
+# --------------------------------------------------------------------- #
+# sharded staging under a mesh
+# --------------------------------------------------------------------- #
+def test_prefetch_sharding_under_mesh(mesh8):
+    ds = ArrayDataset(onp.arange(64, dtype="float32").reshape(16, 4),
+                      onp.arange(16, dtype="float32"))
+    loader = DataLoader(ds, batch_size=8, prefetch_to_device=2, mesh=mesh8)
+    batches = list(loader)
+    assert len(batches) == 2
+    for data, label in batches:
+        assert data._data.sharding == NamedSharding(mesh8, P("data", None))
+        assert label._data.sharding == NamedSharding(mesh8, P("data"))
+    # values survive the sharded placement bit-exactly
+    got = onp.concatenate([d.asnumpy() for d, _ in batches])
+    assert got.tobytes() == onp.arange(64, dtype="float32").tobytes()
+
+
+def test_prefetch_active_mesh_pickup(mesh8):
+    """mesh=None resolves the ambient use_mesh() mesh at epoch start."""
+    src = [onp.ones((8, 2), onp.float32)]
+    with use_mesh(mesh8):
+        (out,) = list(DevicePrefetcher(iter(src), depth=1))
+    assert out.sharding == NamedSharding(mesh8, P("data", None))
+
+
+def test_to_device_replicates_indivisible_batch(mesh8):
+    # batch 6 % 8 != 0: replicate instead of failing mid-epoch
+    out = to_device(onp.ones((6, 2), onp.float32), mesh=mesh8)
+    assert out.sharding == NamedSharding(mesh8, P())
+
+
+def test_batch_sharding_is_shard_batch_placement(mesh8):
+    from incubator_mxnet_tpu.gluon.utils import shard_batch
+
+    x = onp.arange(32, dtype="float32").reshape(8, 4)
+    via_helper = to_device(x, mesh=mesh8)
+    via_shard_batch = shard_batch(NDArray(onp.asarray(x)), mesh8)
+    assert via_helper.sharding == via_shard_batch._data.sharding
+
+
+# --------------------------------------------------------------------- #
+# epoch boundaries, reset, and the reset() race
+# --------------------------------------------------------------------- #
+def test_prefetching_iter_reset_mid_epoch_no_pollution():
+    """reset() while the worker is parked on a full queue must reap it;
+    the next epoch must replay the FULL sequence (no stale batches)."""
+    X = onp.arange(64, dtype="float32").reshape(64, 1)
+    Y = onp.arange(64, dtype="float32")
+    expect = [b.label[0].asnumpy().tolist()
+              for b in mx.io.NDArrayIter(X, Y, batch_size=4)]
+    pit = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, Y, batch_size=4),
+                                prefetch_depth=2)
+    for _ in range(5):  # repeated mid-epoch resets (the race scenario)
+        pit.next()
+        pit.next()
+        t0 = time.perf_counter()
+        pit.reset()
+        assert time.perf_counter() - t0 < 2.0, "reset hung joining worker"
+    got = [b.label[0].asnumpy().tolist() for b in pit]
+    assert got == expect
+    pit.close()
+    assert not _join_threads(prefix="mxtpu-prefetching-iter")
+
+
+def test_prefetching_iter_epoch_boundary():
+    X = onp.zeros((12, 2), "float32")
+    pit = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, onp.zeros(12, "float32"),
+                                                  batch_size=4))
+    assert len(list(pit)) == 3
+    with pytest.raises(StopIteration):
+        pit.next()
+    pit.reset()
+    assert len(list(pit)) == 3
+    pit.close()
+
+
+def test_device_prefetcher_multi_epoch_reiterates_source():
+    epochs = []
+
+    class Source:
+        def __iter__(self):
+            epochs.append(len(epochs))
+            return iter([onp.ones(2, onp.float32)] * 3)
+
+    pf = DevicePrefetcher(Source(), depth=1, mesh=False)
+    assert len(list(pf)) == 3
+    assert len(list(pf)) == 3
+    assert epochs == [0, 1]
+
+
+# --------------------------------------------------------------------- #
+# early exit: futures cancelled, threads reaped, sampler streamed
+# --------------------------------------------------------------------- #
+class _CountingDataset(ArrayDataset):
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.fetches = 0
+
+    def __getitem__(self, idx):
+        self.fetches += 1
+        return super().__getitem__(idx)
+
+
+def test_dataloader_streaming_sampler_not_materialized():
+    """The threaded path must pull the batch sampler lazily."""
+    pulled = []
+
+    class StreamingSampler:
+        def __iter__(self):
+            for i in range(100):
+                pulled.append(i)
+                yield [i % 10]
+
+    ds = _CountingDataset(onp.arange(10, dtype="float32"))
+    loader = DataLoader(ds, batch_sampler=StreamingSampler(), num_workers=2,
+                        prefetch=2)
+    it = iter(loader)
+    next(it)
+    next(it)
+    it.close()
+    # 2 consumed + at most prefetch+1 in flight + 1 lookahead — nowhere
+    # near the 100 an eager list() would have pulled
+    assert len(pulled) <= 8, f"sampler materialized: {len(pulled)} pulled"
+    assert ds.fetches <= 8
+
+
+def test_dataloader_early_break_cancels_and_cleans_up():
+    ds = _CountingDataset(onp.arange(400, dtype="float32"))
+    loader = DataLoader(ds, batch_size=4, num_workers=2,
+                        prefetch_to_device=2, mesh=False)
+    for i, _ in enumerate(loader):
+        if i == 1:
+            break
+    assert not _join_threads(), "prefetch threads leaked after break"
+    # in-flight bound: consumed(2) + device queue(2+stage 2) + pool
+    # prefetch window(5) of 4 samples each, far below the 400 total
+    assert ds.fetches <= 11 * 4, f"early break kept fetching: {ds.fetches}"
+    # the loader is reusable after an early break
+    assert len(list(loader)) == 100
+
+
+def test_device_prefetcher_close_mid_epoch():
+    def gen():
+        for i in range(1000):
+            yield onp.full(3, i, onp.float32)
+
+    pf = DevicePrefetcher(gen(), depth=2, mesh=False)
+    it = iter(pf)
+    next(it)
+    it.close()
+    assert not _join_threads()
+
+
+def test_device_prefetcher_error_propagates():
+    def bad():
+        yield onp.ones(2, onp.float32)
+        raise ValueError("boom in fetch")
+
+    it = iter(DevicePrefetcher(bad(), mesh=False))
+    next(it)
+    with pytest.raises(ValueError, match="boom in fetch"):
+        next(it)
+    assert not _join_threads()
+
+
+# --------------------------------------------------------------------- #
+# telemetry
+# --------------------------------------------------------------------- #
+def test_pipeline_metrics_recorded():
+    reg = telemetry.get_registry()
+    was_on = telemetry.enabled()
+    telemetry.enable()
+    try:
+        wait = telemetry.histogram("data_wait_seconds")
+        h2d = telemetry.counter("h2d_bytes_total")
+        wait0, h2d0 = wait.count, h2d.value
+        ds = ArrayDataset(onp.ones((8, 4), "float32"))
+        for _ in DataLoader(ds, batch_size=2, prefetch_to_device=2,
+                            mesh=False):
+            pass
+        assert wait.count - wait0 >= 4
+        assert h2d.value - h2d0 == 8 * 4 * 4  # fp32 data bytes staged
+        assert reg.get("prefetch_queue_depth") is not None
+    finally:
+        if not was_on:
+            telemetry.disable()
+
+
+def test_prefetched_trainer_loop_end_to_end():
+    """The full consumption path: DataLoader(prefetch_to_device) →
+    autograd.record → Trainer.step matches the sync loop's params."""
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+
+    rng = onp.random.RandomState(3)
+    X = rng.randn(16, 5).astype("float32")
+    Y = rng.randn(16, 1).astype("float32")
+
+    def train(prefetch):
+        mx.random.seed(0)
+        net = nn.Dense(1)
+        net.initialize()
+        trainer = Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+        loader = DataLoader(ArrayDataset(X, Y), batch_size=4,
+                            prefetch_to_device=2 if prefetch else False,
+                            mesh=False)
+        for _ in range(2):
+            for data, label in loader:
+                with autograd.record():
+                    err = net(data) - label
+                    loss = (err * err).sum()
+                loss.backward()
+                trainer.step(4)
+        trainer.flush()
+        # positional: block name counters differ across instantiations
+        return [v.data().asnumpy()
+                for v in net.collect_params().values()]
+
+    sync_p, pref_p = train(False), train(True)
+    assert len(sync_p) == len(pref_p)
+    for a, b in zip(sync_p, pref_p):
+        onp.testing.assert_array_equal(a, b)
