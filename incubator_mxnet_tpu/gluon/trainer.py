@@ -56,6 +56,22 @@ def _aval_bytes(a) -> int:
         itemsize = 2  # bfloat16 and friends
     return math.prod(a.shape) * itemsize if a.shape else itemsize
 
+def _apply_constraints(new_w, new_s, constraints):
+    """Pin fused-step outputs to their input shardings (ZeRO gspmd tier):
+    new weights back to the original param layout, new states to the
+    data-augmented state layout."""
+    from jax.sharding import NamedSharding
+
+    wsh, ssh = constraints
+    wsc = jax.lax.with_sharding_constraint
+    new_w = tuple(wsc(x, s) if isinstance(s, NamedSharding) else x
+                  for x, s in zip(new_w, wsh))
+    sdef = jax.tree_util.tree_structure(new_s)
+    sl = [wsc(x, s) if isinstance(s, NamedSharding) else x
+          for x, s in zip(jax.tree_util.tree_leaves(new_s), ssh)]
+    return new_w, jax.tree_util.tree_unflatten(sdef, sl)
+
+
 __all__ = ["Trainer"]
 
 
@@ -68,7 +84,9 @@ class Trainer:
                  max_inflight_steps: Optional[int] = None,
                  max_inflight_bytes: int = 6 << 30,
                  mesh=None, data_axis: str = "data",
-                 chain_steps: int = 1, chain_unroll: bool = False):
+                 chain_steps: int = 1, chain_unroll: bool = False,
+                 zero_stage: Optional[int] = None,
+                 zero_collectives: str = "auto"):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -148,6 +166,24 @@ class Trainer:
         self._chain_buf: list = []
         self._chain_state: Optional[dict] = None
         self._chain_weight_cells: list = []
+        # ZeRO-1 sharded optimizer step (docs/performance.md "Sharded
+        # optimizer"): None = auto (ON whenever a mesh with a non-trivial
+        # data axis is active), 0 = off, 1 = forced.  zero_collectives
+        # picks how the sharding is expressed: "explicit" (shard_map +
+        # psum_scatter/all_gather — data-only meshes), "gspmd"
+        # (NamedSharding state + sharding constraints — composes with
+        # TP), or "auto" (explicit when eligible, else gspmd).
+        if zero_stage not in (None, 0, 1):
+            raise ValueError(f"zero_stage must be None, 0 or 1, got {zero_stage!r}")
+        if zero_collectives not in ("auto", "gspmd", "explicit"):
+            raise ValueError(
+                f"zero_collectives must be 'auto', 'gspmd' or 'explicit', "
+                f"got {zero_collectives!r}")
+        self._zero_stage = zero_stage
+        self._zero_collectives = zero_collectives
+        self._zero_warned: set = set()  # one-time warning keys
+        self._capture_hlo = False       # tests/dryrun: keep last_step_hlo
+        self.last_step_hlo: Optional[str] = None
 
     def _get_mesh(self):
         """Explicit mesh, else inferred from any NamedSharded param.
@@ -165,22 +201,158 @@ class Trainer:
                     break
         return self._mesh
 
+    # ------------------------------------------------------------------ #
+    # ZeRO-1 sharded optimizer state (gluon/zero.py)
+    # ------------------------------------------------------------------ #
+    def _warn_zero_once(self, key: str, msg: str, use_logging: bool = False):
+        if key in self._zero_warned:
+            return
+        self._zero_warned.add(key)
+        if use_logging:
+            import logging
+
+            logging.getLogger(__name__).warning(msg)
+        else:
+            import warnings
+
+            warnings.warn(msg, stacklevel=4)
+
+    def _resolve_zero(self) -> Optional[dict]:
+        """Resolve the ZeRO-1 configuration for the current step.
+
+        Returns None (replicated optimizer path) or
+        ``{"tier": "explicit"|"gspmd", "mesh", "axis", "D"}``.  ZeRO is
+        auto-enabled when a mesh with a non-trivial data axis is active;
+        stochastic optimizers and gradient compression opt out with a
+        one-time warning naming the reason."""
+        if self._zero_stage == 0:
+            return None
+        mesh = self._get_mesh()
+        axis = self._data_axis
+        D = int(mesh.shape[axis]) \
+            if mesh is not None and axis in mesh.axis_names else 0
+        if D <= 1:
+            if self._zero_stage == 1:
+                self._warn_zero_once(
+                    "nomesh",
+                    f"Trainer(zero_stage=1): no mesh with a non-trivial "
+                    f"{axis!r} axis is active — running the replicated "
+                    f"optimizer path")
+            return None
+        opt = self._optimizer
+        if getattr(opt, "needs_rng", False):
+            self._warn_zero_once(
+                "rng",
+                f"Trainer: ZeRO-1 disabled for stochastic optimizer "
+                f"{type(opt).__name__}: a sharded update would draw "
+                f"per-shard noise and diverge from the replicated rule")
+            return None
+        kv = self._kvstore
+        comp = getattr(kv, "_compression", None) if kv is not None else None
+        if comp is not None:
+            reason = comp.reduce_scatter_incompatible_reason()
+            if reason is not None:
+                # one-time logging.warning naming the reason — the step
+                # keeps the all-reduce gradient sync instead of silently
+                # changing the compression numerics
+                self._warn_zero_once(
+                    "compression",
+                    "Trainer: zero_stage=1 reduce-scatter gradient sync "
+                    "disabled, falling back to the all-reduce path: "
+                    + reason, use_logging=True)
+                return None
+        tier = self._zero_collectives
+        explicit_ok = (tuple(mesh.axis_names) == (axis,)
+                       and getattr(opt, "elementwise_update", True))
+        if tier == "auto":
+            tier = "explicit" if explicit_ok else "gspmd"
+        elif tier == "explicit" and not explicit_ok:
+            self._warn_zero_once(
+                "explicit",
+                "Trainer(zero_collectives='explicit') needs a data-only "
+                "mesh and an elementwise optimizer rule — using the GSPMD "
+                "sharding tier instead")
+            tier = "gspmd"
+        return {"tier": tier, "mesh": mesh, "axis": axis, "D": D}
+
+    def _zero_sig(self):
+        zr = self._resolve_zero()
+        return None if zr is None else (zr["tier"], zr["axis"], zr["D"])
+
+    def _canonicalize_states(self):
+        """Convert any explicit-tier Zero1State entries back to the
+        canonical full-shape layout (device-side slice+reshape of the
+        global flat buffers — no host round-trip)."""
+        from . import zero as zero_mod
+
+        for k, st in list(self._states.items()):
+            if isinstance(st, zero_mod.Zero1State):
+                self._states[k] = zero_mod.canonical(st)
+
+    def optimizer_state_bytes_per_device(self) -> int:
+        """Per-device bytes held by the optimizer state (sharding
+        metadata only, no sync) — the quantity ZeRO-1 divides by the
+        data-axis size."""
+        from . import zero as zero_mod
+
+        self._sync_states()
+        return sum(zero_mod.state_bytes_per_device(st)
+                   for st in self._states.values())
+
+    def host_states(self) -> dict:
+        """Canonical full-shape host copy of every optimizer state,
+        fetched one leaf at a time (a ZeRO-sharded state is never
+        materialized as a full device-side replica to be saved)."""
+        import numpy as onp
+
+        from . import zero as zero_mod
+
+        self._flush_chain()
+        self._sync_states()
+        out = {}
+        for k, st in self._states.items():
+            if isinstance(st, zero_mod.Zero1State):
+                out[k] = zero_mod.host_canonical(st)
+            else:
+                out[k] = jax.tree_util.tree_map(
+                    lambda x: onp.asarray(jax.device_get(x)), st)
+        return out
+
     def _shard_state_like(self, state, w):
         """Place same-shape optimizer-state leaves (momentum, fp32
         master, ...) on the weight's sharding — TP memory savings apply
-        to the full train state, not just the weights."""
+        to the full train state, not just the weights.  With ZeRO-1
+        active the leaf sharding additionally gains the data axis on the
+        first free divisible dimension (gluon/zero.py), dividing state
+        bytes per device by the data-axis size."""
         from jax.sharding import NamedSharding
 
         sh = getattr(w, "sharding", None)
         if not isinstance(sh, NamedSharding):
             return state
+        zsh = None
+        zr = self._resolve_zero()
+        if zr is not None:
+            from . import zero as zero_mod
+
+            zsh = zero_mod.gspmd_state_sharding(w, zr["axis"], zr["D"])
 
         def put(leaf):
             if hasattr(leaf, "shape") and tuple(leaf.shape) == tuple(w.shape):
-                return jax.device_put(leaf, sh)
+                return jax.device_put(leaf, zsh or sh)
             return leaf
 
         return jax.tree_util.tree_map(put, state)
+
+    def _zero_constraints(self, idxs):
+        """(weight shardings, flat state-leaf shardings) for the gspmd
+        tier's output constraints — captured from the live arrays."""
+        w_sh = tuple(getattr(self._params[i]._data_nd._data, "sharding", None)
+                     for i in idxs)
+        s_sh = tuple(getattr(l, "sharding", None)
+                     for i in idxs
+                     for l in jax.tree_util.tree_leaves(self._states[i]))
+        return (w_sh, s_sh)
 
     @telemetry.span("trainer/shard_inputs")
     def _shard_inputs(self, input_raws):
@@ -592,7 +764,10 @@ class Trainer:
                         auxs.append(aux)
                     return w, aux, states, ts, tuple(outs), tuple(auxs), sync
 
-                fn = jax.jit(chain_unrolled, donate_argnums=(0, 2, 3))
+                donate = (0, 2, 3)
+                if ctx.get("zero_sig") is not None:
+                    donate = self._zero_safe_donate(donate)
+                fn = jax.jit(chain_unrolled, donate_argnums=donate)
                 ctx[key] = fn
                 return fn
 
@@ -625,7 +800,10 @@ class Trainer:
             # never donates it either, so user-held aux references (e.g.
             # a captured running_mean array) stay readable, parity with
             # the per-step path
-            fn = jax.jit(chain, donate_argnums=(0, 2, 3))
+            donate = (0, 2, 3)
+            if ctx.get("zero_sig") is not None:
+                donate = self._zero_safe_donate(donate)
+            fn = jax.jit(chain, donate_argnums=donate)
             ctx[key] = fn
         return fn
 
@@ -737,6 +915,8 @@ class Trainer:
         ctx["states"] = new_s
         ctx["ts_dev"] = new_ts
         self._states_stale = True
+        if telemetry.enabled():
+            self._count_collective_bytes(ctx, K)
         try:
             self._throttle_bytes(sync, ctx["held_bytes"] * K)
         except Exception:
@@ -787,10 +967,11 @@ class Trainer:
         # this path donates/replaces the state buffers the fullstep ctx
         # still references — drop the ctx so the next full step re-reads
         self._fullstep_ctx = None
+        self._canonicalize_states()
         idxs = [i for i, p in enumerate(self._params)
                 if p.grad_req != "null" and p._data_nd is not None]
         lr_mults, wd_mults, clip = self._mults_key(idxs)
-        key = (tuple(idxs), lr_mults, wd_mults, clip)
+        key = (tuple(idxs), lr_mults, wd_mults, clip, self._zero_sig())
         if self._fused_fn is None or self._fused_key != key:
             self._fused_key = key
             for i in idxs:
@@ -799,13 +980,22 @@ class Trainer:
                         opt.create_state_multi_precision(
                             i, self._params[i].data()),
                         self._params[i]._data_nd._data)
-            donate = (0, 2) if self._donate else ()
             stacked = self._make_stacked_update(lr_mults, wd_mults, clip)
+            # ZeRO gspmd tier: pin outputs to the (data-sharded) state /
+            # original weight shardings so the partitioner keeps the
+            # layout across the donated update
+            constraints = self._zero_constraints(idxs) \
+                if self._resolve_zero() is not None else None
+            donate = (0, 2) if self._donate else ()
+            if constraints is not None:
+                donate = self._zero_safe_donate(donate)
 
             def stacked_with_sync(*a):
                 import jax.numpy as jnp
 
                 nw, ns = stacked(*a)
+                if constraints is not None:
+                    nw, ns = _apply_constraints(nw, ns, constraints)
                 # tiny NON-donated output depending on the update: the
                 # throttle's sync leaf (every other output is a donated
                 # alias, which block_until_ready can't wait on)
@@ -814,6 +1004,9 @@ class Trainer:
                 return nw, ns, sync
 
             self._fused_fn = jax.jit(stacked_with_sync, donate_argnums=donate)
+            if telemetry.enabled():
+                telemetry.gauge("optimizer_state_bytes_per_device") \
+                    .set(self.optimizer_state_bytes_per_device())
         ts, lr, keys = self._step_scalars(idxs)
         weights = tuple(self._params[i]._data_nd._data for i in idxs)
         grads = tuple(raw(self._params[i].grad()) for i in idxs)
@@ -908,13 +1101,18 @@ class Trainer:
         sig = (id(block), block._cache_version, pending.training,
                pending.arg_tree, pending.head_positions,
                tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
-        if self._chain_buf and (ctx is None or ctx["sig"] != sig
-                                or ctx["mults"] != mults):
-            # shape/block change mid-chain: flush before rebuilding so
-            # the rebuild sees real (post-chain) weights
+        zsig = self._zero_sig()
+        stale = (ctx is None or ctx["sig"] != sig or ctx["mults"] != mults
+                 or ctx.get("zero_sig") != zsig)
+        if self._chain_buf and stale:
+            # shape/block/zero-mode change mid-chain: flush before
+            # rebuilding so the rebuild sees real (post-chain) weights
             self._flush_chain()
             ctx = self._fullstep_ctx
-        if ctx is None or ctx["sig"] != sig or ctx["mults"] != mults:
+            stale = (ctx is None or ctx["sig"] != sig
+                     or ctx["mults"] != mults
+                     or ctx.get("zero_sig") != zsig)
+        if stale:
             ctx = self._prepare_full_step(pending, sig)
             if ctx is None:
                 return False
@@ -954,6 +1152,8 @@ class Trainer:
             opt.num_update = prev_num_update
             raise
         ctx["ts_dev"] = new_ts
+        if telemetry.enabled():
+            self._count_collective_bytes(ctx, 1)
         pending.fill_from_full_step(out_leaves, new_aux,
                                     grads if self._keep_grads else None)
         for nd, nw in zip(ctx["nds"], new_w):
@@ -999,13 +1199,35 @@ class Trainer:
         if set(idx_of) != managed:
             return None  # stale grads would go unnoticed — fall back
         self._sync_states()
+        self._canonicalize_states()
         for i in idx_of:
             if i not in self._states:
                 self._states[i] = self._shard_state_like(
                     opt.create_state_multi_precision(i, self._params[i].data()),
                     self._params[i]._data_nd._data)
         mults = self._mults_key(idx_of)
-        fn, pure = self._build_full_step(pending, mults)
+        fn = pure = None
+        zero_bytes = None
+        zr = self._resolve_zero()
+        if zr is not None and zr["tier"] == "explicit":
+            built = self._try_build_zero_explicit(pending, mults, zr, idx_of)
+            if built is None:
+                zr = self._resolve_zero()  # sticky fallback → gspmd
+            else:
+                fn, pure, zstates, zero_bytes = built
+                for i, st in zip(idx_of, zstates):
+                    self._states[i] = st
+        if fn is None:
+            constraints = self._zero_constraints(idx_of) \
+                if zr is not None else None
+            fn, pure = self._build_full_step(pending, mults, constraints)
+            if zr is not None:
+                # gspmd tier: the data-axis gradient sync stays an
+                # all-reduce (plan-level estimate for telemetry)
+                zero_bytes = {"all-reduce": sum(
+                    _aval_bytes(self._params[i]._data_nd._data)
+                    for i in idx_of)}
+        zsig = None if zr is None else (zr["tier"], zr["axis"], zr["D"])
 
         held = sum(_aval_bytes(a) for a in pending.out_avals)
         held += sum(_aval_bytes(a) for a in pending.aux_raws)  # new_aux outputs
@@ -1021,7 +1243,7 @@ class Trainer:
                         for i in idx_of
                         for l in jax.tree_util.tree_leaves(self._states[i]))
             held += sum(_aval_bytes(a) for a in pending.input_raws)
-        return {
+        ctx = {
             "sig": sig,
             "mults": mults,
             "idx_of": idx_of,
@@ -1030,7 +1252,15 @@ class Trainer:
             "fn": fn,
             "pure": pure,
             "held_bytes": held,
+            "zero_sig": zsig,
+            "zero_bytes": zero_bytes,
         }
+        if telemetry.enabled():
+            telemetry.gauge("optimizer_state_bytes_per_device") \
+                .set(self.optimizer_state_bytes_per_device())
+        if self._capture_hlo:
+            self.last_step_hlo = self._lower_step_hlo(fn, pending, ctx)
+        return ctx
 
     def _sync_states(self):
         """Write the fullstep ctx's states back into the per-index dict."""
@@ -1039,7 +1269,7 @@ class Trainer:
             self._states.update(zip(ctx["idx_of"], ctx["states"]))
         self._states_stale = False
 
-    def _build_full_step(self, pending, mults):
+    def _build_full_step(self, pending, mults, constraints=None):
         import jax.numpy as jnp
 
         block = pending.block
@@ -1067,6 +1297,10 @@ class Trainer:
             new_w, new_s = stacked(train_raws, grads, states,
                                    ts.astype(jnp.float32), lr, wd,
                                    rescale, keys)
+            if constraints is not None:
+                # ZeRO gspmd tier: keep new states data-sharded and new
+                # weights on the original param layout across donation
+                new_w, new_s = _apply_constraints(new_w, new_s, constraints)
             out_leaves = jax.tree_util.tree_leaves(out)
             out_grads = tuple(grads) if keep_grads else ()
             # tiny NON-donated output depending on the update: the
@@ -1082,7 +1316,291 @@ class Trainer:
                     new_ts, sync)
 
         donate = (0, 2, 6) if self._donate else ()
+        if constraints is not None:
+            donate = self._zero_safe_donate(donate)
         return jax.jit(full, donate_argnums=donate), full
+
+    # ------------------------------------------------------------------ #
+    # ZeRO-1 explicit tier: the whole step (fwd + vjp + sharded update)
+    # under a fully-manual shard_map over the data axis, so the gradient
+    # sync is a REAL reduce-scatter and the updated params come back
+    # with one all-gather (gluon/zero.py module docstring)
+    # ------------------------------------------------------------------ #
+    def _zero_fallback_gspmd(self, reason: str):
+        """Sticky fallback: later _zero_sig()/_resolve_zero() calls keep
+        answering 'gspmd', so the fullstep ctx stays cache-stable."""
+        self._zero_collectives = "gspmd"
+        self._warn_zero_once(
+            "explicit_fallback",
+            f"Trainer ZeRO-1: explicit reduce-scatter tier unavailable "
+            f"({reason}) — using the GSPMD sharding tier")
+
+    def _count_collective_bytes(self, ctx, k: int):
+        zb = ctx.get("zero_bytes")
+        if not zb:
+            return
+        for op, b in zb.items():
+            telemetry.counter("collective_bytes_total",
+                              labels={"op": op}).inc(int(b) * k)
+
+    def _lower_step_hlo(self, fn, pending, ctx):
+        """Compiled-HLO capture of the fused step (tests/dryrun gates:
+        reduce-scatter > 0, per-axis all-reduce attribution).  AOT
+        lower+compile — the regular jit call cache is untouched."""
+        try:
+            import jax.numpy as jnp
+
+            from .block import _resolve_raws
+
+            opt = self._optimizer
+            # only shapes/dtypes matter for lowering: the update counts
+            # may not exist yet at prepare time, so feed a zero vector
+            args = (_resolve_raws(pending.train_raws),
+                    _resolve_raws(pending.aux_raws), ctx["states"],
+                    pending.rng, pending.rng_ctr,
+                    tuple(self._shard_inputs(pending.input_raws)),
+                    jnp.zeros((len(ctx["idx_of"]),), jnp.int32),
+                    float(opt.learning_rate), float(opt.wd),
+                    float(opt.rescale_grad), None)
+            return fn.lower(*args).compile().as_text()
+        except Exception:
+            return None
+
+    def _try_build_zero_explicit(self, pending, mults, zr, idx_of):
+        """Build the explicit-tier step, or None (sticky gspmd fallback)
+        when this pending/mesh/optimizer combination can't take it."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from . import zero as zero_mod
+        from .block import _resolve_raws
+
+        mesh, axis, D = zr["mesh"], zr["axis"], zr["D"]
+        opt = self._optimizer
+        batch = None
+        for r in pending.input_raws:
+            if hasattr(r, "shape") and getattr(r, "ndim", 0) >= 1:
+                batch = int(r.shape[0])
+                break
+        if batch is None or batch % D != 0:
+            self._zero_fallback_gspmd(
+                f"leading batch dim {batch} is not divisible by the "
+                f"data axis ({D})")
+            return None
+
+        def on_data(r):
+            sh = getattr(r, "sharding", None)
+            return isinstance(sh, NamedSharding) and any(
+                s == axis or (isinstance(s, tuple) and axis in s)
+                for s in sh.spec)
+
+        train_raws = _resolve_raws(pending.train_raws)
+        aux_raws = _resolve_raws(pending.aux_raws)
+        if any(on_data(r) for r in train_raws) \
+                or any(on_data(r) for r in aux_raws):
+            self._zero_fallback_gspmd(
+                "some parameters are already sharded on the data axis")
+            return None
+        input_specs = []
+        for r in pending.input_raws:
+            if hasattr(r, "shape") and getattr(r, "ndim", 0) >= 1 \
+                    and r.shape[0] == batch:
+                input_specs.append(P(axis, *([None] * (r.ndim - 1))))
+            elif on_data(r):
+                self._zero_fallback_gspmd(
+                    "a non-batch input is sharded on the data axis")
+                return None
+            else:
+                input_specs.append(P())
+        out_batch = tuple(
+            getattr(a, "ndim", 0) >= 1 and tuple(a.shape)[0] == batch
+            for a in pending.out_avals)
+        try:
+            zstates = []
+            for i in idx_of:
+                w = self._params[i]._data_nd._data
+                mp = bool(opt.multi_precision
+                          and w.dtype in (jnp.float16, jnp.bfloat16))
+                zstates.append(
+                    zero_mod.adopt(self._states[i], w, D, mesh, axis, mp))
+            zstates = tuple(zstates)
+            zinfo = {"mesh": mesh, "axis": axis, "D": D, "zstates": zstates,
+                     "out_batch": out_batch,
+                     "input_specs": tuple(input_specs)}
+            fn, pure = self._build_full_step_zero(pending, mults, zinfo)
+            # trace-level validation BEFORE anything can be donated: the
+            # global output shapes must match the replicated path's
+            # (catches batch-flag mis-inference and rules/ops that don't
+            # trace under the manual mesh)
+            outs = jax.eval_shape(
+                pure, tuple(train_raws), tuple(aux_raws), zstates,
+                pending.rng, pending.rng_ctr, tuple(pending.input_raws),
+                jnp.zeros((len(idx_of),), jnp.int32),
+                jnp.float32(0), jnp.float32(0), jnp.float32(1), None)
+            got = [tuple(a.shape) for a in outs[0]]
+            want = [tuple(a.shape) for a in pending.out_avals]
+            if got != want:
+                raise zero_mod.ZeroIncompatible(
+                    f"output shapes {got} != replicated {want}")
+        except Exception as e:
+            self._zero_fallback_gspmd(
+                f"explicit-tier build failed: {type(e).__name__}: "
+                f"{str(e)[:300]}")
+            return None
+        rs_bytes = ag_bytes = 0
+        for z, i in zip(zstates, idx_of):
+            w = self._params[i]._data_nd._data
+            item = _aval_bytes(w) // max(1, w.size) if w.size else 1
+            rs_bytes += z.meta.npad * item
+            ag_bytes += z.meta.npad * item
+            if self._keep_grads:
+                ag_bytes += z.meta.npad * item
+        zero_bytes = {"reduce-scatter": rs_bytes, "all-gather": ag_bytes}
+        return fn, pure, zstates, zero_bytes
+
+    def _build_full_step_zero(self, pending, mults, zinfo):
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.compat import shard_map
+        from . import zero as zero_mod
+
+        mesh, axis, D = zinfo["mesh"], zinfo["axis"], zinfo["D"]
+        metas = tuple(z.meta for z in zinfo["zstates"])
+        out_batch = zinfo["out_batch"]
+        block = pending.block
+        raw_fn_jit = block._cached_fn
+        training, arg_tree = pending.training, pending.arg_tree
+        lr_mults, wd_mults, clip = mults
+        opt = self._optimizer
+        keep_grads = self._keep_grads
+        heads = pending.head_positions
+        inv_d = 1.0 / D
+        n_train = len(metas)
+
+        def body(train_raws, aux_raws, states, rng, rng_ctr, input_raws, ts,
+                 lr, wd, rescale, keys):
+            def f(tr):
+                out, new_aux = raw_fn_jit(training, arg_tree, tr, aux_raws,
+                                          rng, rng_ctr, *input_raws)
+                return out, new_aux
+
+            out, pullback, new_aux = jax.vjp(f, tuple(train_raws),
+                                             has_aux=True)
+            leaves, tdef = jax.tree_util.tree_flatten(out)
+            cts = []
+            for i, l in enumerate(leaves):
+                if heads is not None and i not in heads:
+                    cts.append(jnp.zeros_like(l))
+                elif out_batch[i]:
+                    # batch-sharded head: local ones == the global ones
+                    # cotangent restricted to this shard — exact
+                    cts.append(jnp.ones_like(l))
+                else:
+                    # reduced (scalar) head under the batch-MEAN loss
+                    # convention: global mean = mean of per-shard means,
+                    # so each shard contributes 1/D of the cotangent
+                    cts.append(jnp.full_like(l, inv_d))
+            (grads,) = pullback(jax.tree_util.tree_unflatten(tdef, cts))
+            tsf = ts.astype(jnp.float32)
+            shard_idx = lax.axis_index(axis)
+            new_w, new_s, out_grads = [], [], []
+            for j in range(n_train):
+                m = metas[j]
+                w = train_raws[j]
+                g = grads[j].reshape(-1)
+                if m.npad != m.n:
+                    g = jnp.pad(g, (0, m.npad - m.n))
+                # THE ZeRO-1 exchange: sum+shard the gradient in one op
+                g_sh = lax.psum_scatter(g, axis, tiled=True)
+                st = states[j]
+                if m.mp:
+                    # fp32 master (canonical leaf 0) doubles as the
+                    # local weight — no extra copy
+                    w_loc = st.leaves[0].astype(w.dtype)
+                else:
+                    # slice this device's weight shard out of the
+                    # replicated parameter (pad keeps it aligned with
+                    # the reduce-scattered gradient)
+                    w_pad = w.reshape(-1)
+                    if m.npad != m.n:
+                        w_pad = jnp.pad(w_pad, (0, m.npad - m.n))
+                    chunk = m.npad // D
+                    w_loc = lax.dynamic_slice(w_pad, (shard_idx * chunk,),
+                                              (chunk,))
+                inner = jax.tree_util.tree_unflatten(m.treedef, st.leaves)
+                nw_l, ns = opt.pure_update_multi_precision(
+                    w_loc, g_sh, inner, tsf[j], lr * lr_mults[j],
+                    wd * wd_mults[j], rescale, clip, None)
+                ns_leaves = tuple(jax.tree_util.tree_leaves(ns))
+                new_s.append(zero_mod.Zero1State(ns_leaves, m))
+                wf = lax.all_gather(nw_l, axis, tiled=True, axis=0)
+                wf = wf[:m.n].reshape(m.w_shape)
+                if wf.dtype != w.dtype:
+                    wf = wf.astype(w.dtype)
+                new_w.append(wf)
+                if keep_grads:
+                    gf = lax.all_gather(g_sh, axis, tiled=True, axis=0)
+                    new_g = gf[:m.n].reshape(m.w_shape)
+                    out_grads.append(new_g.astype(grads[j].dtype))
+            out_leaves = list(leaves)
+            for i, l in enumerate(out_leaves):
+                if not out_batch[i] and jnp.issubdtype(l.dtype, jnp.floating):
+                    # reduced heads/outputs: report the global (batch-
+                    # mean) value, not this shard's local reduction
+                    out_leaves[i] = lax.pmean(l, axis)
+            new_aux = jax.tree_util.tree_map(
+                lambda a: lax.pmean(a, axis)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, new_aux)
+            sync = new_w[0].ravel()[0].astype(jnp.float32) if new_w \
+                else jnp.float32(0)
+            new_ts = ts + 1
+            return (tuple(out_leaves), new_aux, tuple(out_grads),
+                    tuple(new_w), tuple(new_s), new_ts, sync)
+
+        state_specs = tuple(zero_mod.spec_state(m, axis) for m in metas)
+        in_specs = (
+            tuple(P() for _ in range(n_train)),          # train_raws
+            P(),                                          # aux_raws
+            state_specs,                                  # Zero1States
+            P(), P(),                                     # rng, rng_ctr
+            zinfo["input_specs"],                         # batch inputs
+            P(), P(), P(), P(), P(),                      # ts/lr/wd/rescale/keys
+        )
+        out_specs = (
+            tuple(P(axis, *([None] * (max(0, a.ndim - 1)))) if out_batch[i]
+                  else P() for i, a in enumerate(pending.out_avals)),
+            P(),                                          # new_aux
+            tuple(P() for _ in range(n_train)) if keep_grads else (),
+            tuple(P() for _ in range(n_train)),           # new_w
+            state_specs,                                  # new states
+            P(), P(),                                     # new_ts, sync
+        )
+        shmapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+
+        def full_zero(*a):
+            return shmapped(*a)
+
+        donate = self._zero_safe_donate((0, 2, 6) if self._donate else ())
+        return jax.jit(full_zero, donate_argnums=donate), shmapped
+
+    def _zero_safe_donate(self, donate):
+        """jaxlib 0.4.x CPU: a donated executable holding ZeRO-sharded
+        optimizer state (explicit shard_map tier OR gspmd constraint
+        tier) has corrupted input-output aliasing when DESERIALIZED
+        from the persistent compilation cache — heap corruption or NaN
+        params in the second process to run it.  The pre-ZeRO programs
+        are unaffected.  Drop donation for ZeRO programs when a cache
+        dir is active on the CPU backend, where the virtual-device
+        parity tests run; real accelerator runs keep donation."""
+        import jax
+
+        if donate and jax.default_backend() == "cpu" \
+                and jax.config.jax_compilation_cache_dir:
+            return ()
+        return donate
 
     def _allreduce_grads_packed(self):
         """ONE compressed exchange for the whole model: concat all grads
@@ -1174,6 +1692,7 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         self._sync_states()
         self._fullstep_ctx = None  # eager updates replace ctx-held states
+        self._canonicalize_states()  # per-key rules need full-shape leaves
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data_nd is None:
                 continue
@@ -1189,12 +1708,13 @@ class Trainer:
     def save_states(self, fname):
         import pickle
 
-        import jax
-
         self._flush_chain()
         self._sync_states()
         with open(fname, "wb") as f:
-            states_host = jax.tree_util.tree_map(lambda x: jax.device_get(x), self._states)
+            # host_states fetches leaf-at-a-time and converts any ZeRO-
+            # sharded layout to canonical full shapes — a sharded state
+            # is never materialized as a full device replica to be saved
+            states_host = self.host_states()
             pickle.dump({"states": states_host,
                          "num_update": self._optimizer.num_update,
                          "index_update_count": self._optimizer._index_update_count},

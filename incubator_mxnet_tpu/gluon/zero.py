@@ -1,0 +1,234 @@
+"""ZeRO stage-1 optimizer-state sharding (Rajbhandari et al., SC'20).
+
+The Trainer's fused step keeps one full optimizer-state replica per
+device; on a mesh with a non-trivial ``"data"`` axis that replication is
+pure waste — every data shard applies the SAME update.  ZeRO-1 divides
+the state (momentum, Adam m/v, fp32 master weights) across the data
+axis: gradients arrive via **reduce-scatter** instead of all-reduce,
+each device updates only its 1/D shard, and the updated parameters come
+back with an **all-gather**.  Same math, same wire bytes (a reduce-
+scatter plus an all-gather moves what one all-reduce does), state
+memory divided by D.
+
+This module holds the layout machinery shared by the Trainer's two
+ZeRO tiers:
+
+``explicit`` (data-only meshes)
+    The whole fused step runs under a fully-manual ``shard_map`` over
+    the data axis; every state leaf that is weight-shaped is flattened,
+    zero-padded to a multiple of D, and carried as a ``P("data")``
+    NamedSharded flat buffer (:class:`Zero1State`).  ``lax.psum_scatter``
+    / ``lax.all_gather`` appear literally in the program, so compiled
+    HLO shows real reduce-scatter ops.
+
+``gspmd`` (mixed TP×DP meshes)
+    State leaves keep their canonical shapes but their NamedSharding
+    gains the data axis on the first free, divisible dimension
+    (:func:`gspmd_state_sharding`); ``with_sharding_constraint`` pins
+    the fused step's outputs so the partitioner keeps the layout.
+    Numerics are bit-identical to the replicated path.
+
+Padding is zero-filled and self-consistent: padded gradient entries are
+always zero, so every shipped update rule (they all map g=0, w=0 to a
+zero step) keeps the pad region at zero, and the all-gather slices it
+off before reshaping parameters back.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Zero1State", "ZeroMeta", "adopt", "canonical", "host_canonical",
+           "spec_state", "state_bytes_per_device", "leaf_shard_bytes",
+           "gspmd_state_sharding", "ZeroIncompatible"]
+
+
+class ZeroIncompatible(Exception):
+    """This parameter/state cannot take the explicit ZeRO layout."""
+
+
+class ZeroMeta(NamedTuple):
+    """Static (hashable) description of one parameter's ZeRO layout.
+
+    ``flags`` has one entry per canonical state leaf: ``None`` for a
+    passthrough (replicated) leaf, else ``(n, npad, shape, dtype_str)``
+    of the flattened original.  Multi-precision parameters lead with the
+    fp32 master (canonical leaf 0), which doubles as the local weight;
+    non-multi-precision updates slice their weight shard from the
+    replicated parameter with ``lax.axis_index`` inside the manual
+    ``shard_map`` — no weight copy rides in the state.
+    """
+    treedef: object            # canonical state tree structure
+    flags: Tuple               # per-leaf layout, see above
+    has_zw: bool               # unused (kept for pickle/meta stability)
+    mp: bool                   # multi-precision: leaves[0] is the fp32 master
+    n: int                     # weight element count
+    npad: int                  # padded element count (multiple of D)
+    w_shape: Tuple[int, ...]
+    w_dtype: str
+    D: int
+
+
+@jax.tree_util.register_pytree_node_class
+class Zero1State:
+    """Pytree carrying one parameter's sharded optimizer state.
+
+    Children are the (flat-padded, ``P("data")``-sharded) state leaves,
+    and the :class:`ZeroMeta` rides as static aux data, so jit caching
+    keys on the layout."""
+
+    def __init__(self, leaves, meta: ZeroMeta):
+        self.leaves = tuple(leaves)
+        self.meta = meta
+
+    def tree_flatten(self):
+        return self.leaves, self.meta
+
+    @classmethod
+    def tree_unflatten(cls, meta, leaves):
+        return cls(leaves, meta)
+
+    def __repr__(self):
+        return (f"Zero1State(n={self.meta.n}, npad={self.meta.npad}, "
+                f"D={self.meta.D}, mp={self.meta.mp}, "
+                f"leaves={len(self.leaves)})")
+
+
+def _pad_flat(leaf, npad: int):
+    flat = leaf.reshape(-1)
+    if flat.shape[0] != npad:
+        flat = jnp.pad(flat, (0, npad - flat.shape[0]))
+    return flat
+
+
+def adopt(state, w, D: int, mesh, axis: str, mp: bool) -> Zero1State:
+    """Canonical full-shape state → explicit-tier :class:`Zero1State`.
+
+    Weight-shaped leaves are flattened, zero-padded to a multiple of D
+    and placed ``P(axis)``; every other leaf (e.g. Nadam's scalar
+    m_schedule) passes through replicated.  Raises
+    :class:`ZeroIncompatible` when the layout can't represent the state
+    (caller falls back to the GSPMD tier)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    w_shape = tuple(w.shape)
+    n = max(1, math.prod(w_shape))
+    npad = -(-n // D) * D
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    flags = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and tuple(leaf.shape) == w_shape:
+            flags.append((n, npad, w_shape, str(leaf.dtype)))
+        elif hasattr(leaf, "shape"):
+            flags.append(None)
+        else:
+            raise ZeroIncompatible("non-array optimizer state leaf")
+    if mp and (not flags or flags[0] is None):
+        raise ZeroIncompatible(
+            "multi-precision state does not lead with a weight-shaped "
+            "master copy")
+    sharded = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    out = []
+    for leaf, flag in zip(leaves, flags):
+        if flag is None:
+            out.append(jax.device_put(leaf, rep))
+        else:
+            out.append(jax.device_put(_pad_flat(leaf, flag[1]), sharded))
+    meta = ZeroMeta(treedef=treedef, flags=tuple(flags), has_zw=False,
+                    mp=mp, n=n, npad=npad, w_shape=w_shape,
+                    w_dtype=str(w.dtype), D=D)
+    return Zero1State(out, meta)
+
+
+def _inner_leaves(z: Zero1State):
+    return z.leaves
+
+
+def canonical(z: Zero1State):
+    """:class:`Zero1State` → canonical full-shape state tree (device-
+    side; flat global arrays are sliced/reshaped lazily, no host trip)."""
+    m = z.meta
+    full = []
+    for leaf, flag in zip(_inner_leaves(z), m.flags):
+        if flag is None:
+            full.append(leaf)
+        else:
+            nleaf, _npad, shape, _dt = flag
+            full.append(leaf[:nleaf].reshape(shape))
+    return jax.tree_util.tree_unflatten(m.treedef, full)
+
+
+def host_canonical(z: Zero1State):
+    """Canonical full-shape state as host numpy, fetched ONE LEAF AT A
+    TIME — a ZeRO-sharded state is never materialized device-side as a
+    full replica just to be saved."""
+    import numpy as onp
+
+    m = z.meta
+    full = []
+    for leaf, flag in zip(_inner_leaves(z), m.flags):
+        host = onp.asarray(jax.device_get(leaf))
+        if flag is not None:
+            nleaf, _npad, shape, _dt = flag
+            host = host[:nleaf].reshape(shape)
+        full.append(host)
+    return jax.tree_util.tree_unflatten(m.treedef, full)
+
+
+def spec_state(meta: ZeroMeta, axis: str) -> Zero1State:
+    """shard_map in/out spec tree matching a :class:`Zero1State`."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = []
+    for flag in meta.flags:
+        specs.append(P(axis) if flag is not None else P())
+    return Zero1State(specs, meta)
+
+
+def leaf_shard_bytes(leaf) -> int:
+    """Per-device bytes of one array, from sharding metadata only."""
+    from jax.sharding import NamedSharding
+
+    try:
+        itemsize = int(jnp.dtype(leaf.dtype).itemsize)
+    except TypeError:
+        itemsize = 2
+    shape = tuple(getattr(leaf, "shape", ()))
+    sh = getattr(leaf, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        shape = sh.shard_shape(shape)
+    return (math.prod(shape) if shape else 1) * itemsize
+
+
+def state_bytes_per_device(state) -> int:
+    """Per-device bytes of a state tree (works for both canonical and
+    :class:`Zero1State` layouts — aval/sharding metadata only)."""
+    return sum(leaf_shard_bytes(l)
+               for l in jax.tree_util.tree_leaves(state)
+               if hasattr(l, "shape"))
+
+
+def gspmd_state_sharding(w, axis: str, D: int) -> Optional[object]:
+    """GSPMD-tier sharding for a weight-shaped state leaf: the weight's
+    own NamedSharding with ``axis`` added on the first dimension that is
+    unsharded and divisible by D.  None when no dimension qualifies (the
+    state then simply rides the weight's sharding, replicated over
+    data)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = getattr(w, "sharding", None)
+    if not isinstance(sh, NamedSharding) or axis not in sh.mesh.axis_names:
+        return None
+    shape = tuple(w.shape)
+    spec = list(sh.spec) + [None] * (len(shape) - len(sh.spec))
+    if any(s == axis or (isinstance(s, tuple) and axis in s) for s in spec):
+        return None  # already data-sharded (e.g. FSDP weights)
+    for d, dim in enumerate(shape):
+        if spec[d] is None and dim >= D and dim % D == 0:
+            spec[d] = axis
+            return NamedSharding(sh.mesh, P(*spec))
+    return None

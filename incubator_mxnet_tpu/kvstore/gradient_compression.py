@@ -69,6 +69,20 @@ class GradientCompression:
         self.threshold = float(threshold)
         self._residuals = {}
 
+    def reduce_scatter_incompatible_reason(self):
+        """Why this compression cannot ride a reduce-scatter gradient
+        sync (→ the Trainer's ZeRO-1 mode falls back to all-reduce with
+        a one-time logging.warning instead of silently changing the
+        numerics), or None if it composes."""
+        return (f"{self.type} compression quantizes against per-key "
+                "error-feedback residuals that require the FULL gradient "
+                "on every worker; a reduce-scatter hands each worker only "
+                "a 1/D shard, which would silently change the "
+                "quantization numerics")
+
+    def supports_reduce_scatter(self) -> bool:
+        return self.reduce_scatter_incompatible_reason() is None
+
     def _residual(self, key, grad_raw):
         res = self._residuals.get(key)
         if res is None:

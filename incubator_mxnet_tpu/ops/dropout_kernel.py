@@ -355,14 +355,35 @@ def _dp2d_partition(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
     return mesh, lower, canon, (canon, seed_sh)
 
 
-_dp2d.def_partition(
-    _dp2d_partition,
-    infer_sharding_from_operands=None,
-    # rows (i) AND cols (j) may shard — tile ids are global either way;
-    # only the seed (k) must replicate
-    sharding_rule="i j, k -> i j",
-    need_replication_factors=("k",),
-)
+def _dp2d_infer(rate, br, bc, ncb_g, mesh, arg_shapes, result_shape):
+    """Result sharding = x's spec clamped to tile-aligned dims — the
+    same canonicalization `_dp2d_partition` applies to its operands."""
+    x_info = arg_shapes[0]
+    x_sh = x_info.sharding
+    m = x_sh.mesh
+    R, Clp = x_info.shape
+    spec = tuple(x_sh.spec) + (None,) * (2 - len(x_sh.spec))
+    rows_spec, _ = _shard_count_and_offset(spec[0], m, R, br)
+    cols_spec, _ = _shard_count_and_offset(spec[1], m, Clp, bc)
+    return NamedSharding(m, P(rows_spec, cols_spec))
+
+
+try:
+    _dp2d.def_partition(
+        _dp2d_partition,
+        infer_sharding_from_operands=None,
+        # rows (i) AND cols (j) may shard — tile ids are global either
+        # way; only the seed (k) must replicate
+        sharding_rule="i j, k -> i j",
+        need_replication_factors=("k",),
+    )
+except TypeError:
+    # older jax: no sdy sharding_rule kwarg — the callback-based
+    # inference carries the same "keep x's tile-aligned spec" contract
+    _dp2d.def_partition(
+        _dp2d_partition,
+        infer_sharding_from_operands=_dp2d_infer,
+    )
 
 
 def _canonical_2d(x):

@@ -55,6 +55,13 @@ class Optimizer:
     """Base optimizer: per-weight state, lr/wd multipliers, loss-scale-aware."""
 
     needs_rng = False  # subclasses that draw randomness set True (SGLD)
+    # True when pure_update is a purely per-element rule: running it on
+    # an arbitrary slice of (w, g, state) yields the same elements as
+    # running it on the whole tensor.  This is what lets the Trainer's
+    # ZeRO-1 explicit tier apply the update on a flat 1/D shard.  Rules
+    # that consume whole-tensor statistics (LAMB/LARS trust ratios use
+    # global norms) set False and take the GSPMD sharding tier instead.
+    elementwise_update = True
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
@@ -402,6 +409,8 @@ class Ftrl(Optimizer):
 class LAMB(Optimizer):
     """Layer-wise adaptive moments for large-batch BERT (ref multi_lamb.cc)."""
 
+    elementwise_update = False  # trust ratio uses whole-tensor norms
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
                  lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -433,6 +442,8 @@ class LAMB(Optimizer):
 
 @register
 class LARS(Optimizer):
+    elementwise_update = False  # layer-wise rate uses whole-tensor norms
+
     def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
         self.momentum, self.eta, self.epsilon = momentum, eta, epsilon

@@ -1,0 +1,35 @@
+"""`shard_map` API compatibility.
+
+jax moved `shard_map` from `jax.experimental.shard_map` to the top-level
+namespace and renamed `check_rep` → `check_vma` along the way.  This
+wrapper resolves whichever implementation the installed jax provides and
+translates the replication-check kwarg in either direction, so call
+sites can be written against one spelling and run on both API
+generations.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _ACCEPTED = set(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+    _ACCEPTED = None
+
+__all__ = ["shard_map"]
+
+
+def shard_map(*args, **kwargs):
+    if _ACCEPTED is not None:
+        if ("check_vma" in kwargs and "check_vma" not in _ACCEPTED
+                and "check_rep" in _ACCEPTED):
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        elif ("check_rep" in kwargs and "check_rep" not in _ACCEPTED
+                and "check_vma" in _ACCEPTED):
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)

@@ -156,7 +156,7 @@ def pipeline_apply(stage_fn: Callable, all_stage_params, x, mesh: Mesh,
     size (module docstring).  For PP×TP TRAINING use
     `pipeline_train_1f1b`.
     """
-    from jax import shard_map
+    from .compat import shard_map
 
     B = x.shape[0]
     if B % num_microbatches:
@@ -415,7 +415,7 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
     pipeline microbatch-by-microbatch (or pre-shard x along a data axis
     composed with pipe) if that bites.
     """
-    from jax import shard_map
+    from .compat import shard_map
 
     B = x.shape[0]
     M = num_microbatches

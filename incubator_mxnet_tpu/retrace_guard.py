@@ -60,6 +60,10 @@ PROGRAM_NAMES: Set[str] = {
     "raw_fn", "grad_fn", "fwd_record_fn",       # hybridized block programs
     "chain", "chain_unrolled",                  # fused optimizer chains
     "stacked_with_sync", "full",                # fused train steps
+    "full_zero",                                # ZeRO-1 explicit-tier step:
+                                                # toggling zero_stage swaps
+                                                # programs and legitimately
+                                                # compiles this once
     "_flash_core",                              # flash-attention kernel jit
 }
 

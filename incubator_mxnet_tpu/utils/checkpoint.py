@@ -70,12 +70,19 @@ class CheckpointManager:
                     arrays[f"param:{name}"] = onp.asarray(
                         jax.device_get(p.data()._data))
         if trainer is not None:
-            if hasattr(trainer, "_flush_chain"):
-                trainer._flush_chain()  # drain buffered chained steps
-            trainer._sync_states()
+            if hasattr(trainer, "host_states"):
+                # flushes + syncs internally; ZeRO-sharded state comes
+                # back canonical, fetched leaf-at-a-time (never
+                # materialized as a full device-side replica)
+                states_host = trainer.host_states()
+            else:
+                if hasattr(trainer, "_flush_chain"):
+                    trainer._flush_chain()  # drain buffered chained steps
+                trainer._sync_states()
+                states_host = jax.tree_util.tree_map(
+                    lambda x: onp.asarray(jax.device_get(x)), trainer._states)
             blob["trainer"] = {
-                "states": jax.tree_util.tree_map(
-                    lambda x: onp.asarray(jax.device_get(x)), trainer._states),
+                "states": states_host,
                 "num_update": trainer._optimizer.num_update,
                 "index_update_count": dict(trainer._optimizer._index_update_count),
             }

@@ -132,7 +132,7 @@ def test_moe_dispatch_conservation():
 
 
 def test_collectives_psum_across_mesh():
-    from jax import shard_map
+    from incubator_mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = par.create_mesh(data=8)
@@ -305,7 +305,7 @@ def test_pipeline_1f1b_composes_with_tp_collectives():
     def loss_fn(y, t):
         return jnp.mean((y - t) ** 2)
 
-    from jax import shard_map
+    from incubator_mxnet_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def run(W1, W2):

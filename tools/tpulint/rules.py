@@ -21,9 +21,12 @@ from typing import Dict, List, Optional, Set
 
 from .analyzer import Finding, FunctionInfo, Project, dotted_name
 
-# parameters that are flags/contexts by convention, never arrays
+# parameters that are flags/contexts by convention, never arrays.
+# zero_stage is a Trainer config flag: branching on it swaps the fused
+# step program (one legitimate recompile), never a per-step retrace.
 NEVER_TAINTED_PARAMS = {"self", "cls", "F", "training", "mode", "ctx",
-                        "context", "deterministic", "axis", "name", "prefix"}
+                        "context", "deterministic", "axis", "name", "prefix",
+                        "zero_stage"}
 
 # attribute reads that are static under trace (aval metadata)
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
