@@ -24,4 +24,7 @@ JAX_PLATFORMS=cpu python ci/telemetry_smoke.py
 echo "input pipeline smoke: sync-vs-prefetched equivalence + metrics"
 JAX_PLATFORMS=cpu python ci/input_pipeline_smoke.py
 
+echo "overlap smoke: bucketed-vs-monolithic ZeRO parity + overlap_fraction"
+JAX_PLATFORMS=cpu python ci/overlap_smoke.py
+
 echo "lint gates: OK"

@@ -1,0 +1,126 @@
+"""CI smoke: backward-overlapped bucketed gradient sync (ISSUE 5).
+
+A 3-step train on a data=8 virtual-CPU mesh through the ZeRO explicit
+tier, once with `zero_overlap=True` (bucket cap forced tiny so the
+grads split into several buckets) and once with `zero_overlap=False`
+(the monolithic per-param exchange).  Asserts:
+
+  * the bucketed build engaged (>= 2 buckets, no sticky fallback),
+  * parameters MATCH the monolithic path (the interleaved pack layout
+    feeds the identical per-param shard update, so this is exact),
+  * telemetry's `overlap_fraction{source="plan"}` gauge is > 0, and the
+    compiled schedule hides every bucket behind independent compute
+    (`schedule_overlap_stats` overlap_fraction > 0).
+
+Run as `JAX_PLATFORMS=cpu python ci/overlap_smoke.py` (ci/lint.sh
+invokes it).
+"""
+import os
+import sys
+import tempfile
+
+# runnable as `python ci/overlap_smoke.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# env must be set BEFORE the package import: the virtual device count is
+# read at backend init, telemetry config at package import
+_FLAGS = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(
+    _FLAGS + ["--xla_force_host_platform_device_count=8"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MXTPU_TELEMETRY_DUMP"] = "1"
+# the atexit dump must not land in the invoking checkout
+os.environ["MXTPU_TELEMETRY_DIR"] = tempfile.mkdtemp(prefix="mxtpu_ov_smoke_")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import autograd, gluon, telemetry  # noqa: E402
+from incubator_mxnet_tpu.gluon import nn  # noqa: E402
+from incubator_mxnet_tpu.parallel import create_mesh, overlap  # noqa: E402
+
+
+class MLPWithLoss(gluon.nn.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.d1 = nn.Dense(64, activation="relu", in_units=32)
+        self.d2 = nn.Dense(64, activation="relu", in_units=64)
+        self.d3 = nn.Dense(8, in_units=64)
+        self.loss = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def forward(self, x, y):
+        return self.loss(self.d3(self.d2(self.d1(x))), y).mean()
+
+
+def run(zero_overlap):
+    np.random.seed(0)
+    mx.random.seed(0)
+    mesh = create_mesh(data=len(jax.devices()))
+    net = MLPWithLoss()
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    # 0.01 MB cap: this MLP's ~20 KB of fp32 grads split into >= 2 buckets
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2}, mesh=mesh,
+                            zero_stage=1, zero_overlap=zero_overlap,
+                            zero_bucket_mb=0.01)
+    trainer._capture_hlo = True
+    losses = []
+    with mesh:
+        for s in range(3):
+            rs = np.random.RandomState(s)
+            x = rs.randn(16, 32).astype(np.float32)
+            y = rs.randint(0, 8, (16,)).astype(np.int32)
+            with autograd.record():
+                loss = net(mx.nd.array(x), mx.nd.array(y))
+            loss.backward()
+            trainer.step(16)
+            losses.append(float(loss.asnumpy()))
+    params = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    return losses, params, trainer
+
+
+def main() -> int:
+    l_off, p_off, _ = run(zero_overlap=False)
+    l_on, p_on, tr = run(zero_overlap=True)
+
+    assert tr._zero_sig() == ("explicit", "data", 8), \
+        f"explicit ZeRO tier did not engage: {tr._zero_sig()}"
+    assert not tr._zero_overlap_broken, "bucketed build fell back"
+    bks = tr._fullstep_ctx.get("zero_buckets")
+    assert bks and len(bks) >= 2, f"bucket cap did not split grads: {bks}"
+
+    # parity: same losses, same params as the monolithic exchange.
+    # gluon name counters differ between the two instantiations, so
+    # compare in sorted order, not by name.
+    np.testing.assert_allclose(l_on, l_off, rtol=2e-4, atol=2e-5)
+    for (ka, va), (kb, vb) in zip(sorted(p_off.items()), sorted(p_on.items())):
+        np.testing.assert_allclose(va, vb, rtol=2e-3, atol=1e-4,
+                                   err_msg=f"{ka} vs {kb}")
+
+    # the trainer published the planned overlap fraction
+    prom = telemetry.exporters.prometheus_text(telemetry.get_registry())
+    frac = None
+    for line in prom.splitlines():
+        if line.startswith("overlap_fraction{") and 'source="plan"' in line:
+            frac = float(line.rpartition(" ")[2])
+    assert frac is not None and frac > 0, \
+        f"overlap_fraction{{source=plan}} not published (> 0): {frac}\n" \
+        + prom[:500]
+
+    # and the compiled schedule actually interleaves the collectives
+    st = overlap.schedule_overlap_stats(tr.last_step_hlo)
+    assert st["n_collectives"] == len(bks), st
+    assert st["overlap_fraction"] > 0, st
+
+    print(f"overlap smoke: OK (buckets={len(bks)}, "
+          f"plan_overlap_fraction={frac:.2f}, "
+          f"schedule_overlap_fraction={st['overlap_fraction']:.2f}, "
+          f"losses={l_on})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

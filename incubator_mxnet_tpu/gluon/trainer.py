@@ -86,7 +86,9 @@ class Trainer:
                  mesh=None, data_axis: str = "data",
                  chain_steps: int = 1, chain_unroll: bool = False,
                  zero_stage: Optional[int] = None,
-                 zero_collectives: str = "auto"):
+                 zero_collectives: str = "auto",
+                 zero_overlap: Optional[bool] = None,
+                 zero_bucket_mb: Optional[float] = None):
         if isinstance(params, (dict, ParameterDict)):
             param_list = [params[k] for k in sorted(params.keys())] \
                 if isinstance(params, dict) else list(params.values())
@@ -181,6 +183,17 @@ class Trainer:
                 f"got {zero_collectives!r}")
         self._zero_stage = zero_stage
         self._zero_collectives = zero_collectives
+        # Backward-overlapped bucketed gradient sync (parallel/overlap.py):
+        # None = env-resolved (MXTPU_ZERO_OVERLAP, default on).  Only the
+        # explicit tier buckets; the result is bit-identical to the
+        # monolithic per-param exchange (interleaved pack layout), so the
+        # knob exists for A/B measurement, not numerics.
+        if zero_bucket_mb is not None and float(zero_bucket_mb) <= 0:
+            raise ValueError(
+                f"zero_bucket_mb must be positive, got {zero_bucket_mb!r}")
+        self._zero_overlap = zero_overlap
+        self._zero_bucket_mb = zero_bucket_mb
+        self._zero_overlap_broken = False  # sticky: bucketed build failed
         self._zero_warned: set = set()  # one-time warning keys
         self._capture_hlo = False       # tests/dryrun: keep last_step_hlo
         self.last_step_hlo: Optional[str] = None
@@ -278,6 +291,18 @@ class Trainer:
     def _zero_sig(self):
         zr = self._resolve_zero()
         return None if zr is None else (zr["tier"], zr["axis"], zr["D"])
+
+    def _overlap_sig(self) -> Optional[int]:
+        """Bucket byte cap when the overlapped explicit exchange is
+        live, else None (off / env-disabled / sticky-broken).  Part of
+        the fullstep staleness signature so flipping the knob rebuilds."""
+        if self._zero_overlap_broken:
+            return None
+        from ..parallel import overlap as overlap_mod
+
+        if not overlap_mod.overlap_enabled(self._zero_overlap):
+            return None
+        return overlap_mod.resolve_bucket_bytes(self._zero_bucket_mb)
 
     def _canonicalize_states(self):
         """Convert any explicit-tier Zero1State entries back to the
@@ -1100,7 +1125,8 @@ class Trainer:
         mults = self._mults_key(idx_of) if idx_of is not None else None
         sig = (id(block), block._cache_version, pending.training,
                pending.arg_tree, pending.head_positions,
-               tuple((r.shape, str(r.dtype)) for r in pending.input_raws))
+               tuple((r.shape, str(r.dtype)) for r in pending.input_raws),
+               self._overlap_sig())
         zsig = self._zero_sig()
         stale = (ctx is None or ctx["sig"] != sig or ctx["mults"] != mults
                  or ctx.get("zero_sig") != zsig)
@@ -1208,13 +1234,14 @@ class Trainer:
         mults = self._mults_key(idx_of)
         fn = pure = None
         zero_bytes = None
+        zero_buckets = None
         zr = self._resolve_zero()
         if zr is not None and zr["tier"] == "explicit":
             built = self._try_build_zero_explicit(pending, mults, zr, idx_of)
             if built is None:
                 zr = self._resolve_zero()  # sticky fallback → gspmd
             else:
-                fn, pure, zstates, zero_bytes = built
+                fn, pure, zstates, zero_bytes, zero_buckets = built
                 for i, st in zip(idx_of, zstates):
                     self._states[i] = st
         if fn is None:
@@ -1254,6 +1281,7 @@ class Trainer:
             "held_bytes": held,
             "zero_sig": zsig,
             "zero_bytes": zero_bytes,
+            "zero_buckets": zero_buckets,
         }
         if telemetry.enabled():
             telemetry.gauge("optimizer_state_bytes_per_device") \
@@ -1334,6 +1362,53 @@ class Trainer:
             "explicit_fallback",
             f"Trainer ZeRO-1: explicit reduce-scatter tier unavailable "
             f"({reason}) — using the GSPMD sharding tier")
+
+    def _zero_overlap_fail(self, reason: str):
+        """Sticky fallback one level SHALLOWER than gspmd: the bucketed
+        (overlapped) exchange failed, keep the PR-4 monolithic explicit
+        tier — later _overlap_sig() calls answer None, so the fullstep
+        ctx stays cache-stable."""
+        self._zero_overlap_broken = True
+        self._warn_zero_once(
+            "overlap_fallback",
+            f"Trainer ZeRO-1: overlapped bucketed gradient sync "
+            f"unavailable ({reason}) — using the monolithic per-param "
+            f"exchange")
+
+    def _zero_overlap_plan(self, zstates, idx_of, D):
+        """Bucket plan for the overlapped exchange, or None when off.
+        Buckets group only same-(dtype, multi-precision) params so the
+        packed buffers never promote a dtype (bit-parity)."""
+        cap = self._overlap_sig()
+        if cap is None:
+            return None
+        from ..parallel import overlap as overlap_mod
+
+        try:
+            npads, items, keys = [], [], []
+            for z, i in zip(zstates, idx_of):
+                w = self._params[i]._data_nd._data
+                npads.append(z.meta.npad)
+                items.append(_aval_bytes(w) // max(1, w.size) if w.size else 1)
+                keys.append((str(z.meta.w_dtype), z.meta.mp))
+            buckets = overlap_mod.partition_buckets(npads, items, keys, D, cap)
+        except Exception as e:
+            self._zero_overlap_fail(
+                f"bucket partitioning failed: {type(e).__name__}: "
+                f"{str(e)[:200]}")
+            return None
+        if telemetry.enabled():
+            h = telemetry.histogram("grad_bucket_bytes")
+            for b in buckets:
+                h.observe(float(b.nbytes))
+            # plan-level estimate: the last bucket in backward order is
+            # the one with no backward compute left to hide behind
+            total = sum(b.nbytes for b in buckets)
+            if total:
+                telemetry.gauge("overlap_fraction",
+                                labels={"source": "plan"}) \
+                    .set(1.0 - buckets[-1].nbytes / total)
+        return buckets
 
     def _count_collective_bytes(self, ctx, k: int):
         zb = ctx.get("zero_bytes")
@@ -1426,22 +1501,48 @@ class Trainer:
             zstates = tuple(zstates)
             zinfo = {"mesh": mesh, "axis": axis, "D": D, "zstates": zstates,
                      "out_batch": out_batch,
-                     "input_specs": tuple(input_specs)}
-            fn, pure = self._build_full_step_zero(pending, mults, zinfo)
-            # trace-level validation BEFORE anything can be donated: the
-            # global output shapes must match the replicated path's
-            # (catches batch-flag mis-inference and rules/ops that don't
-            # trace under the manual mesh)
-            outs = jax.eval_shape(
-                pure, tuple(train_raws), tuple(aux_raws), zstates,
-                pending.rng, pending.rng_ctr, tuple(pending.input_raws),
-                jnp.zeros((len(idx_of),), jnp.int32),
-                jnp.float32(0), jnp.float32(0), jnp.float32(1), None)
-            got = [tuple(a.shape) for a in outs[0]]
-            want = [tuple(a.shape) for a in pending.out_avals]
-            if got != want:
-                raise zero_mod.ZeroIncompatible(
-                    f"output shapes {got} != replicated {want}")
+                     "input_specs": tuple(input_specs), "buckets": None}
+
+            def build(zinfo):
+                fn, pure = self._build_full_step_zero(pending, mults, zinfo)
+                # trace-level validation BEFORE anything can be donated:
+                # the global output shapes must match the replicated
+                # path's (catches batch-flag mis-inference and rules/ops
+                # that don't trace under the manual mesh)
+                outs = jax.eval_shape(
+                    pure, tuple(train_raws), tuple(aux_raws), zstates,
+                    pending.rng, pending.rng_ctr, tuple(pending.input_raws),
+                    jnp.zeros((len(idx_of),), jnp.int32),
+                    jnp.float32(0), jnp.float32(0), jnp.float32(1), None)
+                got = [tuple(a.shape) for a in outs[0]]
+                want = [tuple(a.shape) for a in pending.out_avals]
+                if got != want:
+                    raise zero_mod.ZeroIncompatible(
+                        f"output shapes {got} != replicated {want}")
+                return fn, pure
+
+            buckets = self._zero_overlap_plan(zstates, idx_of, D)
+            if buckets is not None:
+                # nudge the latency-hiding-scheduler flags on (no-op
+                # once the backend is initialized or off-TPU; see
+                # runtime.enable_collective_overlap for the early hook)
+                from .. import runtime as runtime_mod
+
+                runtime_mod.enable_collective_overlap()
+                try:
+                    zinfo["buckets"] = buckets
+                    fn, pure = build(zinfo)
+                except Exception as e:
+                    # bucketed segmentation failed: sticky fallback to
+                    # the PR-4 monolithic exchange, NOT all the way to
+                    # gspmd — the explicit tier itself is fine
+                    self._zero_overlap_fail(
+                        f"bucketed build failed: {type(e).__name__}: "
+                        f"{str(e)[:200]}")
+                    zinfo["buckets"] = buckets = None
+                    fn, pure = build(zinfo)
+            else:
+                fn, pure = build(zinfo)
         except Exception as e:
             self._zero_fallback_gspmd(
                 f"explicit-tier build failed: {type(e).__name__}: "
@@ -1456,17 +1557,19 @@ class Trainer:
             if self._keep_grads:
                 ag_bytes += z.meta.npad * item
         zero_bytes = {"reduce-scatter": rs_bytes, "all-gather": ag_bytes}
-        return fn, pure, zstates, zero_bytes
+        return fn, pure, zstates, zero_bytes, buckets
 
     def _build_full_step_zero(self, pending, mults, zinfo):
         import jax.numpy as jnp
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
+        from ..parallel import overlap as overlap_mod
         from ..parallel.compat import shard_map
         from . import zero as zero_mod
 
         mesh, axis, D = zinfo["mesh"], zinfo["axis"], zinfo["D"]
+        buckets = zinfo.get("buckets")  # None = monolithic per-param sync
         metas = tuple(z.meta for z in zinfo["zstates"])
         out_batch = zinfo["out_batch"]
         block = pending.block
@@ -1505,15 +1608,38 @@ class Trainer:
             (grads,) = pullback(jax.tree_util.tree_unflatten(tdef, cts))
             tsf = ts.astype(jnp.float32)
             shard_idx = lax.axis_index(axis)
-            new_w, new_s, out_grads = [], [], []
+            # -- exchange: sum+shard every gradient ------------------- #
+            g_pad = []
+            for j in range(n_train):
+                g = grads[j].reshape(-1)
+                if metas[j].npad != metas[j].n:
+                    g = jnp.pad(g, (0, metas[j].npad - metas[j].n))
+                g_pad.append(g)
+            g_shard = [None] * n_train
+            if buckets is None:
+                # THE ZeRO-1 exchange: one psum_scatter per parameter
+                for j in range(n_train):
+                    g_shard[j] = lax.psum_scatter(g_pad[j], axis, tiled=True)
+            else:
+                # overlapped tier: one psum_scatter per BUCKET, issued
+                # in backward order — bucket 0's cotangents are complete
+                # while earlier layers are still backpropagating, so the
+                # latency-hiding scheduler floats each collective over
+                # the remaining backward matmuls.  The interleaved pack
+                # keeps every shard bit-identical to the per-param ops
+                # (parallel/overlap.py module docstring).
+                for b in buckets:
+                    packed = overlap_mod.pack_bucket(
+                        [g_pad[j] for j in b.idxs], D)
+                    sh = lax.psum_scatter(packed, axis, tiled=True)
+                    for j, seg in zip(b.idxs,
+                                      overlap_mod.unpack_shards(sh, b.chunks)):
+                        g_shard[j] = seg
+            # -- shard-local optimizer update ------------------------- #
+            new_s, nw_locs = [], []
             for j in range(n_train):
                 m = metas[j]
                 w = train_raws[j]
-                g = grads[j].reshape(-1)
-                if m.npad != m.n:
-                    g = jnp.pad(g, (0, m.npad - m.n))
-                # THE ZeRO-1 exchange: sum+shard the gradient in one op
-                g_sh = lax.psum_scatter(g, axis, tiled=True)
                 st = states[j]
                 if m.mp:
                     # fp32 master (canonical leaf 0) doubles as the
@@ -1531,19 +1657,52 @@ class Trainer:
                                               (chunk,))
                 inner = jax.tree_util.tree_unflatten(m.treedef, st.leaves)
                 nw_l, ns = opt.pure_update_multi_precision(
-                    w_loc, g_sh, inner, tsf[j], lr * lr_mults[j],
+                    w_loc, g_shard[j], inner, tsf[j], lr * lr_mults[j],
                     wd * wd_mults[j], rescale, clip, None)
                 ns_leaves = tuple(jax.tree_util.tree_leaves(ns))
                 new_s.append(zero_mod.Zero1State(ns_leaves, m))
-                wf = lax.all_gather(nw_l, axis, tiled=True, axis=0)
-                wf = wf[:m.n].reshape(m.w_shape)
-                if wf.dtype != w.dtype:
-                    wf = wf.astype(w.dtype)
-                new_w.append(wf)
-                if keep_grads:
-                    gf = lax.all_gather(g_sh, axis, tiled=True, axis=0)
-                    new_g = gf[:m.n].reshape(m.w_shape)
-                    out_grads.append(new_g.astype(grads[j].dtype))
+                nw_locs.append(nw_l)
+
+            # -- gather: rebuild full params (and grads) -------------- #
+            def finish_w(j, w_full):
+                m = metas[j]
+                wf = w_full[:m.n].reshape(m.w_shape)
+                if wf.dtype != train_raws[j].dtype:
+                    wf = wf.astype(train_raws[j].dtype)
+                return wf
+
+            def finish_g(j, g_full):
+                m = metas[j]
+                return g_full[:m.n].reshape(m.w_shape).astype(grads[j].dtype)
+
+            new_w = [None] * n_train
+            out_grads = [None] * n_train if keep_grads else []
+            if buckets is None:
+                for j in range(n_train):
+                    wf = lax.all_gather(nw_locs[j], axis, tiled=True, axis=0)
+                    new_w[j] = finish_w(j, wf)
+                    if keep_grads:
+                        gf = lax.all_gather(g_shard[j], axis, tiled=True,
+                                            axis=0)
+                        out_grads[j] = finish_g(j, gf)
+            else:
+                # symmetric bucketed return trip: one all_gather per
+                # bucket of updated weight shards (and grad shards)
+                for b in buckets:
+                    wt = lax.all_gather(
+                        overlap_mod.pack_shards([nw_locs[j] for j in b.idxs]),
+                        axis, tiled=True, axis=0)
+                    for j, wp in zip(b.idxs, overlap_mod.unpack_gathered(
+                            wt, b.chunks, D)):
+                        new_w[j] = finish_w(j, wp)
+                    if keep_grads:
+                        gt = lax.all_gather(
+                            overlap_mod.pack_shards(
+                                [g_shard[j] for j in b.idxs]),
+                            axis, tiled=True, axis=0)
+                        for j, gp in zip(b.idxs, overlap_mod.unpack_gathered(
+                                gt, b.chunks, D)):
+                            out_grads[j] = finish_g(j, gp)
             out_leaves = list(leaves)
             for i, l in enumerate(out_leaves):
                 if not out_batch[i] and jnp.issubdtype(l.dtype, jnp.floating):

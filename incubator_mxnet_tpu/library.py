@@ -38,6 +38,17 @@ def loaded_ops() -> List[str]:
     return sorted(_LOADED.keys())
 
 
+def _jax_ffi():
+    """The FFI namespace: ``jax.ffi`` (>= 0.5) or ``jax.extend.ffi``
+    (0.4.x — identical register/call/include_dir surface)."""
+    import jax
+
+    ffi = getattr(jax, "ffi", None)
+    if ffi is None:
+        from jax.extend import ffi
+    return ffi
+
+
 def _capsule(ptr: int):
     """Wrap a raw function pointer in a PyCapsule for jax.ffi."""
     PyCapsule_New = ctypes.pythonapi.PyCapsule_New
@@ -106,8 +117,8 @@ def load(path: str, verbose: bool = True):
             g = lib.mxtpu_plugin_op_grad_of(i)
             grad_of = g.decode() if g else None
         target = f"mxtpu_plugin_{libtag}_{name}"
-        jax.ffi.register_ffi_target(target, _capsule(lib.mxtpu_plugin_op_handler(i)),
-                                    platform="cpu")
+        _jax_ffi().register_ffi_target(
+            target, _capsule(lib.mxtpu_plugin_op_handler(i)), platform="cpu")
         entries.append((name, grad_of, target))
 
     grads = {g: t for (name, g, t) in entries if g}
@@ -136,7 +147,7 @@ def _make_op(name: str, target: str, grad_target: Optional[str]):
     from .ndarray.ndarray import apply_op, wrap
 
     def raw_call(x):
-        call = jax.ffi.ffi_call(
+        call = _jax_ffi().ffi_call(
             target, jax.ShapeDtypeStruct(x.shape, x.dtype))
         return call(x)
 
@@ -155,7 +166,7 @@ def _make_op(name: str, target: str, grad_target: Optional[str]):
         return core(x), x
 
     def bwd(x, dy):
-        call = jax.ffi.ffi_call(
+        call = _jax_ffi().ffi_call(
             grad_target, jax.ShapeDtypeStruct(x.shape, x.dtype))
         return (call(x, dy),)
 
@@ -184,7 +195,7 @@ def build_example_plugin(out_dir: Optional[str] = None) -> str:
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
         return so
     cmd = ["g++", "-shared", "-fPIC", "-O2", "-std=c++17",
-           f"-I{jax.ffi.include_dir()}", src, "-o", so]
+           f"-I{_jax_ffi().include_dir()}", src, "-o", so]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise RuntimeError(

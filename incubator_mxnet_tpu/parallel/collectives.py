@@ -72,6 +72,15 @@ def allreduce_across_processes(x: jax.Array) -> jax.Array:
     global device set. Single-process: identity."""
     if jax.process_count() == 1:
         return x
+    from .. import telemetry
+
+    if telemetry.enabled():
+        # the gather moves P copies of the payload across the DCN
+        # (aval metadata only — no sync); labeled like the in-step
+        # collectives so multichip byte accounting is one metric
+        telemetry.counter(
+            "collective_bytes_total", labels={"op": "all-reduce"}) \
+            .inc(telemetry.nbytes_of(x) * jax.process_count())
     from jax.experimental import multihost_utils
 
     return multihost_utils.process_allgather(x).sum(axis=0)
@@ -80,6 +89,14 @@ def allreduce_across_processes(x: jax.Array) -> jax.Array:
 def barrier(name: str = "kvstore_barrier"):
     if jax.process_count() == 1:
         return
+    from .. import telemetry
+
+    if telemetry.enabled():
+        # rendezvous payload is one scalar per process; count the op
+        # (bytes ≈ 4·P) so barrier storms show up in the same series
+        telemetry.counter(
+            "collective_bytes_total", labels={"op": "barrier"}) \
+            .inc(4 * jax.process_count())
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)

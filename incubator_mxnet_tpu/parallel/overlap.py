@@ -1,0 +1,341 @@
+"""Backward-overlapped bucketed gradient sync for the ZeRO explicit tier.
+
+The reference's dependency engine made push/pull of early layers'
+gradients run concurrently with backprop of later layers (PAPER
+§"engine/kvstore").  PR 4's explicit ZeRO-1 tier reproduced the memory
+win but issued ONE reduce-scatter per parameter after the full
+backward, and XLA's default scheduler kept them serialized at the end
+of the step — every collective byte exposed wall-clock.
+
+This module supplies the three pieces that close that gap:
+
+* a size-capped **bucket partitioner** (:func:`partition_buckets`) that
+  groups parameter leaves into ~25 MB buckets in *reverse* parameter
+  order — the backward pass produces cotangents last-layer-first, so
+  bucket 0's gradients are complete while most of the backward is
+  still running;
+* **pack/unpack helpers** for the interleaved bucket layout (below)
+  that turn N per-param ``psum_scatter`` calls into one per bucket
+  while keeping the result *bit-identical* to the per-param exchange —
+  the per-param sharded update path and ``Zero1State`` layout are
+  untouched;
+* a compiled-HLO **schedule analyzer** (:func:`schedule_overlap_stats`)
+  that measures, from the scheduled module text, how many collectives
+  the scheduler actually floated over independent backward compute —
+  the dryrun/bench `overlap_fraction` gate.
+
+Interleaved bucket layout
+-------------------------
+``psum_scatter(tiled=True)`` on a data axis of size D splits its
+operand into D contiguous tiles and leaves tile ``i`` (summed) on
+device ``i``.  Packing a bucket by flat concatenation would therefore
+hand device ``i`` a slice of *one* parameter, not a slice of *each*.
+Instead each padded flat gradient ``g_j`` (length ``npad_j = D*c_j``)
+is viewed as ``(D, c_j)`` and the bucket is the row-wise concatenation
+flattened::
+
+    packed = concat([g_j.reshape(D, c_j) for j in bucket], axis=1)  # (D, C)
+    shard  = psum_scatter(packed.reshape(-1), axis, tiled=True)     # (C,)
+
+Tile ``i`` of ``packed`` is exactly ``concat([g_j[i*c_j:(i+1)*c_j]])``
+— the concatenation of every parameter's device-``i`` shard.  Splitting
+``shard`` at the ``c_j`` offsets recovers precisely what per-param
+``psum_scatter`` calls would have produced (same elementwise sums,
+same reduction order), so the optimizer math downstream is unchanged.
+The updated weight shards ride back through the symmetric bucketed
+``all_gather``: concat local shards → one collective → ``(D, C)`` view
+→ per-param columns → per-param flat ``(npad_j,)``.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_BUCKET_MB", "GradBucket", "overlap_enabled",
+    "resolve_bucket_bytes", "partition_buckets", "pack_bucket",
+    "unpack_shards", "pack_shards", "unpack_gathered",
+    "parse_hlo_schedule", "schedule_overlap_stats",
+]
+
+# ~25 MB global gradient bytes per bucket: large enough that each
+# reduce-scatter is bandwidth-bound (ring collectives amortize latency
+# past a few MB), small enough that several buckets exist to pipeline
+# against the remaining backward.  Same order of magnitude as the
+# reference kvstore's big-array split threshold.
+DEFAULT_BUCKET_MB = 25.0
+
+
+class GradBucket(NamedTuple):
+    """One gradient bucket, in backward (reverse parameter) order.
+
+    ``idxs``   positions into the step's trainable-param order;
+    ``chunks`` per-param shard length ``c_j = npad_j // D`` (the split
+               offsets of the scattered result);
+    ``nbytes`` global (pre-scatter) gradient bytes in this bucket.
+    """
+    idxs: Tuple[int, ...]
+    chunks: Tuple[int, ...]
+    nbytes: int
+
+
+def overlap_enabled(flag: Optional[bool] = None) -> bool:
+    """Resolve the overlap knob: explicit argument wins, else the
+    ``MXTPU_ZERO_OVERLAP`` env (default ON — overlap is bit-compatible
+    with the monolithic path, so there is no numerics reason to gate)."""
+    if flag is not None:
+        return bool(flag)
+    v = os.environ.get("MXTPU_ZERO_OVERLAP", "").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return False
+    return True
+
+
+def resolve_bucket_bytes(bucket_mb: Optional[float] = None) -> int:
+    """Bucket byte cap: explicit argument, else ``MXTPU_ZERO_BUCKET_MB``,
+    else :data:`DEFAULT_BUCKET_MB`.  Always >= 1 byte."""
+    if bucket_mb is None:
+        env = os.environ.get("MXTPU_ZERO_BUCKET_MB", "").strip()
+        if env:
+            try:
+                bucket_mb = float(env)
+            except ValueError:
+                raise ValueError(
+                    f"MXTPU_ZERO_BUCKET_MB={env!r} is not a number")
+    if bucket_mb is None:
+        bucket_mb = DEFAULT_BUCKET_MB
+    return max(1, int(float(bucket_mb) * (1 << 20)))
+
+
+def partition_buckets(npads: Sequence[int], itemsizes: Sequence[int],
+                      group_keys: Sequence, D: int,
+                      cap_bytes: int) -> Tuple[GradBucket, ...]:
+    """Partition params (given in STEP/forward order) into size-capped
+    buckets in REVERSE order — the order their cotangents complete
+    during backward.
+
+    ``group_keys[j]`` must be equal for params whose gradients may
+    share one packed buffer (same dtype / multi-precision mode): a
+    bucket never crosses a group boundary, so packing never promotes a
+    dtype and bit-parity with the per-param exchange holds.
+
+    A single parameter larger than ``cap_bytes`` gets a bucket of its
+    own (never split — splitting would change nothing: its cotangent
+    arrives all at once anyway).
+    """
+    n = len(npads)
+    if not (len(itemsizes) == len(group_keys) == n):
+        raise ValueError("npads/itemsizes/group_keys length mismatch")
+    if D <= 0:
+        raise ValueError(f"data axis size must be positive, got {D}")
+    buckets: List[GradBucket] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_key = object()  # matches nothing
+
+    def flush():
+        nonlocal cur, cur_bytes
+        if cur:
+            buckets.append(GradBucket(
+                idxs=tuple(cur),
+                chunks=tuple(npads[j] // D for j in cur),
+                nbytes=cur_bytes))
+            cur, cur_bytes = [], 0
+
+    for j in reversed(range(n)):
+        if npads[j] % D != 0:
+            raise ValueError(
+                f"param {j}: padded size {npads[j]} not divisible by D={D}")
+        b = npads[j] * itemsizes[j]
+        if cur and (group_keys[j] != cur_key or cur_bytes + b > cap_bytes):
+            flush()
+        cur_key = group_keys[j]
+        cur.append(j)
+        cur_bytes += b
+    flush()
+    return tuple(buckets)
+
+
+# --------------------------------------------------------------------- #
+# pack / unpack (trace-time jnp ops — called inside the shard_map body)
+# --------------------------------------------------------------------- #
+def pack_bucket(g_pads: Sequence, D: int):
+    """Pack padded flat gradients into one interleaved buffer whose
+    tiled psum_scatter equals the per-param scatters (module docstring).
+    Single-element buckets skip the reshape round-trip entirely."""
+    import jax.numpy as jnp
+
+    if len(g_pads) == 1:
+        return g_pads[0]
+    return jnp.concatenate(
+        [g.reshape(D, -1) for g in g_pads], axis=1).reshape(-1)
+
+
+def unpack_shards(shard, chunks: Sequence[int]):
+    """Split a scattered bucket result (length sum(chunks)) back into
+    per-param local shards of length ``chunks[j]``."""
+    if len(chunks) == 1:
+        return [shard]
+    out, off = [], 0
+    for c in chunks:
+        out.append(shard[off:off + c])
+        off += c
+    return out
+
+
+def pack_shards(shards: Sequence):
+    """Concat per-param local shards into one bucket buffer for the
+    gathered return trip (inverse of :func:`unpack_shards`)."""
+    import jax.numpy as jnp
+
+    if len(shards) == 1:
+        return shards[0]
+    return jnp.concatenate(shards)
+
+
+def unpack_gathered(flat, chunks: Sequence[int], D: int):
+    """Split one tiled all_gather result (length ``D*sum(chunks)``)
+    into per-param padded flat arrays of length ``D*chunks[j]`` — the
+    exact arrays per-param all_gathers would have produced."""
+    if len(chunks) == 1:
+        return [flat]
+    mat = flat.reshape(D, sum(chunks))
+    out, off = [], 0
+    for c in chunks:
+        out.append(mat[:, off:off + c].reshape(-1))
+        off += c
+    return out
+
+
+# --------------------------------------------------------------------- #
+# compiled-HLO schedule analysis (the dryrun/bench overlap gate)
+# --------------------------------------------------------------------- #
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+}
+# op kinds that represent real backward/forward compute the scheduler
+# could hide a collective behind (fusions cover elementwise chains;
+# dot/convolution appear unfused on some backends)
+_COMPUTE_KINDS = frozenset({"dot", "fusion", "convolution"})
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%?[\w\.\-]+)\s*=\s*(\(?[^\s]*)\s*([a-z][\w\-]*)\(")
+_NAME_RE = re.compile(r"%([\w\.\-]+)")
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total bytes of an HLO result type string (handles tuples by
+    summing every dtype[shape] token)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(type_str):
+        dt, dims = m.group(1), m.group(2)
+        item = _DTYPE_BYTES.get(dt)
+        if item is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * item
+    return total
+
+
+def parse_hlo_schedule(hlo_text: str) -> List[dict]:
+    """Parse the ENTRY computation of (scheduled) compiled HLO text into
+    an ordered instruction list.  Each entry:
+    ``{"name", "kind", "bytes", "operands"}`` — operands include control
+    predecessors (they are real scheduling dependencies).  Instruction
+    order in a scheduled module IS the schedule."""
+    out: List[dict] = []
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if not in_entry:
+            continue
+        if line.startswith("}"):
+            break
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, type_str, kind = m.group(1).lstrip("%"), m.group(2), m.group(3)
+        # operand/attribute names on the rest of the line; the result
+        # name itself may reappear in sharding attrs — drop it
+        rest = line[m.end():]
+        operands = {n for n in _NAME_RE.findall(rest) if n != name}
+        out.append({"name": name, "kind": kind,
+                    "bytes": _shape_bytes(type_str), "operands": operands})
+    return out
+
+
+def _descendants(instrs: List[dict], start: int) -> set:
+    """Names of entry instructions transitively depending on instrs[start]."""
+    desc = {instrs[start]["name"]}
+    for ins in instrs[start + 1:]:
+        if ins["operands"] & desc:
+            desc.add(ins["name"])
+    return desc
+
+
+def schedule_overlap_stats(hlo_text: str,
+                           collective: str = "reduce-scatter") -> Dict:
+    """Measure collective/compute overlap from scheduled HLO text.
+
+    For every ``collective`` instruction (sync form, or the async
+    ``*-start``/``*-done`` pair when the backend splits them) count the
+    compute ops scheduled after it that do NOT transitively depend on
+    it — backward work the latency-hiding scheduler placed behind the
+    in-flight collective.  Descendants are excluded: the collective's
+    own unpack/update chain trailing it is not overlap.
+
+    Returns ``n_collectives``, ``positions``, per-collective
+    ``independent_compute_after``, ``total_bytes``, and the
+    byte-weighted ``overlap_fraction`` (fraction of collective bytes
+    with at least one independent compute op scheduled after — i.e.
+    issued before the backward was drained).
+    """
+    instrs = parse_hlo_schedule(hlo_text)
+    start_kind, done_kind = collective + "-start", collective + "-done"
+    compute_pos = [i for i, ins in enumerate(instrs)
+                   if ins["kind"] in _COMPUTE_KINDS]
+    colls = []  # (issue_pos, retire_pos, bytes)
+    done_by_operand = {}
+    for i, ins in enumerate(instrs):
+        if ins["kind"] == done_kind:
+            for op in ins["operands"]:
+                done_by_operand[op] = i
+    for i, ins in enumerate(instrs):
+        if ins["kind"] == start_kind:
+            colls.append((i, done_by_operand.get(ins["name"], i),
+                          ins["bytes"]))
+        elif ins["kind"] == collective:
+            colls.append((i, i, ins["bytes"]))
+    per = []
+    hidden_bytes = 0
+    total_bytes = 0
+    for issue, retire, b in colls:
+        desc = _descendants(instrs, issue)
+        indep = sum(1 for p in compute_pos
+                    if p > issue and instrs[p]["name"] not in desc)
+        between = sum(1 for p in compute_pos
+                      if issue < p < retire
+                      and instrs[p]["name"] not in desc)
+        per.append({"position": issue, "bytes": b,
+                    "independent_compute_after": indep,
+                    "compute_between_start_done": between})
+        total_bytes += b
+        if indep > 0:
+            hidden_bytes += b
+    return {
+        "n_collectives": len(colls),
+        "positions": [p["position"] for p in per],
+        "per_collective": per,
+        "total_bytes": total_bytes,
+        "hidden_bytes": hidden_bytes,
+        "overlap_fraction":
+            (hidden_bytes / total_bytes) if total_bytes else 0.0,
+    }

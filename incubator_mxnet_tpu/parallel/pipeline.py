@@ -50,6 +50,17 @@ from .. import telemetry
 __all__ = ["pipeline_forward", "pipeline_apply", "pipeline_train_1f1b"]
 
 
+def _vma_of(z) -> set:
+    """Varying-manual-axes of ``z`` under shard_map, or the empty set on
+    jax versions without ``jax.typeof``/vma tracking (< 0.6 — there the
+    check_rep system owns replication discipline and no explicit pcast
+    is needed, see parallel/compat.py)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return set()
+    return set(getattr(typeof(z), "vma", ()))
+
+
 def _record_schedule(schedule: str, n_stages: int, n_micro: int) -> None:
     """Publish the schedule's analytic shape as gauges (host ints only).
 
@@ -236,9 +247,14 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
     # TP axis).  We track: activation/ring vma (fixpoint of the stage's
     # output vma), per-residual-leaf vma, and per-param-grad vma.
     def _vma(z):
-        return set(getattr(jax.typeof(z), "vma", ()))
+        return _vma_of(z)
 
     def cast_to(z, target):
+        # no vma system (jax < 0.6): the legacy check_rep machinery
+        # tracks replication itself — explicit pcasts neither exist nor
+        # are needed for correct psum transposition there
+        if not hasattr(lax, "pcast"):
+            return z
         need = tuple(a for a in sorted(set(target) - _vma(z)))
         return lax.pcast(z, need, to="varying") if need else z
 
@@ -436,7 +452,7 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_fn: Callable,
         # reduce to an unvarying (out_specs P()) value: psum over pipe,
         # pmean over any leftover TP axes (values replicated there)
         v = lax.psum(v, axis_name)
-        for ax in sorted(set(getattr(jax.typeof(v), "vma", ()))):
+        for ax in sorted(_vma_of(v)):
             v = lax.pmean(v, ax)
         return v
 

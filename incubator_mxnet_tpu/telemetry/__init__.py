@@ -43,7 +43,7 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "span", "spans", "mark_step", "current_step", "dump", "reset",
            "get_registry", "Counter", "Gauge", "Histogram", "Registry",
            "SpanRecord", "DEFAULT_BUCKETS", "log_buckets", "nbytes_of",
-           "exporters", "tracer"]
+           "record_collective_overlap", "exporters", "tracer"]
 
 _default_registry = Registry()
 _dump_interval = 0
@@ -86,6 +86,31 @@ def nbytes_of(arr) -> int:
     except Exception:
         itemsize = 2  # bfloat16 and friends under older numpy
     return _math.prod(shape) * itemsize if shape else itemsize
+
+
+def record_collective_overlap(exposed_seconds: float, hidden_seconds: float,
+                              source: str = "trace") -> None:
+    """Record one measured collective-overlap observation (ISSUE 5):
+
+    * ``collective_exposed_seconds`` — counter of collective time NOT
+      hidden behind compute (the wall-clock cost the overlapped ZeRO
+      exchange exists to remove);
+    * ``overlap_fraction`` — gauge, hidden/(hidden+exposed) of the last
+      observation, labeled by ``source`` (``trace`` = measured from a
+      device trace via tools/xprof_summary.py; the Trainer sets a
+      ``plan``-sourced estimate at build time; dryrun/bench set
+      ``schedule`` from compiled-HLO analysis).
+
+    Values are host data (trace timestamps / schedule positions) — the
+    no-host-sync rule is trivially satisfied.
+    """
+    if not enabled():
+        return
+    counter("collective_exposed_seconds", labels={"source": source}) \
+        .inc(float(exposed_seconds))
+    total = float(exposed_seconds) + float(hidden_seconds)
+    gauge("overlap_fraction", labels={"source": source}) \
+        .set(float(hidden_seconds) / total if total > 0 else 0.0)
 
 
 def _on_step(step: int) -> None:

@@ -1,0 +1,165 @@
+"""parallel/compat.py: the shard_map API-generation shim (ISSUE 5
+satellite).  Covers BOTH import paths (top-level `jax.shard_map` vs
+`jax.experimental.shard_map`) and the check_vma <-> check_rep kwarg
+translation in each direction, under mocked jax modules — the installed
+jax only ever exercises one side of each branch.
+"""
+import importlib
+import sys
+import types
+
+import pytest
+
+import incubator_mxnet_tpu.parallel.compat as compat
+
+
+# ---------------------------------------------------------------------------
+# kwarg translation (monkeypatched resolver state, no reload needed)
+# ---------------------------------------------------------------------------
+
+def _capture_impl(accepted):
+    """A fake resolved shard_map recording the kwargs it receives."""
+    calls = []
+
+    def impl(*args, **kwargs):
+        calls.append((args, dict(kwargs)))
+        return "mapped"
+
+    return impl, calls, set(accepted)
+
+
+_NEW_API = ("f", "mesh", "in_specs", "out_specs", "check_vma", "axis_names")
+_OLD_API = ("f", "mesh", "in_specs", "out_specs", "check_rep", "auto")
+
+
+def test_check_vma_translates_to_check_rep_on_old_jax(monkeypatch):
+    impl, calls, acc = _capture_impl(_OLD_API)
+    monkeypatch.setattr(compat, "_shard_map", impl)
+    monkeypatch.setattr(compat, "_ACCEPTED", acc)
+    assert compat.shard_map(lambda x: x, check_vma=False) == "mapped"
+    (_, kwargs), = calls
+    assert kwargs == {"check_rep": False}
+
+
+def test_check_rep_translates_to_check_vma_on_new_jax(monkeypatch):
+    impl, calls, acc = _capture_impl(_NEW_API)
+    monkeypatch.setattr(compat, "_shard_map", impl)
+    monkeypatch.setattr(compat, "_ACCEPTED", acc)
+    assert compat.shard_map(lambda x: x, check_rep=False) == "mapped"
+    (_, kwargs), = calls
+    assert kwargs == {"check_vma": False}
+
+
+@pytest.mark.parametrize("api,kw", [(_OLD_API, "check_rep"),
+                                    (_NEW_API, "check_vma")])
+def test_native_spelling_passes_through_untranslated(monkeypatch, api, kw):
+    impl, calls, acc = _capture_impl(api)
+    monkeypatch.setattr(compat, "_shard_map", impl)
+    monkeypatch.setattr(compat, "_ACCEPTED", acc)
+    compat.shard_map(lambda x: x, **{kw: True})
+    (_, kwargs), = calls
+    assert kwargs == {kw: True}, \
+        "the implementation's own spelling must never be rewritten"
+
+
+def test_unintrospectable_impl_passes_kwargs_verbatim(monkeypatch):
+    # exotic wrappers whose signature inspect can't read: _ACCEPTED is
+    # None and the shim must not guess — kwargs go through untouched
+    impl, calls, _ = _capture_impl(())
+    monkeypatch.setattr(compat, "_shard_map", impl)
+    monkeypatch.setattr(compat, "_ACCEPTED", None)
+    compat.shard_map(lambda x: x, check_vma=True)
+    (_, kwargs), = calls
+    assert kwargs == {"check_vma": True}
+
+
+def test_positional_args_forwarded(monkeypatch):
+    impl, calls, acc = _capture_impl(_OLD_API)
+    monkeypatch.setattr(compat, "_shard_map", impl)
+    monkeypatch.setattr(compat, "_ACCEPTED", acc)
+    f = lambda x: x  # noqa: E731
+    compat.shard_map(f, "MESH", check_vma=True)
+    (args, kwargs), = calls
+    assert args == (f, "MESH") and kwargs == {"check_rep": True}
+
+
+# ---------------------------------------------------------------------------
+# import-path resolution (reload under mocked jax module trees)
+# ---------------------------------------------------------------------------
+
+def _reload_with_fake_jax(fake_modules, check):
+    """Reload compat with `fake_modules` shadowing jax in sys.modules and
+    run `check(reloaded_module)` while the fake is live; ALWAYS restores
+    the real modules and re-reloads compat back to its true state."""
+    saved = {}
+    names = set(fake_modules) | {
+        n for n in sys.modules
+        if n == "jax" or n.startswith(("jax.", "jaxlib"))}
+    for n in names:
+        saved[n] = sys.modules.pop(n, None)
+    sys.modules.update(fake_modules)
+    try:
+        check(importlib.reload(compat))
+    finally:
+        for n in fake_modules:
+            sys.modules.pop(n, None)
+        for n, mod in saved.items():
+            if mod is not None:
+                sys.modules[n] = mod
+        importlib.reload(compat)
+
+
+def _fake_shard_map(check_kw):
+    # a real function so inspect.signature works on the reloaded module
+    if check_kw == "check_vma":
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=True):
+            return ("new-api", check_vma)
+    else:
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_rep=True):
+            return ("old-api", check_rep)
+    return shard_map
+
+
+def test_resolves_toplevel_jax_shard_map():
+    # jax >= 0.6 layout: `from jax import shard_map` succeeds
+    jx = types.ModuleType("jax")
+    jx.shard_map = _fake_shard_map("check_vma")
+
+    def check(mod):
+        assert mod._shard_map is jx.shard_map
+        assert "check_vma" in mod._ACCEPTED
+        # legacy spelling translated forward on this layout
+        assert mod.shard_map(lambda x: x, check_rep=False) \
+            == ("new-api", False)
+
+    _reload_with_fake_jax({"jax": jx}, check)
+
+
+def test_falls_back_to_experimental_shard_map():
+    # jax 0.4.x layout: no top-level attr, submodule carries it
+    jx = types.ModuleType("jax")
+    exp = types.ModuleType("jax.experimental")
+    sub = types.ModuleType("jax.experimental.shard_map")
+    sub.shard_map = _fake_shard_map("check_rep")
+    jx.experimental = exp
+    exp.shard_map = sub
+
+    def check(mod):
+        assert mod._shard_map is sub.shard_map
+        assert "check_rep" in mod._ACCEPTED
+        # modern spelling translated back on this layout
+        assert mod.shard_map(lambda x: x, check_vma=False) \
+            == ("old-api", False)
+
+    _reload_with_fake_jax({"jax": jx, "jax.experimental": exp,
+                           "jax.experimental.shard_map": sub}, check)
+
+
+def test_installed_jax_resolves_a_callable():
+    # whatever generation is installed, the shim must have bound a real
+    # implementation at import time
+    assert callable(compat._shard_map)
+    assert compat._ACCEPTED is None or (
+        "check_rep" in compat._ACCEPTED or "check_vma" in compat._ACCEPTED)

@@ -276,6 +276,13 @@ def test_pipeline_1f1b_matches_oracle():
                                 rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.xfail(
+    not hasattr(jax, "typeof"),
+    reason="needs the jax >= 0.6 vma system (jax.typeof/lax.pcast) to "
+           "rewrite the psum transpose through an in-stage TP collective; "
+           "under the legacy check_rep discipline the backward psum is not "
+           "re-associated and grads come out axis_size('model')x too large",
+    strict=True)
 def test_pipeline_1f1b_composes_with_tp_collectives():
     """PP×TP: the stage contains a psum over 'model' INSIDE the 1F1B
     branches — the uniform-branch argument (predicates depend only on
@@ -315,8 +322,7 @@ def test_pipeline_1f1b_composes_with_tp_collectives():
                 stage_tp, lambda y, t, _lp: loss_fn(y, t), params,
                 xmb, tmb, "pipe", n)
             loss = lax.psum(loss_sum, "pipe") / M
-            import jax as _jax
-            for ax in sorted(set(getattr(_jax.typeof(loss), "vma", ()))):
+            for ax in sorted(pp._vma_of(loss)):
                 loss = lax.pmean(loss, ax)
             # grads: sum the TP shards' contributions is NOT needed —
             # each shard's grad is for its own columns
@@ -360,9 +366,10 @@ def test_pipeline_gpipe_skip_inactive_with_tp_collective():
     safe; output must match skip_inactive=False."""
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec as P
     from incubator_mxnet_tpu.parallel import create_mesh, pipeline as pp
+    from incubator_mxnet_tpu.parallel.compat import shard_map
 
     n, tp, M, mb, d = 2, 2, 2, 2, 4
     mesh = create_mesh(jax.devices()[:n * tp], pipe=n, model=tp)

@@ -23,9 +23,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 from incubator_mxnet_tpu.utils import xplane
 
 
-def module_window_rows(path, substr, device_substr="TPU"):
-    """Rows restricted to the last execution window of the matching
-    XLA module — the steady-state-step view."""
+def _device_events(path, substr=None, device_substr="TPU"):
+    """Raw XLA-Op events from every device plane; with `substr`,
+    restricted to the last execution window of the matching XLA module
+    (the steady-state-step view)."""
     if os.path.isdir(path):
         paths = xplane.latest_run_files(path)  # device_op_table's rule
     else:
@@ -34,24 +35,158 @@ def module_window_rows(path, substr, device_substr="TPU"):
               if device_substr in p.name]
     if not planes:
         raise RuntimeError("no device plane in trace")
-    # collect every plane's window events first, aggregate ONCE — so a
-    # multi-host run dir yields one merged row per op, same as
-    # device_op_table, not one fractional row per host file
-    window_events = []
+    events = []
     for plane in planes:
         lines = {l.name: l for l in plane.lines}
-        mods = lines.get("XLA Modules")
         opsl = lines.get("XLA Ops")
-        if not mods or not opsl:
+        if not opsl:
+            continue
+        if substr is None:
+            events += opsl.events
+            continue
+        mods = lines.get("XLA Modules")
+        if not mods:
             continue
         cand = [e for e in mods.events if substr in e.name]
         if not cand:
             continue
         last = max(cand, key=lambda e: e.offset_ps)
         w0, w1 = last.offset_ps, last.offset_ps + last.duration_ps
-        window_events += [ev for ev in opsl.events
-                          if w0 <= ev.offset_ps < w1]
-    return xplane.aggregate_events(window_events)  # sorted by -total_us
+        events += [ev for ev in opsl.events if w0 <= ev.offset_ps < w1]
+    return events
+
+
+def module_window_rows(path, substr, device_substr="TPU"):
+    """Rows restricted to the last execution window of the matching
+    XLA module — the steady-state-step view."""
+    # collect every plane's window events first, aggregate ONCE — so a
+    # multi-host run dir yields one merged row per op, same as
+    # device_op_table, not one fractional row per host file
+    return xplane.aggregate_events(
+        _device_events(path, substr, device_substr))  # sorted by -total_us
+
+
+# ---------------------------------------------------------------------------
+# exposed-vs-hidden collective time (the trace-measured counterpart of
+# parallel/overlap.py's schedule_overlap_stats)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_BASES = ("all-reduce", "reduce-scatter", "all-gather",
+                     "all-to-all", "collective-permute")
+# what counts as useful work a collective can hide behind; mirrors
+# overlap.py's _COMPUTE_KINDS so schedule- and trace-measured fractions
+# agree on the denominator's meaning
+_COMPUTE_BASES = ("fusion", "dot", "convolution", "custom-call")
+
+
+def _base(name):
+    """`%all-reduce-start.3` -> (`all-reduce`, `start`, `.3`)."""
+    n = name.lstrip("%").split("(")[0]
+    head, _, suffix = n.partition(".")
+    for tag in ("start", "done"):
+        if head.endswith("-" + tag):
+            return head[: -len(tag) - 1], tag, suffix
+    return head, None, suffix
+
+
+def collective_overlap_from_events(events):
+    """Exposed-vs-hidden communication time from trace events.
+
+    Async collectives appear as `<op>-start.N` / `<op>-done.N` pairs;
+    the wire transfer spans [start.begin, done.end].  Pairs are matched
+    by suffix when both sides carry one, else by time order within the
+    op kind (start i with the i-th done beginning after it).  Sync
+    collectives occupy their own interval.  A picosecond of collective
+    time is *hidden* iff some compute op (fusion/dot/convolution/
+    custom-call) is executing at that instant; the rest is *exposed* —
+    time the step genuinely stalls on the network.
+
+    Returns {n_collectives, comm_seconds, exposed_seconds,
+    hidden_seconds, overlap_fraction, per_collective: [{name, seconds,
+    hidden_seconds}]}.  Pure over (name, offset_ps, duration_ps) — no
+    trace file or jax dependency, so it is unit-testable with synthetic
+    events.
+    """
+    starts, dones, comm, compute = {}, {}, [], []
+    for ev in events:
+        base, tag, suffix = _base(ev.name)
+        t0, t1 = ev.offset_ps, ev.offset_ps + ev.duration_ps
+        if base in _COLLECTIVE_BASES:
+            if tag == "start":
+                starts.setdefault(base, []).append((t0, t1, suffix, ev.name))
+            elif tag == "done":
+                dones.setdefault(base, []).append((t0, t1, suffix, ev.name))
+            else:
+                comm.append((ev.name, t0, t1))
+        elif base in _COMPUTE_BASES:
+            compute.append((t0, t1))
+    for base, ss in starts.items():
+        dd = sorted(dones.get(base, []))
+        by_suffix = {d[2]: d for d in dd if d[2]}
+        used = set()
+        for s in sorted(ss):
+            d = by_suffix.get(s[2]) if s[2] else None
+            if d is None or id(d) in used:
+                # fall back: earliest unused done beginning at/after the
+                # start (the runtime never retires a transfer early)
+                d = next((c for c in dd
+                          if id(c) not in used and c[0] >= s[0]), None)
+            if d is None:
+                comm.append((s[3], s[0], s[1]))  # unmatched start: sync-like
+                continue
+            used.add(id(d))
+            comm.append((s[3], s[0], d[1]))
+    # merge compute into disjoint intervals once; each comm interval is
+    # then measured against the union (concurrent collectives are each
+    # attributed in full — the question is per-collective exposure)
+    merged = []
+    for t0, t1 in sorted(compute):
+        if merged and t0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], t1)
+        else:
+            merged.append([t0, t1])
+
+    def _hidden_ps(t0, t1):
+        h = 0
+        for c0, c1 in merged:
+            if c0 >= t1:
+                break
+            h += max(0, min(t1, c1) - max(t0, c0))
+        return h
+
+    per, tot, hid = [], 0, 0
+    for name, t0, t1 in sorted(comm, key=lambda c: c[1]):
+        h = _hidden_ps(t0, t1)
+        per.append({"name": name, "seconds": (t1 - t0) / 1e12,
+                    "hidden_seconds": h / 1e12})
+        tot += t1 - t0
+        hid += h
+    return {
+        "n_collectives": len(per),
+        "comm_seconds": tot / 1e12,
+        "exposed_seconds": (tot - hid) / 1e12,
+        "hidden_seconds": hid / 1e12,
+        "overlap_fraction": (hid / tot) if tot else 0.0,
+        "per_collective": per,
+    }
+
+
+def print_overlap_report(stats, record=False):
+    print(f"== collective overlap ({stats['n_collectives']} collectives, "
+          f"{stats['comm_seconds']*1e3:.3f} ms comm) ==")
+    print(f"  exposed {stats['exposed_seconds']*1e3:9.3f} ms   "
+          f"hidden {stats['hidden_seconds']*1e3:9.3f} ms   "
+          f"overlap_fraction {stats['overlap_fraction']:.2f}")
+    for p in stats["per_collective"][:20]:
+        frac = p["hidden_seconds"] / p["seconds"] if p["seconds"] else 0.0
+        print(f"  {p['seconds']*1e3:9.3f} ms  {frac*100:5.1f}% hidden"
+              f"  {p['name']}")
+    if record:
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.record_collective_overlap(
+            stats["exposed_seconds"], stats["hidden_seconds"],
+            source="trace")
 
 
 def main():
@@ -60,12 +195,19 @@ def main():
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--module", default=None,
                     help="restrict to the last run of this XLA module")
+    ap.add_argument("--overlap", action="store_true",
+                    help="attribute exposed-vs-hidden collective time "
+                         "(async start/done pair matching)")
     args = ap.parse_args()
 
     if args.module:
         rows = module_window_rows(args.trace, args.module)
     else:
         rows = xplane.device_op_table(args.trace)
+
+    if args.overlap:
+        print_overlap_report(collective_overlap_from_events(
+            _device_events(args.trace, args.module)))
 
     total = sum(r["total_us"] for r in rows)
     print(f"== categories (total {total/1e3:.2f} ms device time) ==")
