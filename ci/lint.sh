@@ -27,4 +27,7 @@ JAX_PLATFORMS=cpu python ci/input_pipeline_smoke.py
 echo "overlap smoke: bucketed-vs-monolithic ZeRO parity + overlap_fraction"
 JAX_PLATFORMS=cpu python ci/overlap_smoke.py
 
+echo "quantized decode smoke: int8 weight streaming + greedy parity"
+JAX_PLATFORMS=cpu python ci/quantized_decode_smoke.py
+
 echo "lint gates: OK"

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as onp
 
 __all__ = ["quantize_weight", "calibrate", "QuantizedDense", "QuantizedConv",
-           "quantize_net"]
+           "quantize_net", "DecodeQuantConfig", "quantize_for_decode",
+           "dequantize_decode"]
 
 
 def quantize_weight(w, axis: int = 0):
@@ -320,3 +321,166 @@ class _QuantizedWrapper(_HybridBlock):
 
     def forward(self, x):
         return self._qd(x)
+
+
+# --------------------------------------------------------------------- #
+# weight-only quantization for the KV-cache decode stack
+# --------------------------------------------------------------------- #
+class DecodeQuantConfig:
+    """Weight-only int8 quantization state for the compiled decode
+    programs (`models.generation`): per-output-channel int8 weights +
+    fp32 scales for the transformer matmuls, consumed by
+    `_gather_params`/`_gather_nmt_params`.
+
+    Small-batch decode is weight-streaming-bound, so the recipe is the
+    LLM.int8()/AWQ weight-only one: int8 weights, bf16 activations,
+    fp32 logits.  Two dequant strategies, both applying the scale in
+    the matmul EPILOGUE (to the (B, out) result — never to the weight
+    matrix, so no program-level bf16/f32 weight copy exists):
+
+    * ``act_quant="none"`` — mixed-precision ``dot_general(bf16 x,
+      int8 W)``: the MXU streams int8 from HBM and upconverts in
+      registers.  Weight-only error (~0.4% per channel), the default
+      on accelerators.
+    * ``act_quant="dynamic"`` — per-row dynamic activation
+      quantization feeding an INT8xINT8->INT32 dot (the PTQ
+      machinery's MXU path above).  Adds activation rounding error.
+    * ``act_quant="auto"`` — "dynamic" on the cpu backend, "none"
+      elsewhere (resolved once, at quantize time).  Measured basis
+      (12L/1024D, benchmark/generate_bench.py on XLA:CPU): the mixed
+      dot falls off oneDNN at B>=2 (7.1x bf16 step time at B=4, vs
+      2.1x for the s32-legalized int dot) while at B=1 the two are
+      within 20% — so "dynamic" bounds the worst case on cpu; on TPU
+      the mixed dot upconverts in-register and "none" is strictly
+      better.
+
+    Quantized copies are cached per weight buffer IDENTITY: training or
+    ``cast()`` replaces a parameter's array, and the next `_gather_*`
+    re-quantizes just the stale entries — generation never consumes a
+    quantized copy of weights that no longer exist.
+    """
+
+    def __init__(self, act_quant: str = "auto", quantize_head: bool = False):
+        if act_quant == "auto":
+            act_quant = "dynamic" if jax.default_backend() == "cpu" else "none"
+        if act_quant not in ("none", "dynamic"):
+            raise ValueError(
+                f"act_quant must be auto|none|dynamic, got {act_quant!r}")
+        self.act_quant = act_quant
+        self.quantize_head = quantize_head
+        self._store: Dict[int, dict] = {}   # id(dense) -> entry
+        self._targets: Dict[int, object] = {}  # id(dense) -> dense
+
+    def cache_key(self):
+        """Static part of the decode-program cache signature: programs
+        compiled for one (strategy, head) combination are reused across
+        re-quantization (weights are ARGUMENTS)."""
+        return ("int8", self.act_quant, self.quantize_head)
+
+    def add_target(self, dense):
+        self._targets[id(dense)] = dense
+
+    def is_target(self, dense) -> bool:
+        return id(dense) in self._targets
+
+    def packed(self, dense):
+        """The quantized-weight pytree leaf dict for a target nn.Dense
+        — {"w8": int8 (out, in), "s": fp32 (out,)}, plus a leafless
+        "dyn" marker (static pytree structure) for the dynamic
+        activation-quant strategy.  Returns None for non-targets."""
+        if id(dense) not in self._targets:
+            return None
+        w = dense.weight.data()._data
+        ent = self._store.get(id(dense))
+        if ent is None or ent["src"] is not w:
+            q, scale = quantize_weight(w, axis=0)
+            ent = {"src": w, "w8": q, "s": scale.reshape(-1)}
+            self._store[id(dense)] = ent
+        packed = {"w8": ent["w8"], "s": ent["s"]}
+        if self.act_quant == "dynamic":
+            packed["dyn"] = ()
+        return packed
+
+    def refresh(self):
+        """Re-quantize every stale entry now (otherwise it happens
+        lazily at the next `_gather_*`)."""
+        for dense in self._targets.values():
+            self.packed(dense)
+        return self
+
+    def weight_bytes(self) -> int:
+        """int8 + scale bytes the quantized matmuls stream per decode
+        step (telemetry: decode_weight_bytes)."""
+        total = 0
+        for dense in self._targets.values():
+            ent = self.packed(dense)
+            total += ent["w8"].size + ent["s"].size * 4
+        return total
+
+
+def _decode_target_denses(net, quantize_head: bool):
+    """The Dense layers the decode programs matmul against, per model
+    family (mirrors `_gather_params`/`_gather_nmt_params` structure)."""
+    layers = getattr(net, "_layers", None)
+    if layers is not None:  # TransformerLM (decoder-only)
+        out = []
+        for lyr in layers:
+            out += [lyr.attn.qkv, lyr.attn.proj,
+                    lyr.ffn.ffn_dense1, lyr.ffn.ffn_dense2]
+        if quantize_head:
+            out.append(net.head)
+        return out
+    decoder = getattr(net, "decoder", None)
+    if decoder is not None:  # Transformer (NMT enc-dec): decoder side
+        out = []
+        for lyr in decoder._layers:
+            out += [lyr.self_attn.qkv, lyr.self_attn.proj,
+                    lyr.cross_attn.q_proj, lyr.cross_attn.kv_proj,
+                    lyr.cross_attn.proj,
+                    lyr.ffn.ffn_dense1, lyr.ffn.ffn_dense2]
+        if quantize_head:
+            out.append(net.out_proj)
+        return out
+    raise TypeError(
+        f"quantize_for_decode supports models.TransformerLM and "
+        f"models.Transformer, got {type(net).__name__}")
+
+
+def quantize_for_decode(net, *, act_quant: str = "auto",
+                        quantize_head: bool = False):
+    """Mark `net` (models.TransformerLM or models.Transformer) for
+    weight-quantized generation: the transformer matmul weights
+    (QKV/out projections, FFN dense layers; cross-attention too for
+    NMT; the logits head only with ``quantize_head=True``) are
+    quantized to per-channel int8 + fp32 scales, and every subsequent
+    `generate`/`beam_search`/`translate` call consumes them through a
+    dequant-fused matmul — int8 streamed from HBM, the scale applied in
+    the epilogue, activations bf16, logits fp32.
+
+    Embeddings stay float (decode reads one row per token — a gather,
+    not a streamed matmul); LayerNorm/bias stay float; for NMT the
+    ENCODER runs through the public float blocks as before.
+
+    The transform is runtime-only: `.params` checkpoints still hold the
+    original float parameters, and training after quantization simply
+    re-quantizes lazily (quantized copies are keyed on weight-buffer
+    identity).  Use `dequantize_decode(net)` (or ``quantized=False`` on
+    the entry points) to get the float path back; compiled programs for
+    both paths coexist in the cache, keyed on the quant config.
+
+    Returns `net`.
+    """
+    cfg = DecodeQuantConfig(act_quant, quantize_head)
+    for dense in _decode_target_denses(net, quantize_head):
+        cfg.add_target(dense)
+    cfg.refresh()
+    net._decode_quant = cfg
+    return net
+
+
+def dequantize_decode(net):
+    """Remove the decode-quantization marking set by
+    `quantize_for_decode` — generation returns to the float path (its
+    compiled programs are still cached).  Returns `net`."""
+    net._decode_quant = None
+    return net

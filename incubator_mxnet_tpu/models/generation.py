@@ -28,17 +28,62 @@ prefix-by-prefix (`tests/test_generation.py`).
 from __future__ import annotations
 
 import math
+import os
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["lm_generate", "lm_beam_search", "nmt_translate"]
+__all__ = ["lm_generate", "lm_beam_search", "lm_score", "nmt_translate",
+           "bucket_length"]
+
+# LRU caps for the per-net compiled-program / pe-table caches (ADVICE
+# r5 #3: exact-(B, P, N, sampling) keys grow without bound under
+# variable-length traffic).  Override per net via
+# `net._gen_program_cache_cap` / `net._pe_cache_cap`.
+_PROGRAM_CACHE_CAP = int(os.environ.get("MXTPU_GEN_PROGRAM_CACHE", "32"))
+_PE_CACHE_CAP = int(os.environ.get("MXTPU_GEN_PE_CACHE", "8"))
 
 
-def _dense(x, w, b):
-    """nn.Dense math on raw arrays: x @ W.T + b (weight is (out, in))."""
+def _dense(x, w, b, out_dtype=None):
+    """nn.Dense math on raw arrays: x @ W.T + b (weight is (out, in)).
+
+    `w` is either a raw float array or a quantized-weight dict emitted
+    by `_gather_params` for a `quantize_for_decode`-marked net:
+    ``{"w8": int8 (out, in), "s": fp32 (out,)}`` (+ a leafless "dyn"
+    marker selecting dynamic activation quantization).  The quantized
+    path streams the int8 weight straight into the matmul and applies
+    the per-channel scale in the EPILOGUE — to the (..., out) result,
+    never to the weight — so no program-level float copy of the weight
+    exists (the CI smoke gate pins this on the compiled HLO).
+    """
+    if isinstance(w, dict):
+        cdim = x.ndim - 1
+        # tpulint: disable-next=TPU004 -- dict KEY membership is static pytree structure (the strategy marker), not a traced value
+        if "dyn" in w:
+            # dynamic per-row activation int8: native INT8xINT8->INT32
+            # dot (the PTQ machinery's MXU path); scale product in the
+            # epilogue
+            xf = x.astype(jnp.float32)
+            sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                             1e-8) / 127.0
+            xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+            acc = jax.lax.dot_general(xq, w["w8"], (((cdim,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * (sx * w["s"])
+        else:
+            # weight-only: mixed-precision dot consumes the int8 weight
+            # directly (bf16 activations upconvert in-register on TPU)
+            acc = jax.lax.dot_general(x, w["w8"], (((cdim,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            y = acc * w["s"]
+        if b is not None:
+            y = y + b.astype(jnp.float32)
+        return y.astype(x.dtype if out_dtype is None else out_dtype)
     y = x @ w.T.astype(x.dtype)
-    return y if b is None else y + b.astype(x.dtype)
+    if b is not None:
+        y = y + b.astype(x.dtype)
+    return y if out_dtype is None else y.astype(out_dtype)
 
 
 def _ln(x, g, b, eps=1e-5):
@@ -63,25 +108,103 @@ def _wb(layer):
             None if layer.bias is None else layer.bias.data()._data)
 
 
+def _lru_touch(cache, key):
+    """LRU read: returns cache[key] (refreshing recency) or None."""
+    val = cache.get(key)
+    if val is not None:
+        cache.move_to_end(key)
+    return val
+
+
+def _lru_put(net, cache, key, val, cap_attr, default_cap, gauge=None):
+    """LRU insert with eviction beyond the cap (net attribute override
+    `cap_attr`, else `default_cap`); mirrors the size into `gauge`."""
+    cache[key] = val
+    cap = max(1, int(getattr(net, cap_attr, default_cap)))
+    while len(cache) > cap:
+        cache.popitem(last=False)
+    if gauge is not None:
+        from .. import telemetry
+
+        if telemetry.enabled():
+            telemetry.gauge(gauge).set(len(cache))
+    return val
+
+
+def _program_cache(net):
+    cache = getattr(net, "_gen_programs", None)
+    if cache is None:
+        cache = net._gen_programs = OrderedDict()
+    return cache
+
+
+def _cache_program(net, sig, fn):
+    return _lru_put(net, _program_cache(net), sig, fn,
+                    "_gen_program_cache_cap", _PROGRAM_CACHE_CAP,
+                    gauge="gen_program_cache_size")
+
+
 def _pe_table(net, width):
     """Eagerly-built positional-encoding table of `width` rows, cached
     per width on the net (the compiled decode programs consume pe as an
     argument, so only the rows they read are ever built)."""
     cache = getattr(net, "_pe_cache", None)
     if cache is None:
-        cache = net._pe_cache = {}
-    pe = cache.get(width)
+        cache = net._pe_cache = OrderedDict()
+    pe = _lru_touch(cache, width)
     if pe is None:
         from .transformer import positional_encoding
 
-        pe = cache[width] = positional_encoding(width, net._units)
+        pe = _lru_put(net, cache, width,
+                      positional_encoding(width, net._units),
+                      "_pe_cache_cap", _PE_CACHE_CAP)
     return pe
 
 
-def _gather_params(net, pe_width):
+def bucket_length(n: int, *, floor: int = 16) -> int:
+    """Prompt-length bucketing rule: the smallest power of two >=
+    max(n, floor).  ``lm_generate(..., pad_to_bucket=True)`` compiles
+    one program per BUCKET (the true length rides in as a traced
+    scalar), so variable-length traffic keeps the program cache at
+    O(#buckets) instead of O(#distinct lengths)."""
+    if n < 0:
+        raise ValueError(f"length must be >= 0, got {n}")
+    b = max(1, int(floor))
+    while b < n:
+        b *= 2
+    return b
+
+
+def _quant_config(net, quantized):
+    """Resolve the effective DecodeQuantConfig for a generation call:
+    quantized=None → whatever `quantize_for_decode` attached (float
+    path if nothing); True → require it; False → force the float
+    path."""
+    qc = getattr(net, "_decode_quant", None)
+    if quantized is False:
+        return None
+    if quantized and qc is None:
+        raise ValueError(
+            "quantized=True but the net has no decode-quantization "
+            "state — run contrib.quantization.quantize_for_decode(net) "
+            "first")
+    return qc
+
+
+def _gather_params(net, pe_width, qc=None):
     """The weight pytree the compiled program consumes — the live raw
-    arrays of the Block's parameters, in a fixed structure."""
-    d = _wb
+    arrays of the Block's parameters, in a fixed structure.  With a
+    DecodeQuantConfig `qc`, target matmul weights come out as int8+
+    scale dicts instead (see `_dense`); stale quantized copies are
+    refreshed here, keyed on weight-buffer identity."""
+    def d(layer):
+        if qc is not None:
+            packed = qc.packed(layer)
+            if packed is not None:
+                return (packed, None if layer.bias is None
+                        else layer.bias.data()._data)
+        return _wb(layer)
+
     layers = []
     for lyr in net._layers:
         layers.append({
@@ -115,14 +238,58 @@ def _ffn_fwd(x, lp, act):
 
 
 def _logits_of(params, h_last):
-    return _dense(_ln(h_last, *params["ln"]),
-                  *params["head"]).astype(jnp.float32)
+    return _dense(_ln(h_last, *params["ln"]), *params["head"],
+                  out_dtype=jnp.float32)
 
 
-def _prefill(params, prompt, acts, H, pad_to):
+def _weight_nbytes(params):
+    """Bytes of weights a decode step STREAMS through its matmuls —
+    layer matmul weights/biases + final ln + head (the embedding is a
+    per-token row gather, not a streamed matmul, so it is excluded).
+    Metadata-only (shape/dtype): never touches device data."""
+    from ..telemetry import nbytes_of
+
+    def wsz(w):
+        return (nbytes_of(w["w8"]) + nbytes_of(w["s"])
+                if isinstance(w, dict) else nbytes_of(w))
+
+    def pair(v):
+        w, b = v
+        return wsz(w) + (0 if b is None else nbytes_of(b))
+
+    total = sum(nbytes_of(a) for a in params["ln"])
+    total += pair(params["head"])
+    for lp in params["layers"]:
+        for k, v in lp.items():
+            total += (sum(nbytes_of(a) for a in v) if k.startswith("ln")
+                      else pair(v))
+    return total
+
+
+def _record_decode_weight_bytes(params, qc):
+    from .. import telemetry
+
+    if telemetry.enabled():
+        telemetry.gauge("decode_weight_bytes",
+                        labels={"path": "int8" if qc is not None
+                                else "float"}) \
+            .set(_weight_nbytes(params))
+
+
+def _prefill(params, prompt, acts, H, pad_to, valid_len=None,
+             return_h=False):
     """Run the prompt through the model with the TRAINING path's causal
     attention; returns (h_last (B, C) activations at the final prompt
-    position, per-layer K/V caches (B, H, pad_to, D))."""
+    position, per-layer K/V caches (B, H, pad_to, D)).
+
+    `valid_len` (traced scalar) supports bucket-padded prompts: the
+    prompt is RIGHT-padded, so under the causal mask every position
+    < valid_len computes exactly its unpadded value (pad positions only
+    pollute their own rows, which decode overwrites slot-by-slot as it
+    emits tokens); h_last is read at valid_len-1.  `return_h=True`
+    returns the full (B, P, C) hidden states instead of h_last
+    (`lm_score`'s teacher-forced path — the unused caches DCE away).
+    """
     from ..ops.flash_attention import flash_attention
 
     dt = params["embed"].dtype
@@ -146,7 +313,12 @@ def _prefill(params, prompt, acts, H, pad_to):
         pad = ((0, 0), (0, 0), (0, pad_to - P), (0, 0))
         kcs.append(jnp.pad(kt, pad))
         vcs.append(jnp.pad(vt, pad))
-    return h[:, -1], kcs, vcs
+    if return_h:
+        return h, kcs, vcs
+    if valid_len is None:
+        return h[:, -1], kcs, vcs
+    return jax.lax.dynamic_index_in_dim(
+        h, valid_len - 1, axis=1, keepdims=False), kcs, vcs
 
 
 def _cached_self_attn(lp, h, kcache, vcache, t, H):
@@ -234,36 +406,57 @@ def _greedy_loop(first_logits, state0, step_fn, pick, key, t0, N, B,
     done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((B,), bool)
     if N == 1:
         return first[:, None]
+    # t0 may be a TRACED scalar (bucket-padded prompts: the true length
+    # enters the program as an argument) — build positions around it
     (_, last, _), toks = jax.lax.scan(
         step, (state0, first, done0),
-        jnp.arange(t0, t0 + N - 1, dtype=jnp.int32))
+        jnp.arange(N - 1, dtype=jnp.int32) + t0)
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
 
 
-def _build_program(B, P, N, H, temperature, top_k, eos_id, acts):
+def _build_program(B, P, N, H, temperature, top_k, eos_id, acts,
+                   bucketed=False):
     """The (jittable) prefill+scan generation program for one static
     signature.  `params` is `_gather_params`' pytree; `key` a PRNG key;
-    `acts` the per-layer FFN activation names (static)."""
+    `acts` the per-layer FFN activation names (static).
+
+    `bucketed=True` builds the pad-to-bucket variant: P is the BUCKET
+    width, the prompt arrives right-padded, and the true length rides
+    in as a traced scalar (`valid_len`) — prefill reads h_last at
+    valid_len-1 and decode writes/attends cache slots from valid_len
+    on, so the emitted tokens are bit-identical to the exact-shape
+    program's.  Returns only the generated (B, N) block (the caller
+    re-attaches its unpadded prompt)."""
     pick = _make_pick(temperature, top_k)
 
-    def run(params, prompt, key):
-        h_last, kcs, vcs = _prefill(params, prompt, acts, H, P + N)
+    def core(params, prompt, valid_len, key):
+        h_last, kcs, vcs = _prefill(params, prompt, acts, H, P + N,
+                                    valid_len=valid_len)
 
         def step_fn(state, tok, t):
             new_k, new_v, logits = _decode_token(params, acts, state[0],
                                                  state[1], tok, t, H)
             return (new_k, new_v), logits
 
-        gen = _greedy_loop(_logits_of(params, h_last),
-                           (tuple(kcs), tuple(vcs)), step_fn, pick, key,
-                           P, N, B, eos_id)
-        return jnp.concatenate([prompt, gen], axis=1)
+        return _greedy_loop(_logits_of(params, h_last),
+                            (tuple(kcs), tuple(vcs)), step_fn, pick, key,
+                            P if valid_len is None else valid_len,
+                            N, B, eos_id)
+
+    if bucketed:
+        def run(params, prompt, valid_len, key):
+            return core(params, prompt, valid_len, key)
+    else:
+        def run(params, prompt, key):
+            return jnp.concatenate(
+                [prompt, core(params, prompt, None, key)], axis=1)
 
     return run
 
 
 def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
-                top_k: int = 0, eos_id: int = -1, seed: int = 0):
+                top_k: int = 0, eos_id: int = -1, seed: int = 0,
+                quantized=None, pad_to_bucket: bool = False):
     """Generate `max_new_tokens` continuations of `prompt` with
     `models.TransformerLM` `net` (initialized; generation runs in eval
     mode — dropout off).
@@ -274,9 +467,21 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
     eos (further positions emit eos_id).  Returns an int32 (B, P+N)
     jnp array — the prompt followed by the generated tokens.
 
+    `quantized`: None (default) uses the int8 weight-quantized path iff
+    `contrib.quantization.quantize_for_decode(net)` has been applied;
+    True requires it; False forces the float path.  Programs for both
+    paths coexist in the cache (keyed on the quant config).
+
+    `pad_to_bucket=True` right-pads the prompt to its power-of-two
+    length bucket and passes the true length as a program ARGUMENT —
+    token-identical output, but variable-length traffic compiles one
+    program per bucket instead of one per exact length (the program
+    cache is additionally LRU-capped; see `bucket_length`).
+
     The compiled program is cached on the net per
-    (B, P, N, temperature, top_k, eos_id) signature; weights are
-    arguments, so training between calls does not recompile.
+    (B, P, N, temperature, top_k, eos_id, quant, bucketed) signature;
+    weights are arguments, so training between calls does not
+    recompile.
 
     ref: GluonNLP SequenceSampler/BeamSearchSampler role `[UNVERIFIED]`
     re-designed as a single compiled prefill+scan program (SURVEY.md
@@ -295,19 +500,31 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
         raise ValueError(
             f"prompt+new = {P + N} exceeds max_len {net._max_len}")
     H = net._layers[0].attn._num_heads
+    qc = _quant_config(net, quantized)
+    qkey = qc.cache_key() if qc is not None else None
 
-    sig = (B, P, N, float(temperature), int(top_k), int(eos_id))
-    cache = getattr(net, "_gen_programs", None)
-    if cache is None:
-        cache = net._gen_programs = {}
-    fn = cache.get(sig)
+    # pad-to-bucket: the program is shaped for the bucket (never past
+    # max_len - N, so the guard above stays exact)
+    Pp = min(bucket_length(P), net._max_len - N) if pad_to_bucket else P
+
+    sig = (B, Pp, N, float(temperature), int(top_k), int(eos_id), qkey,
+           bool(pad_to_bucket))
+    cache = _program_cache(net)
+    fn = _lru_touch(cache, sig)
     if fn is None:
         acts = tuple(lyr.ffn._act for lyr in net._layers)
-        run = _build_program(B, P, N, H, float(temperature), int(top_k),
-                             int(eos_id), acts)
-        fn = cache[sig] = jax.jit(run)
-    return fn(_gather_params(net, P + N), prompt,
-              jax.random.PRNGKey(seed))
+        run = _build_program(B, Pp, N, H, float(temperature), int(top_k),
+                             int(eos_id), acts, bucketed=pad_to_bucket)
+        fn = _cache_program(net, sig, jax.jit(run))
+    params = _gather_params(net, Pp + N, qc)
+    _record_decode_weight_bytes(params, qc)
+    key = jax.random.PRNGKey(seed)
+    if not pad_to_bucket:
+        return fn(params, prompt, key)
+    padded = prompt if Pp == P else jnp.concatenate(
+        [prompt, jnp.zeros((B, Pp - P), jnp.int32)], axis=1)
+    gen = fn(params, padded, jnp.int32(P), key)
+    return jnp.concatenate([prompt, gen], axis=1)
 
 
 # --------------------------------------------------------------------- #
@@ -413,8 +630,47 @@ def _build_beam_program(B, P, N, K, H, eos_id, alpha, acts):
     return run
 
 
+def lm_score(net, tokens, *, quantized=None):
+    """Teacher-forced per-token log-probabilities of `tokens` under the
+    DECODE stack's numerics (the quantized path iff
+    `quantize_for_decode` was applied / ``quantized=True``): returns
+    f32 (B, T-1) — logp of tokens[:, 1:] given the prefix.  The
+    perplexity oracle the quantization tolerance tests pin against the
+    float path (``exp(-mean(lm_score(...)))``)."""
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(tokens, NDArray):
+        tokens = tokens._data
+    tokens = jnp.asarray(tokens, jnp.int32)
+    B, T = tokens.shape
+    if T < 2:
+        raise ValueError(f"need >= 2 tokens to score, got {T}")
+    if T > net._max_len:
+        raise ValueError(f"sequence {T} exceeds max_len {net._max_len}")
+    H = net._layers[0].attn._num_heads
+    qc = _quant_config(net, quantized)
+    qkey = qc.cache_key() if qc is not None else None
+
+    sig = ("score", B, T, qkey)
+    cache = _program_cache(net)
+    fn = _lru_touch(cache, sig)
+    if fn is None:
+        acts = tuple(lyr.ffn._act for lyr in net._layers)
+
+        def run(params, toks):
+            h, _, _ = _prefill(params, toks, acts, H, T, return_h=True)
+            logits = _dense(_ln(h, *params["ln"]), *params["head"],
+                            out_dtype=jnp.float32)
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            return jnp.take_along_axis(
+                logp, toks[:, 1:, None], axis=2)[..., 0]
+
+        fn = _cache_program(net, sig, jax.jit(run))
+    return fn(_gather_params(net, T, qc), tokens)
+
+
 def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
-                   eos_id: int = -1, alpha: float = 0.0):
+                   eos_id: int = -1, alpha: float = 0.0, quantized=None):
     """K-beam search decode for `models.TransformerLM` — the
     TPU-native counterpart of the reference era's BeamSearchSampler
     (GluonNLP `[UNVERIFIED — mount empty]`): prefill + the whole beam
@@ -426,6 +682,8 @@ def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
     cumulative log-probabilities (GNMT length-penalty-normalized when
     ``alpha > 0``; eos_id >= 0 freezes finished beams' scores and
     lengths).  beam_size=1 reproduces greedy `lm_generate` exactly.
+    `quantized` selects the int8 weight-quantized path as in
+    `lm_generate`.
     """
     from ..ndarray.ndarray import NDArray
 
@@ -446,28 +704,36 @@ def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
         raise ValueError(
             f"prompt+new = {P + N} exceeds max_len {net._max_len}")
     H = net._layers[0].attn._num_heads
+    qc = _quant_config(net, quantized)
+    qkey = qc.cache_key() if qc is not None else None
 
-    sig = ("beam", B, P, N, K, int(eos_id), float(alpha))
-    cache = getattr(net, "_gen_programs", None)
-    if cache is None:
-        cache = net._gen_programs = {}
-    fn = cache.get(sig)
+    sig = ("beam", B, P, N, K, int(eos_id), float(alpha), qkey)
+    cache = _program_cache(net)
+    fn = _lru_touch(cache, sig)
     if fn is None:
         acts = tuple(lyr.ffn._act for lyr in net._layers)
         run = _build_beam_program(B, P, N, K, H, int(eos_id),
                                   float(alpha), acts)
-        fn = cache[sig] = jax.jit(run)
-    return fn(_gather_params(net, P + N), prompt)
+        fn = _cache_program(net, sig, jax.jit(run))
+    params = _gather_params(net, P + N, qc)
+    _record_decode_weight_bytes(params, qc)
+    return fn(params, prompt)
 
 
 # --------------------------------------------------------------------- #
 # NMT (encoder-decoder Transformer) translation
 # --------------------------------------------------------------------- #
-def _gather_nmt_params(net):
+def _gather_nmt_params(net, qc=None):
     """Decoder-side weight pytree for `models.Transformer` (the encoder
     runs through the PUBLIC block — training numerics — outside the
-    decode program)."""
+    decode program).  With a DecodeQuantConfig `qc`, target decoder
+    matmul weights come out as int8+scale dicts (see `_dense`)."""
     def d(layer):
+        if qc is not None:
+            packed = qc.packed(layer)
+            if packed is not None:
+                return (packed, None if layer.bias is None
+                        else layer.bias.data()._data)
         return (layer.weight.data()._data,
                 None if layer.bias is None else layer.bias.data()._data)
 
@@ -525,8 +791,9 @@ def _nmt_decode_token(params, acts, pe, kcaches, vcaches, xks, xvs,
         h = h + _ffn_fwd(_ln(h, *lp["ln3"]), lp, act)
         new_k.append(kc)
         new_v.append(vc)
-    logits = _dense(_ln(h, *params["ln"]), *params["head"])
-    return tuple(new_k), tuple(new_v), logits.astype(jnp.float32)
+    logits = _dense(_ln(h, *params["ln"]), *params["head"],
+                    out_dtype=jnp.float32)
+    return tuple(new_k), tuple(new_v), logits
 
 
 def _build_nmt_program(B, S, N, K, H, eos_id, bos_id, alpha, temperature,
@@ -595,7 +862,7 @@ def _build_nmt_program(B, S, N, K, H, eos_id, bos_id, alpha, temperature,
 def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
                   eos_id: int = -1, bos_id: int = 0, alpha: float = 0.0,
                   temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                  src_valid_length=None):
+                  src_valid_length=None, quantized=None):
     """Translate `src` with `models.Transformer` (encoder-decoder):
     the ENCODER runs through the public block (training numerics), the
     decoder runs the compiled KV-cache loop — greedy/sampling when
@@ -604,7 +871,9 @@ def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
     scores (B, K)) best-first, GNMT length penalty via ``alpha``).
 
     ``bos_id`` seeds the decoder (the training convention prepends
-    BOS=0); ``eos_id >= 0`` freezes finished rows/beams.
+    BOS=0); ``eos_id >= 0`` freezes finished rows/beams.  `quantized`
+    selects the int8 weight-quantized decoder path as in `lm_generate`
+    (the encoder stays float).
     ref: GluonNLP BeamSearchTranslator role `[UNVERIFIED — mount
     empty]`, one compiled program per signature.
     """
@@ -621,6 +890,19 @@ def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
         raise ValueError(f"max_len must be >= 1, got {N}")
     if K < 1:
         raise ValueError(f"beam_size must be >= 1, got {K}")
+    # the same positional-limit contract lm_generate enforces via
+    # net._max_len (ADVICE r5 #1: the attribute was dead and the two
+    # entry points inconsistent)
+    max_length = getattr(net, "_max_length", None)
+    if max_length is not None:
+        if N > max_length:
+            raise ValueError(
+                f"max_len {N} exceeds the model's max_length "
+                f"{max_length}")
+        if S > max_length:
+            raise ValueError(
+                f"src length {S} exceeds the model's max_length "
+                f"{max_length}")
     V = net.out_proj._units
     if K > V:
         raise ValueError(f"beam_size {K} exceeds vocab {V}")
@@ -629,6 +911,8 @@ def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
             "beam search is deterministic — temperature/top_k only "
             "apply at beam_size=1")
     H = net.decoder._layers[0].self_attn._num_heads
+    qc = _quant_config(net, quantized)
+    qkey = qc.cache_key() if qc is not None else None
 
     # encoder through the PUBLIC blocks — exact training numerics
     mask_nd = None
@@ -645,20 +929,20 @@ def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
     # of the beam cache key so a sweep cannot trigger recompiles
     samp = (float(temperature), int(top_k)) if K == 1 else (0.0, 0)
     sig = ("nmt", B, S, N, K, int(eos_id), int(bos_id), float(alpha),
-           samp, masked)
-    cache = getattr(net, "_gen_programs", None)
-    if cache is None:
-        cache = net._gen_programs = {}
-    fn = cache.get(sig)
+           samp, masked, qkey)
+    cache = _program_cache(net)
+    fn = _lru_touch(cache, sig)
     if fn is None:
         acts = tuple(lyr.ffn._act for lyr in net.decoder._layers)
         run = _build_nmt_program(B, S, N, K, H, int(eos_id), int(bos_id),
                                  float(alpha), samp[0], samp[1], acts,
                                  masked)
-        fn = cache[sig] = jax.jit(run)
+        fn = _cache_program(net, sig, jax.jit(run))
     # pe table built ONCE per width and cached on the net (an eager
     # rebuild per call would pay table construction + h2d every batch)
     pe = _pe_table(net, N + 1)
-    gen, scores = fn(_gather_nmt_params(net), mem, mem_mask, pe,
+    params = _gather_nmt_params(net, qc)
+    _record_decode_weight_bytes(params, qc)
+    gen, scores = fn(params, mem, mem_mask, pe,
                      jax.random.PRNGKey(seed))
     return gen if K == 1 else (gen, scores)

@@ -254,6 +254,29 @@ class TransformerLM(HybridBlock):
 
         return lm_beam_search(self, prompt, max_new_tokens, **kw)
 
+    def score(self, tokens, **kw):
+        """Teacher-forced per-token log-probs through the decode
+        stack's numerics; see `models.generation.lm_score`."""
+        from .generation import lm_score
+
+        return lm_score(self, tokens, **kw)
+
+    def quantize_for_decode(self, **kw):
+        """Weight-quantize this net's transformer matmuls for decode
+        (per-channel int8 + fp32 scales; int8 weights stream through
+        the compiled generate/beam-search programs).  See
+        `contrib.quantization.quantize_for_decode`."""
+        from ..contrib.quantization import quantize_for_decode
+
+        return quantize_for_decode(self, **kw)
+
+    def dequantize_decode(self):
+        """Drop the decode-quantization marking — generation goes back
+        to the float path."""
+        from ..contrib.quantization import dequantize_decode
+
+        return dequantize_decode(self)
+
 
 class Transformer(HybridBlock):
     def __init__(self, src_vocab=32000, tgt_vocab=32000, units=512,
@@ -287,6 +310,21 @@ class Transformer(HybridBlock):
         from .generation import nmt_translate
 
         return nmt_translate(self, src, max_len, **kw)
+
+    def quantize_for_decode(self, **kw):
+        """Weight-quantize the DECODER's matmuls for translation
+        (per-channel int8 + fp32 scales; the encoder stays float).  See
+        `contrib.quantization.quantize_for_decode`."""
+        from ..contrib.quantization import quantize_for_decode
+
+        return quantize_for_decode(self, **kw)
+
+    def dequantize_decode(self):
+        """Drop the decode-quantization marking — translation goes back
+        to the float path."""
+        from ..contrib.quantization import dequantize_decode
+
+        return dequantize_decode(self)
 
     def forward(self, src_tokens, tgt_tokens, src_valid_length=None):
         src = self._embed(self.src_embed, src_tokens)

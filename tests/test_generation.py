@@ -314,6 +314,94 @@ def test_nmt_beam_sampling_conflict_raises():
         net.translate(src, 3, beam_size=2, temperature=0.7)
 
 
+def test_nmt_max_length_guard():
+    """ADVICE r5 #1: nmt_translate must validate like lm_generate does
+    — max_len AND src length against net._max_length (the attribute was
+    dead while lm_generate enforced net._max_len)."""
+    net = _nmt_net()
+    limit = net._max_length
+    src = onp.ones((1, 4), "int32")
+    with pytest.raises(ValueError, match="max_length"):
+        net.translate(src, limit + 1)
+    with pytest.raises(ValueError, match="max_length"):
+        net.translate(onp.ones((1, limit + 1), "int32"), 3)
+    # at the limit itself the guard stays quiet (only shape cost)
+    net.translate(src, 2)  # well inside — sanity
+
+
+# ------------------------------------------------------------------ #
+# prompt-length bucketing + program-cache LRU (ADVICE r5 #3)
+# ------------------------------------------------------------------ #
+from incubator_mxnet_tpu.models.generation import bucket_length
+
+
+def test_bucket_length_rule():
+    assert bucket_length(0) == 16
+    assert bucket_length(5) == 16
+    assert bucket_length(16) == 16
+    assert bucket_length(17) == 32
+    assert bucket_length(33) == 64
+    assert bucket_length(3, floor=2) == 4
+    with pytest.raises(ValueError):
+        bucket_length(-1)
+
+
+def test_pad_to_bucket_token_identical_and_one_program_per_bucket():
+    """The bucketed program right-pads the prompt and threads the true
+    length through as a traced argument — tokens must be IDENTICAL to
+    the exact-shape program's (right-padding under a causal mask cannot
+    touch valid positions, and decode overwrites pad cache slots
+    position by position)."""
+    net = _net()
+    outs = {}
+    for P in (3, 5, 7):  # one bucket (16) for all three lengths
+        prompt = onp.array(jax.random.randint(jax.random.PRNGKey(P),
+                                              (2, P), 0, V), dtype="int32")
+        outs[P] = onp.asarray(net.generate(prompt, 6, pad_to_bucket=True))
+        want = onp.asarray(net.generate(prompt, 6))
+        onp.testing.assert_array_equal(outs[P], want)
+    # 3 exact-shape programs + ONE shared bucketed program
+    sigs = list(net._gen_programs)
+    assert sum(1 for s in sigs if s[-1] is True) == 1
+    # bucket never exceeds max_len - N: a prompt near the cap still works
+    prompt = onp.array(jax.random.randint(jax.random.PRNGKey(0), (2, 50),
+                                          0, V), dtype="int32")
+    out = onp.asarray(net.generate(prompt, 6, pad_to_bucket=True))  # 56<=58
+    onp.testing.assert_array_equal(
+        out, onp.asarray(net.generate(prompt, 6)))
+
+
+def test_gen_program_cache_lru_cap():
+    net = _net()
+    net._gen_program_cache_cap = 3
+    for P in (2, 3, 4, 5, 6):
+        net.generate(onp.ones((1, P), "int32"), 1)
+    assert len(net._gen_programs) == 3
+    # most-recent signatures survive (P = 4, 5, 6)
+    assert {s[1] for s in net._gen_programs} == {4, 5, 6}
+    # a cache hit refreshes recency: touch P=4, insert P=7 → 5 evicted
+    net.generate(onp.ones((1, 4), "int32"), 1)
+    net.generate(onp.ones((1, 7), "int32"), 1)
+    assert {s[1] for s in net._gen_programs} == {4, 6, 7}
+
+
+def test_pe_cache_lru_cap():
+    from incubator_mxnet_tpu.models.transformer import _PE_TABLE_MAX
+
+    mx.random.seed(5)
+    big = TransformerLM(vocab=31, units=16, hidden_size=32, num_layers=1,
+                        num_heads=2, max_len=_PE_TABLE_MAX + 1,
+                        dropout=0.0)
+    big.initialize()
+    big(NDArray(jnp.ones((1, 4), jnp.int32)))
+    assert big._pe is None  # width-keyed eager-table regime
+    big._pe_cache_cap = 2
+    for P in (3, 4, 5, 6):
+        big.generate(onp.ones((1, P), "int32"), 2)
+    assert len(big._pe_cache) == 2
+    assert set(big._pe_cache) == {7, 8}  # the two most recent widths
+
+
 def test_long_maxlen_in_program_pe():
     """max_len > _PE_TABLE_MAX: the forward computes pe IN-PROGRAM (no
     O(max_len*units) constant in the compiled program — the r5 fix for
