@@ -30,13 +30,18 @@ H, D = 16, 64
 REPS, K = 3, 32
 
 
-def _time_chained(fn, args, flops):
+def _time_chained(fn, args, flops, program=None):
     """K invocations chained in one jit; fetch once.  Returns (ms, tfs).
 
     The body DEPENDS on the scan carry (q is perturbed by a zero that
     XLA cannot prove zero-valued at trace time), so the kernel cannot
     be hoisted out of the loop; K=32 amortizes the ~50–90 ms relay
-    d2h fetch to ~2 ms which the null variant subtracts."""
+    d2h fetch to ~2 ms which the null variant subtracts.
+
+    With telemetry enabled and a `program` name, the chained program's
+    cost/memory analysis and best measured wall land in the
+    telemetry.perf roofline attribution (tools/roofline_report.py's
+    table format; one scan-body execution per the XLA cost model)."""
 
     @jax.jit
     def multi(*a):
@@ -69,6 +74,12 @@ def _time_chained(fn, args, flops):
         float(multi(*args))
         best = min(best, (time.perf_counter() - t0 - t_null) / K)
     best = max(best, 1e-6)  # fetch jitter must never yield <=0
+    if program is not None:
+        from incubator_mxnet_tpu import telemetry
+
+        if telemetry.enabled():
+            telemetry.perf.capture(program, multi, *args)
+            telemetry.perf.note_timing(program, best)
     return best * 1e3, flops / best / 1e12
 
 
@@ -92,7 +103,8 @@ def main():
         fwd = functools.partial(fa._flash_core, causal=True, scale=scale,
                                 block_q=bq, block_k=bq, interpret=False)
         ms, tfs = _time_chained(lambda a, b, c: fwd(a, b, c),
-                                (q, k, v), 2 * causal_flops)
+                                (q, k, v), 2 * causal_flops,
+                                program=f"flash_fwd_T{T}")
         print(f"T={T} B={B} fwd[{'resident' if resident else 'streamed'}] "
               f"bq=bk={bq}: {ms:.2f} ms  {tfs:.1f} TF/s", flush=True)
 
@@ -107,7 +119,8 @@ def main():
                                       block_k=bqb, interpret=False)
 
         ms, tfs = _time_chained(lambda a, b, c, d: (bwd(a, b, c, d)[1],),
-                                (q, k, v, do), 7 * causal_flops)
+                                (q, k, v, do), 7 * causal_flops,
+                                program=f"flash_bwd_T{T}")
         print(f"T={T} B={B} bwd[dkdv+dq] bq=bk={bqb}: {ms:.2f} ms  "
               f"{tfs:.1f} TF/s (combined)", flush=True)
 

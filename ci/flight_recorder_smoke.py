@@ -1,0 +1,124 @@
+"""CI gate: the crash/preemption flight recorder really ships a bundle.
+
+Parent/child protocol:
+
+1. the CHILD (`--child`) runs a 3-step Gluon train with
+   ``MXTPU_FLIGHT_DIR`` set (which both enables telemetry and installs
+   the recorder), prints READY, and parks;
+2. the PARENT SIGTERMs it — the preemption signal TPU pools deliver —
+   and asserts:
+   * the child exits with the conventional 128+SIGTERM code (the
+     handler re-delivers after dumping, so preemption tooling still
+     sees a killed process);
+   * ``flight.jsonl`` exists, parses, leads with a ``flight_meta``
+     line whose reason is ``signal:SIGTERM``;
+   * the FINAL record is the in-flight step (step 3) and carries its
+     span tree (``trainer/step``) and a metric snapshot
+     (``trainer_step_seconds`` count == 3);
+   * ``flight_trace.json`` is a well-formed chrome trace of the window.
+
+Run via ci/lint.sh; standalone:
+    JAX_PLATFORMS=cpu python ci/flight_recorder_smoke.py
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def child():
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd
+    from incubator_mxnet_tpu.gluon import Trainer, nn
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    mx.random.seed(0)
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = NDArray(jnp.ones((2, 3)))
+    for _ in range(3):
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        tr.step(2)
+    print("READY", flush=True)
+    while True:  # park: the parent's SIGTERM is the exit path
+        time.sleep(0.1)
+
+
+def main():
+    flight_dir = tempfile.mkdtemp(prefix="mxtpu_flight_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXTPU_FLIGHT_DIR=flight_dir)
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             "--child"],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 180
+        line = ""
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "READY" in line:
+                break
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"child died before READY: {line}{proc.stdout.read()}")
+        else:
+            raise AssertionError("child never reached READY")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    assert rc == -signal.SIGTERM or rc == 128 + signal.SIGTERM, \
+        f"child exit code {rc}, wanted SIGTERM death (-15 or 143)"
+
+    jsonl = os.path.join(flight_dir, "flight.jsonl")
+    trace = os.path.join(flight_dir, "flight_trace.json")
+    assert os.path.exists(jsonl), f"no flight.jsonl in {flight_dir}"
+    assert os.path.exists(trace), f"no flight_trace.json in {flight_dir}"
+
+    with open(jsonl) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert lines and "flight_meta" in lines[0], f"no flight_meta: {lines[:1]}"
+    meta = lines[0]["flight_meta"]
+    assert meta["reason"] == "signal:SIGTERM", meta
+    assert meta["step"] == 3 and meta["records"] == len(lines) - 1, meta
+
+    records = lines[1:]
+    assert records, "flight bundle carries no step records"
+    last = records[-1]
+    assert last["step"] == 3, f"final record is step {last['step']}, not 3"
+    span_names = {s["name"] for s in last["spans"]}
+    assert "trainer/step" in span_names, \
+        f"final step's span tree missing trainer/step: {span_names}"
+    hist = last["metrics"].get("trainer_step_seconds")
+    assert hist and hist["count"] == 3, \
+        f"final metric snapshot wrong: trainer_step_seconds={hist}"
+    assert last["deltas"], "final record carries no counter deltas"
+
+    with open(trace) as f:
+        tr = json.load(f)
+    assert tr.get("traceEvents"), "flight_trace.json has no events"
+    assert any(e.get("name") == "trainer/step" for e in tr["traceEvents"])
+
+    print(f"flight recorder smoke: OK ({len(records)} records, "
+          f"reason {meta['reason']}, exit {rc})")
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -30,4 +30,7 @@ JAX_PLATFORMS=cpu python ci/overlap_smoke.py
 echo "quantized decode smoke: int8 weight streaming + greedy parity"
 JAX_PLATFORMS=cpu python ci/quantized_decode_smoke.py
 
+echo "flight recorder smoke: SIGTERM mid-train ships a parseable bundle"
+JAX_PLATFORMS=cpu python ci/flight_recorder_smoke.py
+
 echo "lint gates: OK"

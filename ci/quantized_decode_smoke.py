@@ -92,6 +92,34 @@ def main():
         print(f"quantized decode smoke [{aq}]: parity {parity:.0%}, "
               f"{len(w_shapes)} weight shapes gated")
         net.dequantize_decode()
+
+    # LRU eviction accounting (ISSUE 8 satellite): squeezing the program
+    # cache below its population must tick the eviction counter and the
+    # size gauge must settle at the cap
+    from incubator_mxnet_tpu import telemetry
+
+    was_on = telemetry.enabled()
+    telemetry.enable()
+    try:
+        evict = telemetry.counter("gen_program_cache_evictions_total")
+        before = evict.value
+        net._gen_program_cache_cap = 1
+        net.generate(prompt, 2)
+        net.generate(prompt, 3)
+        assert evict.value > before, \
+            "gen_program_cache_evictions_total did not advance under a " \
+            f"cap-1 cache (before={before}, after={evict.value})"
+        assert len(net._gen_programs) == 1, \
+            f"cap-1 cache holds {len(net._gen_programs)} programs"
+        size = telemetry.get_registry().get("gen_program_cache_size")
+        assert size is not None and size.value == 1, \
+            f"gen_program_cache_size gauge reads {size and size.value}, not 1"
+        print(f"quantized decode smoke [lru]: "
+              f"{int(evict.value - before)} evictions counted")
+    finally:
+        del net._gen_program_cache_cap
+        if not was_on:
+            telemetry.disable()
     print("quantized decode smoke: OK")
 
 

@@ -74,6 +74,32 @@ _BF16_PEAKS = [  # chip-kind substring -> bf16 peak FLOP/s (canonical
     ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
 ]
 
+_HBM_PEAKS = [  # chip-kind substring -> peak HBM bandwidth, bytes/s
+    ("v6e", 1640e9), ("v6", 1640e9),     # (telemetry.perf roofline
+    ("v5p", 2765e9),                     # denominator — same substring
+    ("v5e", 819e9), ("v5 lite", 819e9),  # matching as _BF16_PEAKS)
+    ("v5litepod", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+]
+
+
+def device_peak_hbm_bytes_per_s(device=None) -> float:
+    """Peak HBM bandwidth (bytes/s) for the (first) local accelerator.
+
+    The memory-side roofline denominator (telemetry/perf.py); an
+    unknown accelerator falls back to a nominal 100 GB/s — like
+    `device_peak_flops` the fallback keeps CPU smoke configurations
+    silent (bandwidth-bound fractions there are not meaningful).
+    """
+    import jax
+
+    dev = device or jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    for sub, peak in _HBM_PEAKS:
+        if sub in kind:
+            return peak
+    return 100e9  # nominal (CPU smoke / unknown chip)
+
 
 def device_peak_flops(device=None) -> float:
     """bf16 peak for the (first) local accelerator.

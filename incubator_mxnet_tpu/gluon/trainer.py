@@ -197,6 +197,9 @@ class Trainer:
         self._zero_warned: set = set()  # one-time warning keys
         self._capture_hlo = False       # tests/dryrun: keep last_step_hlo
         self.last_step_hlo: Optional[str] = None
+        # perf-attribution program name of the step path that last ran
+        # (telemetry.perf roofline/MFU gauges key on it)
+        self._perf_program: Optional[str] = None
 
     def _get_mesh(self):
         """Explicit mesh, else inferred from any NamedSharded param.
@@ -1036,6 +1039,13 @@ class Trainer:
         weights = tuple(self._params[i]._data_nd._data for i in idxs)
         grads = tuple(raw(self._params[i].grad()) for i in idxs)
         states = tuple(self._states[i] for i in idxs)
+        self._perf_program = "trainer_fused_step"
+        if telemetry.enabled():
+            # captured once per program name (AOT; the jit call cache is
+            # untouched) — repeat calls are a dict lookup
+            telemetry.perf.capture("trainer_fused_step", self._fused_fn,
+                                   weights, grads, states, ts, lr, opt.wd,
+                                   opt.rescale_grad, keys)
         new_w, new_s, sync = self._fused_fn(weights, grads, states, ts, lr,
                                             opt.wd, opt.rescale_grad, keys)
         for i, nw, ns in zip(idxs, new_w, new_s):
@@ -1064,9 +1074,13 @@ class Trainer:
         t0 = time.perf_counter()
         with telemetry.span("trainer/step"):
             self._step_impl(batch_size, ignore_stale_grad)
-        telemetry.histogram("trainer_step_seconds") \
-            .observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        telemetry.histogram("trainer_step_seconds").observe(dt)
         telemetry.counter("trainer_steps_total").inc()
+        # roofline/MFU attribution: fold this step's host wall time into
+        # the program_* gauges of whichever compiled step path ran (a
+        # no-op when that program's costs were never captured)
+        telemetry.perf.note_timing(self._perf_program, dt)
 
     def _step_impl(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -1143,6 +1157,7 @@ class Trainer:
             if ctx is None:
                 return False
             self._fullstep_ctx = ctx
+        self._perf_program = ctx.get("perf_program")
         if self._chain_allowed():
             return self._enqueue_chain(ctx, pending)
         import jax.numpy as jnp
@@ -1270,6 +1285,13 @@ class Trainer:
                         for i in idx_of
                         for l in jax.tree_util.tree_leaves(self._states[i]))
             held += sum(_aval_bytes(a) for a in pending.input_raws)
+        # roofline/MFU attribution name of this one-program step path:
+        # telemetry.perf keys its program_* gauges on it, and step()
+        # feeds each step's wall time back under the same name
+        pname = "trainer_full_step"
+        if zsig is not None:
+            pname += "_zero_bucketed" if zero_buckets is not None \
+                else f"_zero_{zsig[0]}"
         ctx = {
             "sig": sig,
             "mults": mults,
@@ -1282,12 +1304,22 @@ class Trainer:
             "zero_sig": zsig,
             "zero_bytes": zero_bytes,
             "zero_buckets": zero_buckets,
+            "perf_program": pname,
+            "lower_avals": None,
         }
         if telemetry.enabled():
             telemetry.gauge("optimizer_state_bytes_per_device") \
                 .set(self.optimizer_state_bytes_per_device())
-        if self._capture_hlo:
-            self.last_step_hlo = self._lower_step_hlo(fn, pending, ctx)
+        if self._capture_hlo or telemetry.enabled():
+            try:
+                args = self._step_lower_args(pending, ctx)
+                # retention-free skeleton for capture_step_costs() —
+                # callers that enable telemetry after the build
+                ctx["lower_avals"] = self._avalize(args)
+                self._capture_step_artifacts(fn, ctx, args)
+            except Exception:
+                if self._capture_hlo:
+                    self.last_step_hlo = None
         return ctx
 
     def _sync_states(self):
@@ -1418,28 +1450,91 @@ class Trainer:
             telemetry.counter("collective_bytes_total",
                               labels={"op": op}).inc(int(b) * k)
 
-    def _lower_step_hlo(self, fn, pending, ctx):
-        """Compiled-HLO capture of the fused step (tests/dryrun gates:
-        reduce-scatter > 0, per-axis all-reduce attribution).  AOT
-        lower+compile — the regular jit call cache is untouched."""
+    def _step_lower_args(self, pending, ctx):
+        """The argument tuple the full-step program lowers against —
+        shared by the HLO-text capture (tests/dryrun gates) and the
+        telemetry.perf cost/memory capture."""
+        import jax.numpy as jnp
+
+        from .block import _resolve_raws
+
+        opt = self._optimizer
+        # only shapes/dtypes matter for lowering: the update counts
+        # may not exist yet at prepare time, so feed a zero vector
+        return (_resolve_raws(pending.train_raws),
+                _resolve_raws(pending.aux_raws), ctx["states"],
+                pending.rng, pending.rng_ctr,
+                tuple(self._shard_inputs(pending.input_raws)),
+                jnp.zeros((len(ctx["idx_of"]),), jnp.int32),
+                float(opt.learning_rate), float(opt.wd),
+                float(opt.rescale_grad), None)
+
+    @staticmethod
+    def _avalize(args):
+        """Shape/dtype/sharding skeleton of a lowering-argument tree —
+        retention-free (holds no device buffers), so the fullstep ctx
+        can keep it for a LATER AOT capture (bench's post-loop roofline
+        phase) without pinning forward-output-sized arrays."""
+        def to_aval(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                sh = getattr(x, "sharding", None)
+                try:
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+                except Exception:
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return x
+
+        return jax.tree_util.tree_map(to_aval, args)
+
+    def _capture_step_artifacts(self, fn, ctx, args):
+        """AOT lower+compile of the full-step program (the regular jit
+        call cache is untouched) feeding both consumers: compiled-HLO
+        text when `_capture_hlo`, telemetry.perf cost/memory analysis
+        when telemetry is enabled."""
         try:
-            import jax.numpy as jnp
+            compiled = fn.lower(*args).compile()
+        except Exception:
+            if self._capture_hlo:
+                self.last_step_hlo = None
+            return
+        if self._capture_hlo:
+            try:
+                self.last_step_hlo = compiled.as_text()
+            except Exception:
+                self.last_step_hlo = None
+        if telemetry.enabled():
+            telemetry.perf.capture_compiled(ctx["perf_program"], compiled,
+                                            sig=ctx["sig"])
 
-            from .block import _resolve_raws
-
-            opt = self._optimizer
-            # only shapes/dtypes matter for lowering: the update counts
-            # may not exist yet at prepare time, so feed a zero vector
-            args = (_resolve_raws(pending.train_raws),
-                    _resolve_raws(pending.aux_raws), ctx["states"],
-                    pending.rng, pending.rng_ctr,
-                    tuple(self._shard_inputs(pending.input_raws)),
-                    jnp.zeros((len(ctx["idx_of"]),), jnp.int32),
-                    float(opt.learning_rate), float(opt.wd),
-                    float(opt.rescale_grad), None)
+    def _lower_step_hlo(self, fn, pending, ctx):
+        """Compiled-HLO text of the fused step (tests/dryrun gates:
+        reduce-scatter > 0, per-axis all-reduce attribution)."""
+        try:
+            args = self._step_lower_args(pending, ctx)
             return fn.lower(*args).compile().as_text()
         except Exception:
             return None
+
+    def capture_step_costs(self):
+        """Re-run the telemetry.perf cost/memory capture for the CURRENT
+        full-step program from the retention-free aval skeleton stored
+        at prepare time — for callers (bench.py's post-loop roofline
+        phase) that enable telemetry only after the program was built.
+        Returns the program name, or None (no ctx / telemetry off /
+        analysis unavailable)."""
+        ctx = self._fullstep_ctx
+        if ctx is None or not telemetry.enabled():
+            return None
+        avals = ctx.get("lower_avals")
+        if avals is None:
+            return None
+        try:
+            compiled = ctx["fn"].lower(*avals).compile()
+        except Exception:
+            return None
+        pc = telemetry.perf.capture_compiled(ctx["perf_program"], compiled,
+                                             sig=ctx["sig"])
+        return None if pc is None else ctx["perf_program"]
 
     def _try_build_zero_explicit(self, pending, mults, zr, idx_of):
         """Build the explicit-tier step, or None (sticky gspmd fallback)

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from collections import OrderedDict
 
 import jax
@@ -118,16 +119,22 @@ def _lru_touch(cache, key):
 
 def _lru_put(net, cache, key, val, cap_attr, default_cap, gauge=None):
     """LRU insert with eviction beyond the cap (net attribute override
-    `cap_attr`, else `default_cap`); mirrors the size into `gauge`."""
+    `cap_attr`, else `default_cap`); mirrors the size into `gauge` and
+    counts evictions into ``gen_program_cache_evictions_total``."""
     cache[key] = val
     cap = max(1, int(getattr(net, cap_attr, default_cap)))
+    evicted = 0
     while len(cache) > cap:
         cache.popitem(last=False)
+        evicted += 1
     if gauge is not None:
         from .. import telemetry
 
         if telemetry.enabled():
             telemetry.gauge(gauge).set(len(cache))
+            if evicted:
+                telemetry.counter("gen_program_cache_evictions_total") \
+                    .inc(evicted)
     return val
 
 
@@ -274,6 +281,46 @@ def _record_decode_weight_bytes(params, qc):
                         labels={"path": "int8" if qc is not None
                                 else "float"}) \
             .set(_weight_nbytes(params))
+
+
+def _decode_path(qc):
+    """Roofline/SLO label of a generation call: which weight path ran."""
+    return "int8" if qc is not None else "float"
+
+
+def _timed_decode(program, path, n_tokens, fn, *args, slo=True):
+    """Run compiled decode program `fn(*args)`; with telemetry enabled,
+    attribute it for the roofline (cost/memory capture once per
+    `program` name — AOT, the jit call cache is untouched) and record
+    the serving SLO gauges:
+
+    * ``decode_ttft_seconds{path=}`` — host wall time of the call.  The
+      entire generation is ONE compiled program, so the first and last
+      token become available together: TTFT equals whole-call latency
+      by construction.
+    * ``decode_tokens_per_second{path=}`` — emitted tokens / wall time.
+
+    NO-HOST-SYNC: only host clocks are read — on an async backend the
+    wall time is dispatch-side and becomes end-to-end once the caller
+    consumes the tokens (serving always does, immediately); the gauges
+    are exact there and never force a device sync here.  `slo=False`
+    (lm_score) keeps the roofline attribution but skips the serving
+    gauges — scores are not tokens.
+    """
+    from .. import telemetry
+
+    if not telemetry.enabled():
+        return fn(*args)
+    telemetry.perf.capture(program, fn, *args)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    dt = time.perf_counter() - t0
+    if slo and dt > 0:
+        telemetry.gauge("decode_ttft_seconds", labels={"path": path}).set(dt)
+        telemetry.gauge("decode_tokens_per_second", labels={"path": path}) \
+            .set(n_tokens / dt)
+    telemetry.perf.note_timing(program, dt)
+    return out
 
 
 def _prefill(params, prompt, acts, H, pad_to, valid_len=None,
@@ -518,12 +565,15 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
         fn = _cache_program(net, sig, jax.jit(run))
     params = _gather_params(net, Pp + N, qc)
     _record_decode_weight_bytes(params, qc)
+    path = _decode_path(qc)
     key = jax.random.PRNGKey(seed)
     if not pad_to_bucket:
-        return fn(params, prompt, key)
+        return _timed_decode(f"decode_{path}", path, B * N,
+                             fn, params, prompt, key)
     padded = prompt if Pp == P else jnp.concatenate(
         [prompt, jnp.zeros((B, Pp - P), jnp.int32)], axis=1)
-    gen = fn(params, padded, jnp.int32(P), key)
+    gen = _timed_decode(f"decode_{path}", path, B * N,
+                        fn, params, padded, jnp.int32(P), key)
     return jnp.concatenate([prompt, gen], axis=1)
 
 
@@ -666,7 +716,9 @@ def lm_score(net, tokens, *, quantized=None):
                 logp, toks[:, 1:, None], axis=2)[..., 0]
 
         fn = _cache_program(net, sig, jax.jit(run))
-    return fn(_gather_params(net, T, qc), tokens)
+    path = _decode_path(qc)
+    return _timed_decode(f"score_{path}", path, 0,
+                         fn, _gather_params(net, T, qc), tokens, slo=False)
 
 
 def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
@@ -717,7 +769,9 @@ def lm_beam_search(net, prompt, max_new_tokens: int, *, beam_size: int = 4,
         fn = _cache_program(net, sig, jax.jit(run))
     params = _gather_params(net, P + N, qc)
     _record_decode_weight_bytes(params, qc)
-    return fn(params, prompt)
+    path = _decode_path(qc)
+    return _timed_decode(f"beam_decode_{path}", path, B * K * N,
+                         fn, params, prompt)
 
 
 # --------------------------------------------------------------------- #
@@ -943,6 +997,8 @@ def nmt_translate(net, src, max_len: int, *, beam_size: int = 1,
     pe = _pe_table(net, N + 1)
     params = _gather_nmt_params(net, qc)
     _record_decode_weight_bytes(params, qc)
-    gen, scores = fn(params, mem, mem_mask, pe,
-                     jax.random.PRNGKey(seed))
+    path = _decode_path(qc)
+    gen, scores = _timed_decode(f"nmt_decode_{path}", path, B * K * N,
+                                fn, params, mem, mem_mask, pe,
+                                jax.random.PRNGKey(seed))
     return gen if K == 1 else (gen, scores)

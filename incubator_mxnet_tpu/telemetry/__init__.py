@@ -22,6 +22,14 @@ env:
 * ``MXTPU_TELEMETRY_DIR=path``   dump directory (default: cwd)
 * ``MXTPU_TELEMETRY_INTERVAL=N`` also dump every N trainer steps
 * ``MXTPU_TELEMETRY_SPAN_BUF=N`` span ring-buffer size (default 16384)
+* ``MXTPU_FLIGHT_DIR=path``      enable + install the crash/preemption
+  flight recorder (telemetry.flight_recorder); bundles land in `path`
+* ``MXTPU_FLIGHT_STEPS=N``       flight-recorder ring size (default 16)
+
+The ISSUE 8 performance layer lives in two submodules: ``perf``
+(roofline/MFU program attribution + device-memory watermarks) and
+``flight_recorder`` (last-N-steps ring dumped on SIGTERM/SIGINT/fatal
+exception) — both ride the same near-zero disabled path.
 
 THE NO-HOST-SYNC RULE: instrumentation must never force a device sync
 — record only host clocks (time.perf_counter), aval metadata
@@ -43,11 +51,17 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "span", "spans", "mark_step", "current_step", "dump", "reset",
            "get_registry", "Counter", "Gauge", "Histogram", "Registry",
            "SpanRecord", "DEFAULT_BUCKETS", "log_buckets", "nbytes_of",
-           "record_collective_overlap", "exporters", "tracer"]
+           "record_collective_overlap", "exporters", "tracer", "perf",
+           "flight_recorder"]
 
 _default_registry = Registry()
 _dump_interval = 0
 _atexit_registered = False
+
+# the ISSUE 8 layer imports AFTER the default registry exists (both
+# resolve it lazily, but the ordering keeps partial-init states out of
+# any interpreter that imports the submodules directly)
+from . import flight_recorder, perf  # noqa: E402
 
 
 def get_registry() -> Registry:
@@ -116,6 +130,8 @@ def record_collective_overlap(exposed_seconds: float, hidden_seconds: float,
 def _on_step(step: int) -> None:
     if _dump_interval > 0 and step % _dump_interval == 0:
         dump()
+    # one attribute read when the flight recorder is not installed
+    flight_recorder._on_step(step)
 
 
 def enable(dump_interval: Optional[int] = None) -> None:
@@ -162,10 +178,14 @@ def _configure_from_env() -> None:
     global _dump_interval, _atexit_registered
     env = os.environ
     want_dump = env.get("MXTPU_TELEMETRY_DUMP", "0") == "1"
-    want_on = env.get("MXTPU_TELEMETRY", "0") == "1" or want_dump
+    flight_dir = env.get("MXTPU_FLIGHT_DIR", "")
+    want_on = env.get("MXTPU_TELEMETRY", "0") == "1" or want_dump \
+        or bool(flight_dir)
     interval = int(env.get("MXTPU_TELEMETRY_INTERVAL", "0") or 0)
     if want_on:
         enable(dump_interval=interval)
+    if flight_dir:
+        flight_recorder.install(flight_dir)
     if want_dump and not _atexit_registered:
         _atexit_registered = True
         atexit.register(_atexit_dump)

@@ -41,8 +41,17 @@ def _prom_name(name: str) -> str:
     return name
 
 
+def _prom_escape(value) -> str:
+    """Label-value escaping per the Prometheus text exposition grammar:
+    backslash, double-quote and newline must be escaped or the line is
+    unparseable (a value like ``he said "hi"\n`` would truncate the
+    sample and corrupt every line after it)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: Dict[str, str], extra: Optional[str] = None) -> str:
-    parts = [f'{_NAME_RE.sub("_", k)}="{str(v)}"'
+    parts = [f'{_NAME_RE.sub("_", k)}="{_prom_escape(v)}"'
              for k, v in sorted(labels.items())]
     if extra:
         parts.append(extra)
@@ -62,8 +71,19 @@ def _fmt(v: float) -> str:
 def prometheus_text(registry: Registry) -> str:
     lines: List[str] = []
     seen_type = set()
+    seen_series = set()
     for m in registry.metrics():
         pname = _prom_name(m.name)
+        # duplicate-timeseries guard: two distinct registry names can
+        # sanitize to the same exposition name+labels (``a/b`` and
+        # ``a_b``) — a second sample for the same series is invalid
+        # exposition, so it is dropped with an explanatory comment
+        series = (pname, tuple(sorted(m.labels.items())))
+        if series in seen_series:
+            lines.append(f"# duplicate timeseries dropped: {m.name!r} "
+                         f"collides with an earlier metric as {pname}")
+            continue
+        seen_series.add(series)
         if pname not in seen_type:
             seen_type.add(pname)
             if pname != m.name:

@@ -1,0 +1,155 @@
+"""telemetry.flight_recorder: ring recording through the mark_step
+chain, bundle contents, exception-hook dumping, and clean uninstall
+(ISSUE 8 tentpole; the SIGTERM path is exercised end-to-end by
+ci/flight_recorder_smoke.py in a real subprocess)."""
+import json
+import signal
+import sys
+import threading
+
+import pytest
+
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.telemetry import flight_recorder as fr
+
+
+@pytest.fixture
+def tel(tmp_path):
+    telemetry.enable()
+    telemetry.get_registry().clear()
+    telemetry.tracer.clear()
+    fr.uninstall()
+    yield telemetry
+    fr.uninstall()
+    telemetry.get_registry().clear()
+    telemetry.tracer.clear()
+    telemetry.disable()
+
+
+def _three_steps(tel):
+    for i in range(3):
+        tel.mark_step()
+        with tel.span("loop/step"):
+            tel.counter("work_total").inc()
+            tel.histogram("work_seconds").observe(0.01 * (i + 1))
+
+
+def test_not_installed_is_inert(tel):
+    assert not fr.installed()
+    _three_steps(tel)
+    assert fr.records() == []
+    assert fr.dump("manual") is None
+    assert fr.record_step(1) is None
+
+
+def test_records_ride_the_mark_step_chain(tel, tmp_path):
+    fr.install(str(tmp_path), steps=8)
+    assert fr.installed()
+    _three_steps(tel)
+    recs = fr.records()
+    # steps 1 and 2 are complete (recorded when the NEXT step opened);
+    # step 3 is in-flight and only lands at dump time
+    assert [r["step"] for r in recs] == [1, 2]
+    assert {s["name"] for s in recs[0]["spans"]} == {"loop/step"}
+    assert recs[0]["metrics"]["work_total"] == 1.0
+    assert recs[1]["deltas"]["work_total"] == 1.0  # per-step delta
+    assert recs[1]["metrics"]["work_seconds"]["count"] == 2
+
+
+def test_ring_keeps_only_last_n(tel, tmp_path):
+    fr.install(str(tmp_path), steps=2)
+    for i in range(6):
+        tel.mark_step()
+        with tel.span("s"):
+            pass
+    assert [r["step"] for r in fr.records()] == [4, 5]
+
+
+def test_dump_bundle_contents(tel, tmp_path):
+    fr.install(str(tmp_path))
+    _three_steps(tel)
+    paths = fr.dump("manual")
+    with open(paths["jsonl"]) as f:
+        lines = [json.loads(l) for l in f]
+    meta = lines[0]["flight_meta"]
+    assert meta["reason"] == "manual" and meta["step"] == 3
+    assert meta["records"] == len(lines) - 1
+    # the dump appended the in-flight step: its spans and metric
+    # snapshot are present even though no step 4 ever opened
+    last = lines[-1]
+    assert last["step"] == 3
+    assert {s["name"] for s in last["spans"]} == {"loop/step"}
+    assert last["metrics"]["work_total"] == 3.0
+    trace = json.load(open(paths["trace"]))
+    assert any(e["name"] == "loop/step" for e in trace["traceEvents"])
+
+
+def test_dump_respects_explicit_dirpath(tel, tmp_path):
+    fr.install(str(tmp_path / "a"))
+    tel.mark_step()
+    paths = fr.dump("manual", dirpath=str(tmp_path / "b"))
+    assert "/b/" in paths["jsonl"].replace("\\", "/")
+
+
+def test_excepthook_dumps_once_and_chains(tel, tmp_path):
+    fr.install(str(tmp_path))
+    tel.mark_step()
+    with tel.span("dying"):
+        pass
+    seen = []
+    prev_hooks = []
+
+    def fake_prev(exc_type, exc, tb):
+        seen.append(exc_type)
+
+    # simulate the interpreter calling the installed hook
+    fr._prev_excepthook, real_prev = fake_prev, fr._prev_excepthook
+    prev_hooks.append(real_prev)
+    try:
+        sys.excepthook(ValueError, ValueError("boom"), None)
+        sys.excepthook(ValueError, ValueError("again"), None)
+    finally:
+        fr._prev_excepthook = prev_hooks[0]
+    assert seen == [ValueError, ValueError]  # always chained
+    with open(str(tmp_path / "flight.jsonl")) as f:
+        meta = json.loads(f.readline())["flight_meta"]
+    assert meta["reason"] == "exception:ValueError"  # first death wins
+
+
+def test_install_idempotent_and_uninstall_restores(tel, tmp_path):
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_hook = sys.excepthook
+    fr.install(str(tmp_path), steps=4)
+    fr.install(str(tmp_path / "other"))  # idempotent: only _dir updates
+    assert fr._ring.maxlen == 4
+    assert signal.getsignal(signal.SIGTERM) is fr._signal_handler
+    assert sys.excepthook is fr._excepthook
+    fr.uninstall()
+    assert not fr.installed()
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+    assert sys.excepthook is prev_hook
+    fr.uninstall()  # idempotent too
+
+
+def test_ring_size_from_env(tel, tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_STEPS", "3")
+    fr.install(str(tmp_path))
+    assert fr._ring.maxlen == 3
+
+
+def test_install_off_main_thread_skips_signal_hooks(tel, tmp_path):
+    prev_term = signal.getsignal(signal.SIGTERM)
+    err = []
+
+    def worker():
+        try:
+            fr.install(str(tmp_path))
+        except Exception as e:  # pragma: no cover
+            err.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert not err
+    assert fr.installed()  # ring + excepthook still active
+    assert signal.getsignal(signal.SIGTERM) is prev_term

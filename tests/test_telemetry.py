@@ -336,3 +336,114 @@ def test_nbytes_of_uses_aval_metadata_only(tel):
     assert tel.nbytes_of(x) == 4 * 8 * 4
     assert tel.nbytes_of(NDArray(jnp.ones((2,), jnp.bfloat16))._data) == 4
     assert tel.nbytes_of(object()) == 0
+
+
+# --------------------------------------------------------------------- #
+# exporter label hygiene (ISSUE 8 satellites)
+# --------------------------------------------------------------------- #
+def test_prometheus_label_values_are_escaped(tel):
+    tel.counter("esc_total",
+                labels={"path": 'C:\\tmp\\"x"\nnext'}).inc()
+    text = exporters.prometheus_text(tel.get_registry())
+    # backslash → \\, quote → \", newline → \n; the line stays one line
+    assert 'path="C:\\\\tmp\\\\\\"x\\"\\nnext"' in text
+    for line in text.splitlines():
+        if line.startswith("esc_total"):
+            assert line.endswith(" 1.0")
+            break
+    else:
+        raise AssertionError(f"no esc_total sample line in:\n{text}")
+
+
+def test_prometheus_duplicate_timeseries_dropped(tel):
+    # two distinct registry names sanitize to the SAME exposition name:
+    # the second sample would be invalid exposition and must be dropped
+    tel.gauge("a/b").set(1.0)
+    tel.gauge("a_b").set(2.0)
+    text = exporters.prometheus_text(tel.get_registry())
+    assert text.count("\na_b ") + text.count("a_b ") >= 1
+    samples = [l for l in text.splitlines()
+               if l.startswith("a_b ") or l.startswith("a_b{")]
+    assert len(samples) == 1, f"duplicate series survived: {samples}"
+    assert "# duplicate timeseries dropped" in text
+    # same-name different-labels is NOT a duplicate
+    tel.gauge("c", labels={"k": "1"}).set(1.0)
+    tel.gauge("c", labels={"k": "2"}).set(2.0)
+    text = exporters.prometheus_text(tel.get_registry())
+    assert 'c{k="1"} 1.0' in text and 'c{k="2"} 2.0' in text
+
+
+# --------------------------------------------------------------------- #
+# histogram edge cases (ISSUE 8 satellites)
+# --------------------------------------------------------------------- #
+def test_histogram_zero_and_negative_observations(tel):
+    h = tel.histogram("edge_s", buckets=[0.1, 1.0])
+    h.observe(0.0)
+    h.observe(-3.0)
+    assert h.count == 2
+    assert h.sum == pytest.approx(-3.0)
+    assert h.bucket_counts()[0] == 2  # at/below zero land in bucket 0
+    p = h.percentile(0.99)
+    assert not math.isnan(p)
+    assert -3.0 <= p <= 0.1  # clamped to the observed range
+
+
+def test_histogram_single_sample_percentiles_collapse(tel):
+    h = tel.histogram("one_s")
+    h.observe(0.42)
+    p = h.percentiles()
+    # with one sample every percentile is that sample (clamped to the
+    # observed min == max), not a bucket-edge interpolation artifact
+    assert p["p50"] == pytest.approx(0.42)
+    assert p["p95"] == pytest.approx(0.42)
+    assert p["p99"] == pytest.approx(0.42)
+
+
+def test_histogram_cross_thread_observations(tel):
+    import threading
+
+    h = tel.histogram("mt_s", buckets=[0.5])
+    n, per = 8, 500
+
+    def work():
+        for _ in range(per):
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n * per
+    assert h.sum == pytest.approx(0.25 * n * per)
+    assert h.bucket_counts()[0] == n * per
+
+
+# --------------------------------------------------------------------- #
+# the ISSUE 8 layer rides the near-zero disabled path
+# --------------------------------------------------------------------- #
+def test_perf_layer_disabled_overhead_budget():
+    import time as _t
+
+    from incubator_mxnet_tpu.telemetry import flight_recorder, perf
+
+    telemetry.disable()
+    # earlier telemetry-enabled tests may have captured programs into
+    # the module-global table; the invariant here is that the DISABLED
+    # path adds nothing, not that the table is empty
+    before = dict(perf.programs())
+    assert perf.capture("off_prog", None) is None
+    assert perf.capture_compiled("off_prog", None) is None
+    assert perf.sample_device_memory() == {}
+    assert not perf.start_poller()
+    n = 20000
+    t0 = _t.perf_counter()
+    for i in range(n):
+        perf.note_timing("off_prog", 0.1)
+        flight_recorder._on_step(i)
+    per_call = (_t.perf_counter() - t0) / (2 * n)
+    # generous CI bound: each disabled call is one flag/attribute read,
+    # microseconds would already mean a broken fast path
+    assert per_call < 5e-6, f"disabled path costs {per_call * 1e9:.0f} ns/call"
+    assert perf.programs() == before
+    assert not flight_recorder.installed()

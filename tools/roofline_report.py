@@ -1,0 +1,222 @@
+"""Roofline/MFU attribution report over the repo's compiled programs.
+
+Builds, at smoke scale, the four headline program families and prints
+one table row per program from `telemetry.perf.roofline_table()`:
+
+* ``trainer_full_step``               — the monolithic one-program step;
+* ``trainer_full_step_zero_bucketed`` — the ZeRO-1 explicit tier with
+  backward-overlapped bucketed gradient sync (data-axis mesh);
+* ``decode_float`` / ``decode_int8``  — `lm_generate`'s bf16 and
+  int8 weight-quantized decode programs (the int8 row must move FEWER
+  HBM bytes — the whole point of the quantized path).
+
+Columns: compile-time flops / HBM bytes / arithmetic intensity /
+bound-by (roofline ridge classification), and the achieved MFU / HBM
+GB/s / roofline fraction from a short measured phase (value-fetched
+walls — a report tool may sync; hot-path instrumentation never does).
+
+Decode caveat (stated in telemetry.perf too): XLA's cost analysis
+models a scan body as executing once, so decode rows compare to each
+other exactly (the int8-vs-float byte ratio) but not to trainer rows.
+
+Usage:  python tools/roofline_report.py [--json] [--devices N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows as one JSON array instead of a table")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="virtual host devices for the ZeRO data mesh "
+                         "(default 2)")
+    return ap.parse_args(argv)
+
+
+def _force_devices(n: int) -> None:
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "jax" in sys.modules:  # pragma: no cover — direct script use only
+        raise SystemExit("roofline_report must set XLA flags before jax "
+                         "imports; run it as a standalone script")
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " "
+                               + flag).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _train_programs(n_devices: int):
+    """Build + time the monolithic and ZeRO-bucketed trainer steps."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import autograd, telemetry
+    from incubator_mxnet_tpu.gluon import Trainer
+    from incubator_mxnet_tpu.gluon.block import HybridBlock
+    from incubator_mxnet_tpu.gluon.utils import shard_batch
+    from incubator_mxnet_tpu.models import bert
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.parallel import create_mesh
+    from incubator_mxnet_tpu.parallel.sharding import shard_params
+
+    V, D, DFF, L, H, B, T = 64, 32, 64, 2, 4, 2 * n_devices, 16
+
+    class WithLoss(HybridBlock):
+        def __init__(self, net_, **kw):
+            super().__init__(**kw)
+            self.net = net_
+
+        def forward(self, tokens, labels):
+            mlm_logits, _nsp = self.net(tokens)
+            logp = mx.nd.log_softmax(mlm_logits.astype("float32"))
+            return -(mx.nd.pick(logp, labels).mean())
+
+    def run(mesh, **tr_kw):
+        mx.random.seed(0)
+        net = bert.BERTForPretraining(vocab_size=V, units=D, hidden_size=DFF,
+                                      num_layers=L, num_heads=H, dropout=0.0)
+        net.initialize()
+        net(NDArray(jnp.ones((B, T), jnp.int32)))
+        if mesh is not None:
+            shard_params(net, mesh, warn=False)
+        model = WithLoss(net)
+        model.hybridize()
+        trainer = Trainer(model.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9},
+                          mesh=mesh, **tr_kw)
+        key = jax.random.PRNGKey(7)
+        tok = jax.random.randint(key, (B, T), 0, V, dtype=jnp.int32)
+        lab = jax.random.randint(jax.random.fold_in(key, 1), (B, T), 0, V,
+                                 dtype=jnp.int32)
+        if mesh is not None:
+            tokens, labels = shard_batch(tok, mesh), shard_batch(lab, mesh)
+        else:
+            tokens, labels = NDArray(tok), NDArray(lab)
+        loss = None
+        for i in range(3):
+            t0 = time.perf_counter()
+            with autograd.record():
+                loss = model(tokens, labels)
+            loss.backward()
+            trainer.step(1)
+            trainer.flush()
+            float(loss.asnumpy())  # value fetch: end-to-end wall
+            if i:  # skip the compile step
+                telemetry.perf.note_timing(trainer._perf_program,
+                                           time.perf_counter() - t0)
+        return trainer._perf_program
+
+    names = [run(mesh=None, zero_stage=0)]
+    mesh = create_mesh(jax.devices()[:n_devices], data=n_devices)
+    # tiny bucket cap → the backward-overlapped BUCKETED tier engages
+    names.append(run(mesh=mesh, zero_stage=1, zero_overlap=True,
+                     zero_bucket_mb=0.05))
+    return names
+
+
+def _decode_programs():
+    """Build + time the float and int8 weight-quantized decode programs."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    V, C, DFF, L, H, MAXLEN = 97, 32, 96, 2, 4, 64
+    B, P, N = 2, 5, 16
+
+    mx.random.seed(0)
+    net = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
+                        num_heads=H, max_len=MAXLEN, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((1, 4), jnp.int32)))
+    net.cast("bfloat16")
+    prompt = onp.array(
+        jax.random.randint(jax.random.PRNGKey(3), (B, P), 0, V),
+        dtype="int32")
+
+    names = []
+    for quant in (False, True):
+        if quant:
+            net.quantize_for_decode(act_quant="none")
+        for i in range(2):
+            t0 = time.perf_counter()
+            out = net.generate(prompt, N)
+            out.block_until_ready()
+            if i:  # second call: compiled program, end-to-end wall
+                name = f"decode_{'int8' if quant else 'float'}"
+                telemetry.perf.note_timing(name, time.perf_counter() - t0)
+        names.append(f"decode_{'int8' if quant else 'float'}")
+    return names
+
+
+_COLS = [("program", 34, "s"), ("flops", 12, "g"), ("hbm_bytes", 12, "g"),
+         ("intensity", 10, "v"), ("bound_by", 8, "s"), ("mfu", 10, "v"),
+         ("hbm_gbps", 10, "v"), ("roofline_fraction", 10, "v")]
+
+
+def _fmt_cell(v, kind):
+    if v is None:
+        return "-"
+    if kind == "s":
+        return str(v)
+    if kind == "g":
+        return f"{v:.4g}"
+    return f"{v:.4f}"
+
+
+def _print_table(rows):
+    head = "  ".join(f"{name:<{w}}" for name, w, _ in _COLS)
+    print(head)
+    print("-" * len(head))
+    for r in rows:
+        print("  ".join(f"{_fmt_cell(r.get(name), kind):<{w}}"
+                        for name, w, kind in _COLS))
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    _force_devices(max(2, args.devices))
+
+    from incubator_mxnet_tpu import telemetry
+
+    telemetry.enable()
+    want = _train_programs(max(2, args.devices)) + _decode_programs()
+
+    rows = telemetry.perf.roofline_table()
+    have = {r["program"] for r in rows}
+    missing = [n for n in want if n not in have]
+    assert not missing, f"programs not captured: {missing} (have {have})"
+
+    by = {r["program"]: r for r in rows}
+    f_b = by["decode_float"]["hbm_bytes"]
+    i_b = by["decode_int8"]["hbm_bytes"]
+    assert i_b < f_b, \
+        f"int8 decode moves {i_b} HBM bytes, not fewer than float {f_b}"
+
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        _print_table(rows)
+        print(f"\nint8 decode HBM bytes / float: {i_b / f_b:.3f}x "
+              f"({len(rows)} programs captured)")
+    return rows
+
+
+if __name__ == "__main__":
+    main()
