@@ -16,6 +16,7 @@ Backward through a hybridized block records ONE tape node whose vjp is
 from __future__ import annotations
 
 import contextlib
+import os
 import re
 import threading
 from collections import OrderedDict
@@ -33,6 +34,30 @@ from ..ndarray.ndarray import NDArray, raw, wrap
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope", "functionalize"]
+
+# per-block LRU caps for the lazy-path aval-spec cache (one entry per
+# distinct input signature) and the chained-composition cache (one
+# _ChainedOp — holding four jitted programs — per upstream/treedef
+# combination).  Unbounded, a shape-churning workload leaks specs and
+# compiled programs for the process lifetime (ADVICE #3 / TPU010).
+_AVAL_CACHE_CAP = int(os.environ.get("MXTPU_BLOCK_AVAL_CACHE", "64"))
+_CHAIN_CACHE_CAP = int(os.environ.get("MXTPU_BLOCK_CHAIN_CACHE", "16"))
+
+
+def _lru_hit(cache: "OrderedDict", key):
+    """cache[key] refreshing recency, or None."""
+    val = cache.get(key)
+    if val is not None:
+        cache.move_to_end(key)
+    return val
+
+
+def _lru_store(cache: "OrderedDict", key, val, cap: int):
+    """Insert and evict least-recently-used entries beyond `cap`."""
+    cache[key] = val
+    while len(cache) > cap:
+        cache.popitem(last=False)
+    return val
 
 
 class _BlockScope(threading.local):
@@ -499,7 +524,7 @@ class _ChainedOp:
         self._cached_param_order = (comb_tr, comb_aux)
         self._cache_version = (up_block._cache_version,
                                down_block._cache_version)
-        self._aval_cache: Dict = {}
+        self._aval_cache: "OrderedDict" = OrderedDict()
         n_up_tr, n_up_aux = len(up_tr), len(up_aux)
         up_fn, down_fn = up_block._cached_fn, down_block._cached_fn
         # deterministic per-composition-depth RNG salt: nested chains
@@ -579,9 +604,10 @@ class HybridBlock(Block):
         self._jit_kwargs: Dict[str, Any] = {}
         self._cached_fn = None
         self._cached_param_order: Optional[List[Parameter]] = None
-        self._aval_cache: Dict = {}
+        self._aval_cache: "OrderedDict" = OrderedDict()
         self._cache_version = 0  # bumped on every _build_cache (Trainer key)
-        self._chain_cache: Dict = {}  # _ChainedOp compositions by key
+        # _ChainedOp compositions by key
+        self._chain_cache: "OrderedDict" = OrderedDict()
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
                   static_shape: bool = False, remat_backward: bool = False,
@@ -619,8 +645,8 @@ class HybridBlock(Block):
         single reset used by hybridize/cast and structural rewrites
         (e.g. contrib.quantization.quantize_net)."""
         self._cached_fn = None
-        self._aval_cache = {}
-        self._chain_cache = {}
+        self._aval_cache = OrderedDict()
+        self._chain_cache = OrderedDict()
         self._aux_cell_avals = None
         self._cache_version += 1
 
@@ -650,7 +676,7 @@ class HybridBlock(Block):
     # -- the CachedOp equivalence ---------------------------------------- #
     def _build_cache(self):
         self._cache_version += 1
-        self._aval_cache = {}
+        self._aval_cache = OrderedDict()
         params = self.collect_params()
         trainable = [p for p in params.values() if p.grad_req != "null" and p._data_nd is not None]
         aux = [p for p in params.values() if p.grad_req == "null" and p._data_nd is not None]
@@ -720,7 +746,7 @@ class HybridBlock(Block):
         # access instead forces the staged fwd/bwd jits.
         sig = (training, arg_tree,
                tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
-        spec = self._aval_cache.get(sig)
+        spec = _lru_hit(self._aval_cache, sig)
         if spec is None:
             import functools
 
@@ -731,7 +757,7 @@ class HybridBlock(Block):
                 rng, rng_ctr, *input_raws)
             leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
             spec = (treedef, leaves_avals)
-            self._aval_cache[sig] = spec
+            _lru_store(self._aval_cache, sig, spec, _AVAL_CACHE_CAP)
         treedef, out_avals = spec
 
         pending = _PendingStep(self, training, arg_tree, train_raws, aux_raws,
@@ -830,11 +856,11 @@ class HybridBlock(Block):
         up_block = pend.block
         key = ("chain", id(up_block), up_block._cache_version,
                self._cache_version, tuple(lazy_map), pend.arg_tree, arg_tree)
-        chained = self._chain_cache.get(key)
+        chained = _lru_hit(self._chain_cache, key)
         if chained is None:
             chained = _ChainedOp(up_block, self, lazy_map,
                                  len(pend.input_raws))
-            self._chain_cache[key] = chained
+            _lru_store(self._chain_cache, key, chained, _CHAIN_CACHE_CAP)
 
         comb_tr, comb_aux = chained._cached_param_order
         up_tr, up_aux = up_block._cached_param_order
@@ -857,7 +883,7 @@ class HybridBlock(Block):
 
         sig = (key, training,
                tuple((tuple(r.shape), str(r.dtype)) for r in input_raws))
-        spec = self._aval_cache.get(sig)
+        spec = _lru_hit(self._aval_cache, sig)
         if spec is None:
             import functools
 
@@ -870,7 +896,7 @@ class HybridBlock(Block):
             d_leaves, d_treedef = jax.tree_util.tree_flatten(down_shape)
             leaves_avals, treedef = jax.tree_util.tree_flatten(out_shape)
             spec = (treedef, leaves_avals, d_treedef, len(d_leaves))
-            self._aval_cache[sig] = spec
+            _lru_store(self._aval_cache, sig, spec, _AVAL_CACHE_CAP)
         treedef, out_avals, down_treedef, n_down = spec
         if len(out_avals) - n_down != len(pend.out_cells):
             return _CHAIN_MISS  # upstream output arity changed underneath

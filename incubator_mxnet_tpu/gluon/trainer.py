@@ -1050,6 +1050,7 @@ class Trainer:
                                             opt.wd, opt.rescale_grad, keys)
         for i, nw, ns in zip(idxs, new_w, new_s):
             self._params[i]._data_nd._data = nw
+            # tpulint: disable-next=TPU010 -- keyed by parameter index: bounded by the model's parameter count, not by shapes/configs
             self._states[i] = ns
         # this path always materializes grads (backward wrote them), so
         # run-ahead always holds model-sized buffers: always throttle

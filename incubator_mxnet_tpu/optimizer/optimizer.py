@@ -103,6 +103,7 @@ class Optimizer:
 
     def _update_count(self, index):
         if index not in self._index_update_count:
+            # tpulint: disable-next=TPU010 -- keyed by parameter index: bounded by the model's parameter count, not by shapes/configs
             self._index_update_count[index] = self.begin_num_update
         self._index_update_count[index] += 1
         self.num_update = max(self._index_update_count[index], self.num_update)
@@ -161,6 +162,7 @@ class Optimizer:
         if fn is None:
             target = self.pure_update_multi_precision if mp else self.pure_update
             fn = jax.jit(target)
+            # tpulint: disable-next=TPU010 -- keyed by the `mp` bool: at most two entries ever
             self._jit_cache[mp] = fn
         return fn
 

@@ -264,6 +264,7 @@ def _1f1b_device(stage_fn, loss_fn, params, xm, targets, axis_name,
         y_t, pull_t = jax.vjp(stage_fn, params,
                               cast_to(jnp.zeros(mb_shape, dt), act_vma))
         new_vma = act_vma | _vma(y_t)
+        # tpulint: disable-next=TPU004 -- vma sets are trace-time host metadata (axis-name frozensets), not tracer values
         if new_vma == act_vma:
             break
         act_vma = new_vma

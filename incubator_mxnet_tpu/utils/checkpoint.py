@@ -36,6 +36,9 @@ class CheckpointManager:
         os.makedirs(directory, exist_ok=True)
         self._queue: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
+        # guards _error: written by the worker thread, read/cleared by
+        # callers on the next save()/wait()/close()
+        self._err_lock = threading.Lock()
         self._error = None
 
     # -- identity ------------------------------------------------------- #
@@ -112,7 +115,8 @@ class CheckpointManager:
             try:
                 self._write(*item)
             except Exception as e:  # surfaced on the next save()/wait()
-                self._error = e
+                with self._err_lock:
+                    self._error = e
             finally:
                 self._queue.task_done()
 
@@ -152,14 +156,36 @@ class CheckpointManager:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def wait(self):
-        """Drain pending async writes (call before exiting)."""
+        """Drain pending async writes (the worker stays up for more
+        saves — use close() at end of life)."""
         if self._worker is not None and self._worker.is_alive():
             self._queue.join()
         self._raise_pending_error()
 
+    def close(self, timeout: Optional[float] = None):
+        """Flush pending saves, then stop and join the worker thread.
+
+        Without this the daemon worker is never joined: interpreter
+        exit could tear it down mid-write, silently dropping queued
+        checkpoints.  Idempotent; save() after close() restarts the
+        worker."""
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(None)          # stop sentinel — see _drain
+            self._worker.join(timeout)
+        self._worker = None
+        self._raise_pending_error()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
     def _raise_pending_error(self):
-        if self._error is not None:
+        with self._err_lock:
             e, self._error = self._error, None
+        if e is not None:
             raise e
 
     # -- restore -------------------------------------------------------- #

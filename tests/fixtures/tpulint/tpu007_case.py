@@ -1,0 +1,38 @@
+"""TPU007 fixture: collective axis not bound by the reaching shard_map mesh."""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def bad_step(x):
+    return jax.lax.psum(x, "model")      # POSITIVE: mesh binds only data
+
+
+def good_step(x):
+    return jax.lax.psum(x, "data")       # negative: bound axis
+
+
+def suppressed_step(x):
+    return jax.lax.psum(x, "pipe")  # tpulint: disable=TPU007 -- caller rebinds pipe at runtime
+
+
+def unknown_mesh_step(x):
+    return jax.lax.psum(x, "rows")       # negative: mesh unresolvable below
+
+
+def make_steps(devs):
+    mesh = Mesh(devs, ("data",))
+    f = shard_map(bad_step, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"))
+    g = shard_map(good_step, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"))
+    h = shard_map(suppressed_step, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=P("data"))
+    return f, g, h
+
+
+def make_opaque(mesh):
+    # mesh arrives as a parameter: the bound axis set is unknowable, so
+    # TPU007 must poison to silent rather than guess
+    return shard_map(unknown_mesh_step, mesh=mesh, in_specs=(P("rows"),),
+                     out_specs=P("rows"))

@@ -1,0 +1,38 @@
+"""TPU008 fixture: closure capture of device arrays at compile boundaries."""
+import jax
+import jax.numpy as jnp
+
+
+def make_bad_step(n):
+    table = jnp.arange(n)          # device array in the builder
+
+    @jax.jit
+    def step(x):                   # POSITIVE: `table` is constant-folded
+        return x + table
+    return step
+
+
+def make_good_step(n):
+    @jax.jit
+    def step(x, table):            # negative: the array is an argument
+        return x + table
+    return step
+
+
+def make_scan(xs):
+    acc0 = jnp.zeros(())
+    peak = jnp.max(xs)
+
+    def body(c, x):                # negative: scan body shares the outer
+        return c + x + peak, c     # trace — closing over values is normal
+    return jax.lax.scan(body, acc0, xs)
+
+
+def make_suppressed(n):
+    scale = jnp.float32(n)
+
+    @jax.jit
+    # tpulint: disable-next=TPU008 -- tiny scalar: folding it is deliberate
+    def step(x):
+        return x * scale
+    return step

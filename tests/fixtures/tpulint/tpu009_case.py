@@ -1,0 +1,36 @@
+"""TPU009 fixture: donated buffer referenced after the donating call."""
+import jax
+
+
+def _update(s, x):
+    return s + x
+
+
+def bad_use(state, batch):
+    step = jax.jit(_update, donate_argnums=(0,))
+    new = step(state, batch)
+    return state.sum(), new        # POSITIVE: state's buffer was donated
+
+
+def good_use(state, batch):
+    step = jax.jit(_update, donate_argnums=(0,))
+    new = step(state, batch)
+    return new.sum()               # negative: reads the fresh result
+
+
+def metadata_use(state, batch):
+    step = jax.jit(_update, donate_argnums=(0,))
+    new = step(state, batch)
+    return state.shape, new        # negative: aval metadata survives donation
+
+
+def rebound_use(state, batch):
+    step = jax.jit(_update, donate_argnums=(0,))
+    state = step(state, batch)
+    return state.sum()             # negative: rebound to the fresh buffer
+
+
+def suppressed_use(state, batch):
+    step = jax.jit(_update, donate_argnums=(0,))
+    new = step(state, batch)
+    return state.sum(), new  # tpulint: disable=TPU009 -- CPU backend: donation is a no-op here

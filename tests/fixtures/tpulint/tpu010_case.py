@@ -1,0 +1,56 @@
+"""TPU010 fixture: unbounded compile/program caches in trace-adjacent code."""
+import jax
+
+
+class BadProgramCache:
+    def __init__(self):
+        self._programs = {}
+
+    def get(self, fn, shape):
+        key = (fn.__name__, shape)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = jax.jit(fn)
+            self._programs[key] = prog   # POSITIVE: one program per shape
+        return prog
+
+
+class CappedProgramCache:
+    def __init__(self):
+        self._programs = {}
+
+    def get(self, fn, shape):
+        key = (fn.__name__, shape)
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = jax.jit(fn)
+            self._programs[key] = prog
+            while len(self._programs) > 8:      # negative: LRU-capped
+                self._programs.pop(next(iter(self._programs)))
+        return prog
+
+
+class HostCache:
+    """negative: nothing trace-adjacent ever stores into it."""
+    def __init__(self):
+        self._names = {}
+
+    def intern(self, name):
+        v = self._names.get(name)
+        if v is None:
+            v = name.upper()
+            self._names[name] = v
+        return v
+
+
+class SuppressedCache:
+    def __init__(self):
+        self._by_mode = {}
+
+    def get(self, training, fn):
+        prog = self._by_mode.get(training)
+        if prog is None:
+            prog = jax.jit(fn)
+            # tpulint: disable-next=TPU010 -- keyed by a bool: two entries max
+            self._by_mode[training] = prog
+        return prog

@@ -1,0 +1,71 @@
+"""TPU011 fixture: cross-thread attribute access without a common lock."""
+import threading
+
+
+class BadCounter:
+    def __init__(self):
+        self._count = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        self._count += 1           # POSITIVE: unlocked thread-side write
+
+    def read(self):
+        return self._count         # ...read here with no common lock
+
+    def close(self):
+        self._thread.join()
+
+
+class GoodCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        with self._lock:
+            self._count += 1       # negative: same lock on both sides
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+    def close(self):
+        self._thread.join()
+
+
+class QueueCounter:
+    def __init__(self):
+        import queue
+        self._q = queue.Queue()    # negative: queues synchronize internally
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        self._q.put(1)
+
+    def read(self):
+        return self._q.get()
+
+    def close(self):
+        self._thread.join()
+
+
+class SuppressedCounter:
+    def __init__(self):
+        self._hits = 0
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        # tpulint: disable-next=TPU011 -- monitoring counter: stale reads are fine
+        self._hits += 1
+
+    def peek(self):
+        return self._hits
+
+    def close(self):
+        self._thread.join()

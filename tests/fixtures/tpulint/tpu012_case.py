@@ -1,0 +1,69 @@
+"""TPU012 fixture: background threads never joined or signalled to exit."""
+import queue
+import threading
+
+
+class BadPool:
+    """POSITIVE: close() neither joins nor signals the worker."""
+    def __init__(self):
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._drain)
+        self._worker.start()
+
+    def _drain(self):
+        while True:
+            if self._q.get() is None:
+                return
+
+    def close(self):
+        self._q = queue.Queue()    # drops the backlog, worker keeps running
+
+
+class OrphanPool:
+    """POSITIVE: no close/stop/__del__ path at all."""
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        return None
+
+
+class SentinelPool:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._drain)
+        self._worker.start()
+
+    def _drain(self):
+        while True:
+            if self._q.get() is None:
+                return
+
+    def close(self):
+        self._q.put(None)          # negative: sentinel + join
+        self._worker.join()
+
+
+class EventPool:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run)
+        self._worker.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            return None
+
+    def stop(self):
+        self._stop.set()           # negative: signalled via the Event
+
+
+class SuppressedPool:
+    def __init__(self):
+        # tpulint: disable-next=TPU012 -- heartbeat daemon: process-lifetime by design
+        self._worker = threading.Thread(target=self._beat, daemon=True)
+        self._worker.start()
+
+    def _beat(self):
+        return None

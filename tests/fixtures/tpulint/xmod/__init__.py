@@ -1,0 +1,2 @@
+"""Cross-module fixture package: reachability and shard-axis contexts
+must propagate from driver.py through the import into kernels.py."""

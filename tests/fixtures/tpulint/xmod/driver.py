@@ -1,0 +1,21 @@
+"""Entry points whose jit/shard_map contexts flow into kernels.py."""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .kernels import collective, host_math
+
+
+@jax.jit
+def step(x):
+    return host_math(x)            # makes kernels.host_math trace-reachable
+
+
+def _device_fn(x):
+    return collective(x)           # axis context {data} flows into kernels
+
+
+def make_sharded(devs):
+    mesh = Mesh(devs, ("data",))
+    return shard_map(_device_fn, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P("data"))

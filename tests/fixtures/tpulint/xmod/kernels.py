@@ -1,0 +1,16 @@
+"""Helpers with no jit of their own — hazards only exist because
+driver.py reaches them from a jit / shard_map context."""
+import jax
+import numpy as np
+
+
+def host_math(x):
+    return np.tanh(x)              # TPU001 ONLY via driver.step's jit
+
+
+def collective(x):
+    return jax.lax.psum(x, "model")    # TPU007 ONLY via driver's data-mesh
+
+
+def standalone(x):
+    return np.log(x)               # negative: nothing traced reaches this

@@ -72,6 +72,50 @@ def test_async_save_and_retention(tmp_path):
     assert mgr.latest_step() == 4
 
 
+def test_close_joins_worker_and_flushes(tmp_path):
+    """tpulint TPU012 regression: close() must flush queued saves and
+    JOIN the worker (previously the daemon thread was never joined —
+    interpreter exit could kill it mid-write)."""
+    net, trainer = _make()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    mgr.save(1, net=net, trainer=trainer)
+    worker = mgr._worker
+    assert worker is not None and worker.is_alive()
+    mgr.close()
+    assert not worker.is_alive()          # joined, not abandoned
+    assert mgr._worker is None
+    assert mgr.all_steps() == [1]         # queued write landed before join
+    mgr.close()                           # idempotent
+    # save() after close() restarts the worker transparently
+    mgr.save(2, net=net, trainer=trainer)
+    mgr.close()
+    assert mgr.all_steps() == [1, 2]
+
+
+def test_close_as_context_manager(tmp_path):
+    net, trainer = _make()
+    with CheckpointManager(str(tmp_path), async_save=True) as mgr:
+        mgr.save(1, net=net, trainer=trainer)
+    assert mgr._worker is None
+    assert mgr.all_steps() == [1]
+
+
+def test_worker_error_surfaces_on_close(tmp_path):
+    """tpulint TPU011 regression: the worker's error handoff is now
+    lock-guarded and close()/wait() re-raise the pending exception."""
+    net, trainer = _make()
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    boom = RuntimeError("disk full")
+    with mgr._err_lock:
+        mgr._error = boom                 # as if _drain had failed
+    with pytest.raises(RuntimeError, match="disk full"):
+        mgr.close()
+    # the error is consumed — the manager is usable again
+    mgr.save(1, net=net, trainer=trainer)
+    mgr.close()
+    assert mgr.all_steps() == [1]
+
+
 def test_kill_and_resume_bit_exact(tmp_path):
     """Kill a training process mid-run; autoresume restarts it; the final
     weights equal an uninterrupted run (≤1 step of work lost, replayed)."""

@@ -93,6 +93,47 @@ def test_hybridize_matches_eager():
     assert net(y).shape == (5, 4)
 
 
+def test_aval_cache_is_lru_bounded(monkeypatch):
+    """tpulint TPU010 regression: the per-block aval-spec cache must not
+    grow one entry per distinct input signature forever — it is an LRU
+    capped at _AVAL_CACHE_CAP, evicting oldest-first."""
+    from incubator_mxnet_tpu.gluon import block as block_mod
+
+    monkeypatch.setattr(block_mod, "_AVAL_CACHE_CAP", 3)
+    net = nn.Dense(4, in_units=7)
+    net.initialize(mx.init.One())
+    net.hybridize()
+    for batch in range(1, 7):       # 6 distinct signatures, cap 3
+        x = mx.nd.ones((batch, 7))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        assert len(net._aval_cache) <= 3
+    assert len(net._aval_cache) == 3
+    # the surviving entries are the most recent — a repeat of the LAST
+    # shape hits the cache without growing it
+    before = list(net._aval_cache)
+    with autograd.record():
+        net(mx.nd.ones((6, 7))).sum().backward()
+    assert list(net._aval_cache) == before
+
+
+def test_lru_helpers_evict_oldest_and_refresh_on_hit():
+    from collections import OrderedDict
+
+    from incubator_mxnet_tpu.gluon.block import _lru_hit, _lru_store
+
+    c = OrderedDict()
+    for k in "abcd":
+        _lru_store(c, k, k.upper(), 3)
+    assert list(c) == ["b", "c", "d"]      # "a" evicted at cap 3
+    assert _lru_hit(c, "b") == "B"
+    assert list(c) == ["c", "d", "b"]      # hit refreshes recency
+    _lru_store(c, "e", "E", 3)
+    assert list(c) == ["d", "b", "e"]      # LRU "c" evicted, not "b"
+    assert _lru_hit(c, "zzz") is None
+
+
 def test_hybridize_backward():
     net = nn.Dense(3)
     net.initialize(mx.init.One())

@@ -19,7 +19,18 @@ Pipeline:
    marks every ``raw_fn`` closure as a jit entry point);
 4. BFS over call edges from the seeds → ``trace_reachable`` set, and a
    second BFS from per-step seeds (``Trainer.step``/``Optimizer.update``)
-   → ``perstep_reachable`` set.
+   → ``perstep_reachable`` set;
+5. two more interprocedural passes reuse the same call graph:
+   *shard-axis contexts* (which mesh axis names are bound by every
+   ``shard_map``/``pmap``/``vmap(axis_name=)`` context a function is
+   reachable from — TPU007's ground truth) and *thread reachability*
+   (functions running on a ``threading.Thread`` target, transitively —
+   TPU011/TPU012's ground truth).
+
+The call graph is exposed as :meth:`Project.callees` /
+:meth:`Project.callers` / :meth:`Project.call_sites` so rules can walk
+it interprocedurally (e.g. resolving an ``axis_name`` parameter to the
+string constants its analyzed callers actually pass).
 
 Resolution is deliberately conservative in BOTH directions: bare names
 only resolve within the module (or explicit imports), ``self.m()``
@@ -81,11 +92,33 @@ class FunctionInfo:
     perstep_reachable: bool = False
     is_jit_wrapper: bool = False
     trace_reason: str = ""             # why it entered trace scope (diagnostics)
+    # resolved name of the wrapper that seeded this function (e.g.
+    # "jax.jit", "jax.lax.scan", or a project-local wrapper's full
+    # name).  TPU008 keys off this: only real COMPILE boundaries
+    # (jit/pjit/pallas_call) make closure capture a bug — control-flow
+    # primitives (scan/cond) and shard_map bodies share the outer
+    # trace, where capturing outer tracers is normal JAX.
+    seed_wrapper: Optional[str] = None
+    # -- shard-axis context (TPU007) ------------------------------------
+    # axis names bound by every shard_map/pmap/vmap context this
+    # function is reachable from (None until some context reaches it)
+    shard_axes: Optional[Set[str]] = None
+    # True when at least one reaching context's axes could not be
+    # extracted statically — rules must not flag then
+    shard_axes_unknown: bool = False
+    shard_reason: str = ""
+    # -- thread context (TPU011/TPU012) ---------------------------------
+    thread_entry: bool = False         # literally a Thread(target=...)
+    thread_reachable: bool = False     # entry or called from one
     # params declared static at the jit boundary (static_argnums/
     # static_argnames) — host values by contract, excluded from taint
     static_params: Set[str] = field(default_factory=set)
     # statics this function forwards to jit when IT is a wrapper
     wrapper_statics: Optional[Tuple[Tuple[int, ...], Tuple[str, ...]]] = None
+    # argnums donated when this function RETURNS a donating jit
+    # (`return jax.jit(g, donate_argnums=(0,))`) — TPU009 tracks the
+    # returned callable through local bindings at call sites
+    returns_donating: Optional[Tuple[int, ...]] = None
 
     @property
     def full_name(self) -> str:
@@ -118,6 +151,28 @@ JIT_WRAPPERS = {
     "jax.lax.switch", "jax.lax.fori_loop", "jax.lax.map",
     "jax.lax.associative_scan", "jax.lax.custom_root",
 }
+
+# wrappers that additionally BIND mesh axis names for the wrapped
+# function (collectives inside may name them).  `shard_map` is matched
+# by resolved-name tail as well so project-local compat shims
+# (parallel/compat.py) count — that is the cross-module propagation
+# per-file linting could never see.
+SHARD_WRAPPER_TAILS = {"shard_map", "pmap", "smap"}
+AXIS_BINDING_WRAPPERS = {
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+    "jax.pmap", "jax.vmap",
+}
+
+# collective ops that CONSUME an axis name (TPU007); tail names of
+# jax.lax.* — matched on the resolved dotted path.
+COLLECTIVE_FUNCS = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.psum_scatter", "jax.lax.all_gather", "jax.lax.all_to_all",
+    "jax.lax.axis_index", "jax.lax.axis_size", "jax.lax.ppermute",
+    "jax.lax.pshuffle", "jax.lax.pswapaxes",
+}
+
+THREAD_FACTORIES = {"threading.Thread", "threading.Timer"}
 
 # methods whose bodies run once per training step (host code, but on
 # the step critical path — explicit syncs there serialize the device
@@ -226,7 +281,11 @@ class Project:
             self._index_file(f)
         self._resolve_block_classes()
         self._compute_jit_wrappers()
+        self._build_call_graph()
         self._compute_reachability()
+        self._compute_shard_axes()
+        self._compute_thread_reachable()
+        self._compute_donations()
 
     # -- file discovery --------------------------------------------------- #
     @staticmethod
@@ -384,6 +443,12 @@ class Project:
     @staticmethod
     def _call_arg_names(call: ast.Call) -> List[str]:
         names = [a.id for a in call.args if isinstance(a, ast.Name)]
+        # *args forwarding counts: `_shard_map(*args, **kwargs)` passes
+        # the vararg tuple through — without this, a compat shim like
+        # parallel/compat.shard_map breaks wrapper propagation and every
+        # shard_map body behind it silently drops out of trace scope
+        names += [a.value.id for a in call.args
+                  if isinstance(a, ast.Starred) and isinstance(a.value, ast.Name)]
         names += [kw.value.id for kw in call.keywords
                   if isinstance(kw.value, ast.Name)]
         return names
@@ -469,6 +534,9 @@ class Project:
                     params = {a.arg for a in (fn.node.args.posonlyargs
                                               + fn.node.args.args
                                               + fn.node.args.kwonlyargs)}
+                    for va in (fn.node.args.vararg, fn.node.args.kwarg):
+                        if va is not None:
+                            params.add(va.arg)
                     for call in self._iter_calls(fn):
                         if not self.is_jit_wrapper_call(mod, call):
                             continue
@@ -486,6 +554,7 @@ class Project:
             if d and self.resolve(fn.module, d) in JIT_WRAPPERS:
                 if isinstance(dec, ast.Call):
                     self._apply_statics(fn, *self._extract_statics(dec))
+                fn.seed_wrapper = self.resolve(fn.module, d)
                 return True
             # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
             if isinstance(dec, ast.Call) and d is not None:
@@ -494,6 +563,7 @@ class Project:
                     inner = dotted_name(dec.args[0])
                     if inner and self.resolve(fn.module, inner) in JIT_WRAPPERS:
                         self._apply_statics(fn, *self._extract_statics(dec))
+                        fn.seed_wrapper = self.resolve(fn.module, inner)
                         return True
         return False
 
@@ -536,6 +606,7 @@ class Project:
                         if target is not None and not target.trace_reason:
                             target.trace_reason = (
                                 f"passed to jit wrapper in {caller.qualname}")
+                            target.seed_wrapper = resolved_w
                             self._apply_statics(target, *statics)
                             seeds.append(target)
         return seeds
@@ -555,40 +626,69 @@ class Project:
                     seeds.append(fn)
         return seeds
 
-    def _callees(self, fn: FunctionInfo) -> List[FunctionInfo]:
-        out: List[FunctionInfo] = []
+    def _resolve_call_target(self, fn: FunctionInfo,
+                             d: str) -> Optional[FunctionInfo]:
+        """FunctionInfo a dotted callee name resolves to from inside
+        `fn` (nested def / module def / import / self.method)."""
         mod = fn.module
-        for call in self._iter_calls(fn):
-            d = dotted_name(call.func)
-            if d is None:
-                continue
-            if "." not in d:
-                # bare name: nested def, module-level def, or import
-                target = (mod.functions.get(f"{fn.qualname}.{d}")
-                          or mod.functions.get(d))
-                if target is None:
-                    resolved = self.resolve(mod, d)
-                    if resolved != d:
-                        target = self.lookup_function(resolved)
-                if target is not None:
-                    out.append(target)
-                continue
-            head, _, rest = d.partition(".")
-            if head == "self" and fn.cls is not None and "." not in rest:
-                target = fn.cls.methods.get(rest)
-                if target is None:
-                    for anc in self._class_ancestry(fn.cls):
-                        target = anc.methods.get(rest)
-                        if target is not None:
-                            break
-                if target is not None:
-                    out.append(target)
-                continue
-            resolved = self.resolve(mod, d)
-            target = self.lookup_function(resolved)
-            if target is not None:
-                out.append(target)
-        return out
+        if "." not in d:
+            # bare name: nested def, module-level def, or import
+            target = (mod.functions.get(f"{fn.qualname}.{d}")
+                      or mod.functions.get(d))
+            if target is None:
+                resolved = self.resolve(mod, d)
+                if resolved != d:
+                    target = self.lookup_function(resolved)
+            return target
+        head, _, rest = d.partition(".")
+        if head == "self" and fn.cls is not None and "." not in rest:
+            target = fn.cls.methods.get(rest)
+            if target is None:
+                for anc in self._class_ancestry(fn.cls):
+                    target = anc.methods.get(rest)
+                    if target is not None:
+                        break
+            return target
+        return self.lookup_function(self.resolve(mod, d))
+
+    def _build_call_graph(self):
+        """One resolution pass over every call: forward edges (callees),
+        reverse edges (callers) and the concrete call sites.  Every
+        later pass (reachability, shard axes, threads, TPU007's
+        axis-parameter resolution, TPU011's lock propagation) walks
+        these maps instead of re-resolving."""
+        self._callee_map: Dict[int, List[FunctionInfo]] = {}
+        self._caller_map: Dict[int, List[FunctionInfo]] = {}
+        self._site_map: Dict[int, List[Tuple[FunctionInfo, ast.Call]]] = {}
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                out = self._callee_map.setdefault(id(fn), [])
+                for call in self._iter_calls(fn):
+                    d = dotted_name(call.func)
+                    if d is None:
+                        continue
+                    target = self._resolve_call_target(fn, d)
+                    if target is None:
+                        continue
+                    if target not in out:
+                        out.append(target)
+                    callers = self._caller_map.setdefault(id(target), [])
+                    if fn not in callers:
+                        callers.append(fn)
+                    self._site_map.setdefault(id(target), []).append((fn, call))
+
+    def callees(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        return self._callee_map.get(id(fn), [])
+
+    def callers(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        return self._caller_map.get(id(fn), [])
+
+    def call_sites(self, fn: FunctionInfo) -> List[Tuple["FunctionInfo", ast.Call]]:
+        """(caller, call-node) pairs for every resolved call of `fn`."""
+        return self._site_map.get(id(fn), [])
+
+    def _callees(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        return self._callee_map.get(id(fn), [])
 
     def _compute_reachability(self):
         seeds = self._seed_functions()
@@ -612,6 +712,223 @@ class Project:
                 if not callee.perstep_reachable and not callee.trace_reachable:
                     callee.perstep_reachable = True
                     work.append(callee)
+
+    # -- shard-axis contexts (TPU007) --------------------------------------- #
+    def is_shard_binding_call(self, mod: ModuleInfo, call: ast.Call) -> Optional[str]:
+        """'shard' / 'pmap' / 'vmap' when this call binds mesh axis
+        names for its function argument, else None.  Matched on the
+        resolved tail so project-local shard_map compat shims count."""
+        d = dotted_name(call.func)
+        if d is None:
+            return None
+        resolved = self.resolve(mod, d)
+        tail = resolved.rpartition(".")[2]
+        if resolved in ("jax.pmap", "jax.vmap"):
+            return "pmap" if resolved == "jax.pmap" else "vmap"
+        if resolved in AXIS_BINDING_WRAPPERS or tail in SHARD_WRAPPER_TAILS:
+            return "shard"
+        return None
+
+    def _shard_call_axes(self, caller: FunctionInfo, call: ast.Call,
+                         kind: str) -> Set[str]:
+        """Axis-name string constants a shard-wrapper call site binds.
+
+        For shard_map every string constant in the call is collected
+        (P(...) specs, axis_names=, partial-bound axis kwargs), plus —
+        through one level of local single-assignment resolution — the
+        strings behind spec/mesh variables (`in_specs = (P("data"),)`,
+        `mesh = Mesh(devs, ("data", "model"))`).  Over-collection only
+        widens the bound set (false-negative direction); an EMPTY
+        result marks the context unextractable and disables TPU007
+        along everything it reaches.
+
+        The mesh argument is the gate: a mesh binds EVERY axis of the
+        device grid, not just the ones the in/out specs name, so when
+        the mesh expression doesn't resolve to a visible
+        ``Mesh(..., ("a", "b"))`` construction (it usually arrives as a
+        function parameter), the bound set is unknowable and the whole
+        context poisons to unknown."""
+        if kind in ("pmap", "vmap"):
+            out: Set[str] = set()
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            out.add(sub.value)
+            return out
+        local_assigns: Dict[str, ast.AST] = {}
+        for node in self.iter_own_nodes(caller):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                local_assigns[node.targets[0].id] = node.value
+
+        out = set()
+
+        def collect(node: ast.AST, depth: int):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    out.add(sub.value)
+                elif isinstance(sub, ast.Name) and depth < 2:
+                    v = local_assigns.get(sub.id)
+                    if v is not None and v is not node:
+                        collect(v, depth + 1)
+
+        # locate the mesh expression: kwarg, or shard_map's 2nd
+        # positional; resolve one local-assign hop
+        mesh_expr: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+        if mesh_expr is None and len(call.args) > 1:
+            mesh_expr = call.args[1]
+        if isinstance(mesh_expr, ast.Name):
+            mesh_expr = local_assigns.get(mesh_expr.id, mesh_expr)
+        mesh_visible = mesh_expr is not None and any(
+            isinstance(sub, ast.Call)
+            and (dotted_name(sub.func) or "").rpartition(".")[2]
+            in ("Mesh", "AbstractMesh", "make_mesh")
+            for sub in ast.walk(mesh_expr))
+        if not mesh_visible:
+            return set()       # unknowable axis set → poison to unknown
+
+        collect(call, 0)
+        return out
+
+    def _compute_shard_axes(self):
+        """Seed the functions passed to axis-binding wrappers with the
+        axes their call sites bind, then propagate through the call
+        graph (union at joins — an axis bound by ANY reaching context
+        is never flagged, the conservative direction for TPU007)."""
+        work: List[FunctionInfo] = []
+
+        def merge(fn: FunctionInfo, axes: Set[str], unknown: bool,
+                  reason: str) -> None:
+            changed = False
+            if fn.shard_axes is None:
+                fn.shard_axes = set(axes)
+                fn.shard_reason = reason
+                changed = True
+            elif not axes <= fn.shard_axes:
+                fn.shard_axes |= axes
+                changed = True
+            if unknown and not fn.shard_axes_unknown:
+                fn.shard_axes_unknown = True
+                changed = True
+            if changed:
+                work.append(fn)
+
+        for mod in self.modules.values():
+            for caller in mod.functions.values():
+                local_aliases = None
+                for call in self._iter_calls(caller):
+                    kind = self.is_shard_binding_call(mod, call)
+                    if kind is None:
+                        continue
+                    axes = self._shard_call_axes(caller, call, kind)
+                    if local_aliases is None:
+                        local_aliases = self._local_fn_aliases(caller)
+                    for n in self._candidate_fn_args(caller, call):
+                        n = local_aliases.get(n, n)
+                        target = self._resolve_call_target(caller, n)
+                        if target is not None:
+                            merge(target, axes, not axes,
+                                  f"wrapped by {kind} in {caller.qualname}")
+        while work:
+            fn = work.pop()
+            for callee in self.callees(fn):
+                merge(callee, fn.shard_axes or set(),
+                      fn.shard_axes_unknown,
+                      callee.shard_reason or f"called from {fn.full_name}")
+
+    # -- thread reachability (TPU011/TPU012) -------------------------------- #
+    def thread_target_of(self, fn: FunctionInfo,
+                         call: ast.Call) -> Optional[FunctionInfo]:
+        """The analyzed function a `threading.Thread(target=...)` call
+        names, if this call is a thread construction."""
+        d = dotted_name(call.func)
+        if d is None:
+            return None
+        resolved = self.resolve(fn.module, d)
+        if resolved not in THREAD_FACTORIES:
+            return None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                t = dotted_name(kw.value)
+                if t is None:
+                    return None
+                return self._resolve_call_target(fn, t)
+        return None
+
+    def _compute_thread_reachable(self):
+        work: List[FunctionInfo] = []
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                for call in self._iter_calls(fn):
+                    target = self.thread_target_of(fn, call)
+                    if target is not None and not target.thread_entry:
+                        target.thread_entry = True
+                        work.append(target)
+        for fn in work:
+            fn.thread_reachable = True
+        while work:
+            fn = work.pop()
+            for callee in self.callees(fn):
+                if not callee.thread_reachable:
+                    callee.thread_reachable = True
+                    work.append(callee)
+
+    # -- donation records (TPU009) ------------------------------------------ #
+    def donating_jit_nums(self, mod: ModuleInfo,
+                          node: ast.AST) -> Optional[Tuple[int, ...]]:
+        """Constant donate_argnums of a `jax.jit(...)` expression, or
+        None when `node` is not a donating jit / the nums aren't
+        literal (dynamic donation lists are skipped, conservatively)."""
+        if not isinstance(node, ast.Call):
+            return None
+        d = dotted_name(node.func)
+        if d is None or self.resolve(mod, d) not in JIT_WRAPPERS:
+            return None
+        for kw in node.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                if kw.arg == "donate_argnames":
+                    return None      # name-keyed donation: positions unknown
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)) and all(
+                        isinstance(e, ast.Constant)
+                        and isinstance(e.value, int) for e in v.elts):
+                    return tuple(e.value for e in v.elts)
+                return None
+        return None
+
+    def _compute_donations(self):
+        """Record donation carriers TPU009 tracks interprocedurally:
+        functions whose return value is a donating jit, and class
+        attributes holding one (`self._fn = jax.jit(..., donate_argnums=)`
+        in one method, called from another)."""
+        self.donating_attrs: Dict[Tuple[int, str], Tuple[int, ...]] = {}
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                for node in self.iter_own_nodes(fn):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        vals = node.value.elts if isinstance(
+                            node.value, ast.Tuple) else [node.value]
+                        for i, v in enumerate(vals):
+                            nums = self.donating_jit_nums(mod, v)
+                            if nums is not None and i == 0:
+                                fn.returns_donating = nums
+                    elif isinstance(node, ast.Assign) and fn.cls is not None:
+                        nums = self.donating_jit_nums(mod, node.value)
+                        if nums is None:
+                            continue
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Attribute) \
+                                    and isinstance(tgt.value, ast.Name) \
+                                    and tgt.value.id == "self":
+                                self.donating_attrs[
+                                    (id(fn.cls), tgt.attr)] = nums
 
     # -- public ------------------------------------------------------------ #
     def iter_functions(self):

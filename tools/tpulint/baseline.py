@@ -1,0 +1,195 @@
+"""Findings baseline + fingerprinting + analyzer result cache.
+
+The CI gate (ci/lint.sh) must fail on NEW findings without forcing a
+contributor to fix every pre-existing one in the same change.  The
+mechanism is the ratchet pyflakes/ruff users know as a *baseline*:
+
+  python -m tools.tpulint <paths> --write-baseline   # seed, commit it
+  python -m tools.tpulint <paths> --baseline .tpulint_baseline.json
+                                                     # fail only on new
+
+A finding's **fingerprint** is a sha1 over (rule, path, enclosing
+function, the stripped text of the flagged source line, occurrence
+index) — deliberately NOT the line number, so baselined findings
+survive unrelated edits that shift code up or down.  The occurrence
+index disambiguates identical lines flagged more than once in the
+same function (index is per (rule, path, function, line-text) group,
+in (line, col) order).
+
+The same module hosts the **result cache**: a full project analysis
+parses every file and runs a half-dozen interprocedural fixpoints, so
+repeat CI invocations memoize the *findings* (not ASTs — measured:
+unpickling 122 ASTs is slower than re-parsing them) under
+``.tpulint_cache/``, keyed on every analyzed file's (path, mtime,
+size) plus the lint tool's own sources and the rule selection.  Any
+edit anywhere — target tree or linter — misses the cache.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .analyzer import Finding
+
+BASELINE_VERSION = 1
+CACHE_DIR = ".tpulint_cache"
+
+
+# -- fingerprints --------------------------------------------------------- #
+def _line_text(sources: Dict[str, str], path: str, line: int) -> str:
+    src = sources.get(path)
+    if src is None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            src = ""
+        sources[path] = src
+    lines = src.splitlines()
+    return lines[line - 1].strip() if 0 < line <= len(lines) else ""
+
+
+def fingerprint_findings(findings: Sequence[Finding],
+                         sources: Optional[Dict[str, str]] = None
+                         ) -> List[Tuple[Finding, str]]:
+    """[(finding, fingerprint)] in the input order.
+
+    Stable under line-number shifts: the hash covers rule, path,
+    function, stripped line text, and an occurrence index — never the
+    line number itself.
+    """
+    sources = dict(sources) if sources else {}
+    groups: Dict[Tuple[str, str, str, str], List[Finding]] = {}
+    texts: Dict[int, str] = {}
+    for f in findings:
+        text = _line_text(sources, f.path, f.line)
+        texts[id(f)] = text
+        groups.setdefault((f.code, f.path, f.function, text), []).append(f)
+    index: Dict[int, int] = {}
+    for members in groups.values():
+        for i, f in enumerate(sorted(members,
+                                     key=lambda f: (f.line, f.col))):
+            index[id(f)] = i
+    out: List[Tuple[Finding, str]] = []
+    for f in findings:
+        h = hashlib.sha1("\x00".join(
+            (f.code, f.path, f.function, texts[id(f)],
+             str(index[id(f)]))).encode("utf-8")).hexdigest()
+        out.append((f, h))
+    return out
+
+
+# -- baseline file -------------------------------------------------------- #
+def write_baseline(path: str, findings: Sequence[Finding],
+                   sources: Optional[Dict[str, str]] = None) -> int:
+    """Serialize `findings` as the accepted baseline; returns count."""
+    sources = dict(sources) if sources else {}
+    entries = []
+    for f, fp in fingerprint_findings(findings, sources):
+        entries.append({
+            "rule": f.code,
+            "path": f.path,
+            "function": f.function,
+            "line": f.line,          # informational only — not hashed
+            "line_text": _line_text(sources, f.path, f.line),
+            "fingerprint": fp,
+        })
+    entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "findings": entries},
+                  fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Fingerprint set from a baseline file (raises on bad file)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        blob = json.load(fh)
+    if blob.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {blob.get('version')!r} "
+            f"(this tpulint writes {BASELINE_VERSION}) — regenerate with "
+            f"--write-baseline")
+    return {e["fingerprint"] for e in blob.get("findings", [])}
+
+
+def filter_new(pairs: Iterable[Tuple[Finding, str]],
+               baseline: Set[str]) -> List[Tuple[Finding, str]]:
+    """Drop findings whose fingerprint the baseline already accepts."""
+    return [(f, fp) for f, fp in pairs if fp not in baseline]
+
+
+# -- result cache --------------------------------------------------------- #
+def _tool_files() -> List[str]:
+    """The linter's own sources — part of every cache key, so editing a
+    rule invalidates all cached results."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return [os.path.join(here, f) for f in sorted(os.listdir(here))
+            if f.endswith(".py")]
+
+
+def cache_key(files: Sequence[str], select: Optional[Sequence[str]],
+              ignore: Optional[Sequence[str]], strict: bool) -> Optional[str]:
+    """sha1 over (path, mtime, size) of every analyzed file AND the
+    tool itself, plus the rule selection; None when any stat fails."""
+    h = hashlib.sha1()
+    h.update(f"v{BASELINE_VERSION}|{sorted(select or [])}|"
+             f"{sorted(ignore or [])}|{strict}".encode("utf-8"))
+    for path in list(files) + _tool_files():
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        h.update(f"{path}|{st.st_mtime_ns}|{st.st_size}\n".encode("utf-8"))
+    return h.hexdigest()
+
+
+def cache_load(cache_dir: str, key: Optional[str]) -> Optional[dict]:
+    if key is None:
+        return None
+    try:
+        with open(os.path.join(cache_dir, f"{key}.json"), "r",
+                  encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def cache_store(cache_dir: str, key: Optional[str], payload: dict) -> None:
+    if key is None:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = os.path.join(cache_dir, f".{key}.tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, os.path.join(cache_dir, f"{key}.json"))
+    except OSError:
+        pass            # cache is best-effort — never fail the lint
+
+
+def findings_to_payload(pairs: Sequence[Tuple[Finding, str]],
+                        n_modules: int, n_reachable: int,
+                        n_files: int) -> dict:
+    return {
+        "n_modules": n_modules,
+        "n_reachable": n_reachable,
+        "n_files": n_files,
+        "findings": [
+            {"code": f.code, "message": f.message, "path": f.path,
+             "line": f.line, "col": f.col, "function": f.function,
+             "fingerprint": fp}
+            for f, fp in pairs
+        ],
+    }
+
+
+def payload_to_findings(payload: dict) -> List[Tuple[Finding, str]]:
+    return [
+        (Finding(e["code"], e["message"], e["path"], e["line"], e["col"],
+                 e.get("function", "")), e["fingerprint"])
+        for e in payload.get("findings", [])
+    ]

@@ -1,0 +1,224 @@
+"""Caching rule TPU010: unbounded compile/program caches.
+
+The failure mode this encodes is the ADVICE-#3 class PR 7 fixed by
+hand in ``models/generation.py``: a dict keyed on shapes/configs that
+memoizes compiled programs (or their aval specs) grows by one entry
+per distinct key and never evicts — every new sequence-length bucket,
+batch size, or composition leaks a program *and its device
+executable* forever.  The rule detects the memo pattern (guarded read
++ keyed store) on an instance attribute or module global, requires
+the store to be *trace-adjacent* (the storing function is
+trace/per-step reachable, or itself builds jit programs), and stays
+quiet on any eviction evidence: ``pop``/``popitem``/``clear``/
+``del``/``move_to_end``, a ``len(cache)`` cap check, or the cache
+escaping into a helper call (e.g. ``_lru_put(net, cache, ...)``).
+
+A fresh re-assignment (``self._cache = {}``) outside ``__init__`` is
+deliberately NOT eviction evidence: that is *invalidation* — it
+resets on structural change but still grows without bound across
+distinct keys between resets.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import (ClassInfo, Finding, FunctionInfo, ModuleInfo, Project,
+                       dotted_name)
+
+_DICTISH_CTORS = {"dict", "collections.OrderedDict", "OrderedDict",
+                  "collections.defaultdict", "defaultdict"}
+_EVICT_METHODS = {"pop", "popitem", "clear", "move_to_end"}
+_STORE_METHODS = {"setdefault", "append"}
+
+
+def _is_cache_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List)):
+        return True
+    if isinstance(node, ast.Call) and not node.args and not node.keywords:
+        d = dotted_name(node.func)
+        return d in _DICTISH_CTORS or d == "list"
+    return False
+
+
+@dataclass
+class _Cache:
+    """One candidate cache: a `self.X` attr of a class, or a module
+    global, with everything observed about it across the module."""
+    label: str                     # "Class._attr" / "module._GLOBAL"
+    init_line: int
+    store_sites: List[Tuple[FunctionInfo, ast.AST]] = field(
+        default_factory=list)
+    guarded_read: bool = False
+    evicted: bool = False
+
+
+def _ref_matches(node: ast.AST, attr: Optional[str],
+                 gname: Optional[str]) -> bool:
+    """Is `node` a reference to the tracked cache (`self.attr` or the
+    module global `gname`)?"""
+    if attr is not None:
+        return (isinstance(node, ast.Attribute) and node.attr == attr
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+    return isinstance(node, ast.Name) and node.id == gname
+
+
+def _rewrite_keys(nodes, attr: Optional[str], gname: Optional[str]) -> Set[str]:
+    """Loop variables iterating the cache itself (`for k in cache`,
+    `for k, v in list(cache.items())`): a store keyed by one rewrites
+    an EXISTING entry in place — it can't grow the cache."""
+    out: Set[str] = set()
+    for node in nodes:
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        # unwrap list(...)/tuple(...)/sorted(...)
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("list", "tuple", "sorted") and it.args:
+            it = it.args[0]
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute) \
+                and it.func.attr in ("items", "keys"):
+            it = it.func.value
+        if not _ref_matches(it, attr, gname):
+            continue
+        tgt = node.target
+        if isinstance(tgt, ast.Name):
+            out.add(tgt.id)
+        elif isinstance(tgt, ast.Tuple) and tgt.elts \
+                and isinstance(tgt.elts[0], ast.Name):
+            out.add(tgt.elts[0].id)   # `for k, v in cache.items()`
+    return out
+
+
+def _scan_usage(project: Project, cache: _Cache, fn: Optional[FunctionInfo],
+                nodes, attr: Optional[str], gname: Optional[str]):
+    nodes = list(nodes)
+    rewrite = _rewrite_keys(nodes, attr, gname)
+    for node in nodes:
+        # keyed store: cache[k] = v
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and _ref_matches(tgt.value, attr, gname) \
+                        and not (isinstance(tgt.slice, ast.Name)
+                                 and tgt.slice.id in rewrite):
+                    cache.store_sites.append((fn, tgt))
+        # del cache[k] — eviction
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and _ref_matches(tgt.value, attr, gname):
+                    cache.evicted = True
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and _ref_matches(f.value, attr,
+                                                             gname):
+                if f.attr in _EVICT_METHODS:
+                    cache.evicted = True
+                elif f.attr in _STORE_METHODS:
+                    cache.store_sites.append((fn, node))
+                    if f.attr == "setdefault":
+                        cache.guarded_read = True
+                elif f.attr == "get":
+                    cache.guarded_read = True
+            # len(cache) in a cap check / cache escaping into a helper
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if _ref_matches(a, attr, gname):
+                    d = dotted_name(f)
+                    if d == "len":
+                        continue     # classified by the Compare case
+                    cache.evicted = True
+        elif isinstance(node, ast.Compare):
+            for side in [node.left] + list(node.comparators):
+                if _ref_matches(side, attr, gname) and any(
+                        isinstance(op, (ast.In, ast.NotIn))
+                        for op in node.ops):
+                    cache.guarded_read = True
+                if isinstance(side, ast.Call) \
+                        and dotted_name(side.func) == "len" and side.args \
+                        and _ref_matches(side.args[0], attr, gname):
+                    cache.evicted = True    # explicit size-cap check
+
+
+def _trace_adjacent(project: Project, fn: Optional[FunctionInfo]) -> bool:
+    if fn is None:
+        return False
+    if fn.trace_reachable or fn.perstep_reachable or fn.is_jit_wrapper:
+        return True
+    # the store lives next to program construction (jit/eval_shape/…)
+    return any(project.is_jit_wrapper_call(fn.module, call)
+               for call in project.iter_own_nodes(fn)
+               if isinstance(call, ast.Call))
+
+
+def _class_caches(project: Project, mod: ModuleInfo,
+                  cls: ClassInfo) -> List[_Cache]:
+    cands: Dict[str, _Cache] = {}
+    methods = [f for f in mod.functions.values() if f.cls is cls]
+    for m in methods:
+        for node in project.iter_own_nodes(m):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and node.value is not None and _is_cache_ctor(node.value):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self" \
+                            and tgt.attr not in cands:
+                        cands[tgt.attr] = _Cache(
+                            f"{cls.name}.{tgt.attr}", node.lineno)
+    for attr, cache in cands.items():
+        for m in methods:
+            _scan_usage(project, cache, m, project.iter_own_nodes(m),
+                        attr, None)
+    return list(cands.values())
+
+
+def _module_caches(project: Project, mod: ModuleInfo) -> List[_Cache]:
+    cands: Dict[str, _Cache] = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                and stmt.value is not None and _is_cache_ctor(stmt.value):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id not in cands:
+                    cands[tgt.id] = _Cache(f"{mod.name}.{tgt.id}",
+                                           stmt.lineno)
+    for gname, cache in cands.items():
+        for fn in mod.functions.values():
+            _scan_usage(project, cache, fn, project.iter_own_nodes(fn),
+                        None, gname)
+    return list(cands.values())
+
+
+def check_tpu010_module(project: Project, mod: ModuleInfo) -> List[Finding]:
+    """TPU010 is a per-module rule (a cache's stores, reads and
+    eviction are spread across functions), unlike the per-function
+    TPU001–009 — the driver calls it once per module."""
+    out: List[Finding] = []
+    caches: List[_Cache] = []
+    for cls in mod.classes.values():
+        caches.extend(_class_caches(project, mod, cls))
+    caches.extend(_module_caches(project, mod))
+    for cache in caches:
+        if cache.evicted or not cache.guarded_read or not cache.store_sites:
+            continue
+        adjacent = [s for s in cache.store_sites
+                    if _trace_adjacent(project, s[0])]
+        if not adjacent:
+            continue
+        fn, node = adjacent[0]
+        out.append(Finding(
+            "TPU010",
+            f"unbounded cache `{cache.label}`: memoized keyed store with "
+            f"no eviction or size cap in trace-adjacent code — one entry "
+            f"(often a compiled program or aval spec) leaks per distinct "
+            f"key; cap it LRU-style like models/generation._lru_put",
+            mod.path, node.lineno, node.col_offset,
+            fn.full_name if fn is not None else mod.name))
+    out.sort(key=lambda f: (f.line, f.col))
+    return out

@@ -1,14 +1,31 @@
 """tpulint command line: ``python -m tools.tpulint <paths> [--strict]``.
 
-Exit codes: 0 clean, 1 findings, 2 usage / analysis errors.
+Exit codes: 0 clean (or all findings baselined), 1 findings, 2 usage /
+analysis errors.
+
+CI shape (ci/lint.sh)::
+
+    python -m tools.tpulint incubator_mxnet_tpu tools ci \
+        --strict --baseline .tpulint_baseline.json
+
+which fails only on findings NOT in the committed baseline — the
+ratchet: new hazards block, pre-existing accepted ones don't.  Seed or
+refresh the baseline with ``--write-baseline``.
+
+Repeat invocations hit a findings cache under ``.tpulint_cache/``
+keyed on every analyzed file's (path, mtime, size) and the linter's
+own sources; ``--no-cache`` forces a fresh analysis, ``--stats``
+reports files/elapsed/cache status on stderr.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import sys
+import time
 from typing import List, Optional
 
+from . import baseline as bl
 from .analyzer import Project
 from .rules import ALL_RULES, run_rules
 from .suppressions import apply_suppressions
@@ -27,11 +44,26 @@ def run(paths: List[str], select: Optional[List[str]] = None,
     return project, findings
 
 
+def _emit(pairs, fmt: str):
+    if fmt == "json":
+        # one finding per line (JSON-lines): trivially grep/jq-able,
+        # diff-stable, and streamable — no enclosing array
+        for f, fp in pairs:
+            print(json.dumps({"rule": f.code, "path": f.path,
+                              "line": f.line, "col": f.col,
+                              "function": f.function, "message": f.message,
+                              "fingerprint": fp}, sort_keys=True))
+    else:
+        for f, _fp in pairs:
+            print(f.format())
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpulint",
-        description="Static analyzer for JAX/TPU tracing hazards "
-                    "(TPU001-TPU006; see docs/static_analysis.md)")
+        description="Static analyzer for JAX/TPU tracing, sharding and "
+                    "thread-safety hazards (TPU001-TPU012; see "
+                    "docs/static_analysis.md)")
     ap.add_argument("paths", nargs="+", help="files or directories to lint")
     ap.add_argument("--strict", action="store_true",
                     help="require a `-- reason` on every suppression")
@@ -39,7 +71,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="comma-separated rule codes to run (default: all)")
     ap.add_argument("--ignore", default=None,
                     help="comma-separated rule codes to skip")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="json = one finding per line with rule/path/line/"
+                         "fingerprint")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="accepted-findings file: report and fail only on "
+                         "findings NOT fingerprinted in it")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the --baseline file "
+                         "(default .tpulint_baseline.json) and exit 0")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the .tpulint_cache/ findings memo")
+    ap.add_argument("--stats", action="store_true",
+                    help="report analyzed files / elapsed / cache status")
     ap.add_argument("--show-reachable", action="store_true",
                     help="dump the trace-reachable function set and exit")
     args = ap.parse_args(argv)
@@ -51,27 +95,68 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"tpulint: unknown rule code {code!r}", file=sys.stderr)
             return 2
 
-    project, findings = run(args.paths, select, ignore, args.strict)
+    t0 = time.monotonic()
+    files = Project._collect_files(args.paths)
+    key = bl.cache_key(files, select, ignore, args.strict)
+    cached = None
+    if not args.no_cache and not args.show_reachable:
+        cached = bl.cache_load(bl.CACHE_DIR, key)
 
-    if project.errors:
-        for e in project.errors:
-            print(f"tpulint: parse error: {e}", file=sys.stderr)
-        return 2
-
-    if args.show_reachable:
-        for fn in sorted(project.trace_reachable_functions(),
-                         key=lambda f: f.full_name):
-            print(f"{fn.full_name}  [{fn.trace_reason}]")
-        return 0
-
-    if args.format == "json":
-        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    if cached is not None:
+        pairs = bl.payload_to_findings(cached)
+        n_mod = cached.get("n_modules", 0)
+        n_reach = cached.get("n_reachable", 0)
     else:
-        for f in findings:
-            print(f.format())
+        project, findings = run(args.paths, select, ignore, args.strict)
+        if project.errors:
+            for e in project.errors:
+                print(f"tpulint: parse error: {e}", file=sys.stderr)
+            return 2
+        if args.show_reachable:
+            for fn in sorted(project.trace_reachable_functions(),
+                             key=lambda f: f.full_name):
+                print(f"{fn.full_name}  [{fn.trace_reason}]")
+            return 0
+        sources = {m.path: m.source for m in project.modules.values()}
+        pairs = bl.fingerprint_findings(findings, sources)
         n_mod = len(project.modules)
         n_reach = len(project.trace_reachable_functions())
-        tail = (f"tpulint: {len(findings)} finding(s) in {n_mod} module(s) "
+        if not args.no_cache:
+            bl.cache_store(bl.CACHE_DIR, key, bl.findings_to_payload(
+                pairs, n_mod, n_reach, len(files)))
+
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        out = args.baseline or ".tpulint_baseline.json"
+        n = bl.write_baseline(out, [f for f, _ in pairs])
+        print(f"tpulint: wrote {n} finding(s) to {out}", file=sys.stderr)
+        return 0
+
+    new_pairs = pairs
+    n_baselined = 0
+    if args.baseline is not None:
+        try:
+            accepted = bl.load_baseline(args.baseline)
+        except FileNotFoundError:
+            print(f"tpulint: baseline {args.baseline} not found — seed it "
+                  f"with --write-baseline", file=sys.stderr)
+            return 2
+        except (ValueError, KeyError) as e:
+            print(f"tpulint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        new_pairs = bl.filter_new(pairs, accepted)
+        n_baselined = len(pairs) - len(new_pairs)
+
+    _emit(new_pairs, args.format)
+    if args.format == "text":
+        tail = (f"tpulint: {len(new_pairs)} finding(s) in {n_mod} module(s) "
                 f"({n_reach} trace-reachable functions)")
+        if n_baselined:
+            tail += f"; {n_baselined} baselined finding(s) suppressed"
         print(tail, file=sys.stderr)
-    return 1 if findings else 0
+    if args.stats:
+        src = "hit" if cached is not None else "miss"
+        print(f"tpulint: analyzed {len(files)} file(s) in {elapsed:.2f}s "
+              f"(cache {src})", file=sys.stderr)
+    return 1 if new_pairs else 0

@@ -1,0 +1,427 @@
+"""Sharding/collective rules TPU007–TPU009 (interprocedural).
+
+These rules ride the analyzer's cross-module passes:
+
+* TPU007 consumes per-function *shard-axis contexts* — the union of
+  mesh axis names bound by every ``shard_map``/``pmap``/``vmap``
+  context a function is reachable from, propagated through the call
+  graph — and flags collectives naming an axis no reaching context
+  binds.  An ``axis_name`` *parameter* is resolved through the reverse
+  call graph to the string constants analyzed callers actually pass.
+* TPU008 flags a jit-boundary closure capturing an array value from
+  its enclosing function: the array is baked into the compiled program
+  as a constant (weights become immutable copies, doubling HBM) or, if
+  the outer function is itself under trace, the inner jit captures an
+  outer tracer and retraces per call.
+* TPU009 tracks donated buffers (``donate_argnums``): referencing a
+  buffer after the call it was donated to reads a deleted device
+  array.  Donating callables are tracked through local bindings,
+  through functions that *return* a donating jit, and through class
+  attributes holding one.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import (COLLECTIVE_FUNCS, Finding, FunctionInfo, Project,
+                       dotted_name)
+
+# collectives whose FIRST positional argument is the axis name
+_AXIS_ARG0 = {"axis_index", "axis_size"}
+
+# aval metadata reads stay legal on a donated (deleted) buffer
+_DONATION_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                        "itemsize", "nbytes", "weak_type", "is_deleted"}
+
+
+# ---------------------------------------------------------------------------
+# TPU007 — collective over an axis no reaching shard context binds
+# ---------------------------------------------------------------------------
+
+
+def _axis_param_index(fn: FunctionInfo, name: str) -> Optional[int]:
+    pos = fn.node.args.posonlyargs + fn.node.args.args
+    for i, a in enumerate(pos):
+        if a.arg == name:
+            return i
+    return None
+
+
+def _param_default(fn: FunctionInfo, name: str) -> Optional[str]:
+    args = fn.node.args
+    pos = args.posonlyargs + args.args
+    n_def = len(args.defaults)
+    for a, d in zip(pos[len(pos) - n_def:], args.defaults):
+        if a.arg == name and isinstance(d, ast.Constant) \
+                and isinstance(d.value, str):
+            return d.value
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if a.arg == name and isinstance(d, ast.Constant) \
+                and isinstance(d.value, str):
+            return d.value
+    return None
+
+
+def _caller_axis_values(project: Project, fn: FunctionInfo,
+                        param: str) -> Tuple[Set[str], bool]:
+    """String constants analyzed callers pass for `param`, plus the
+    param's own default.  (values, all_known): all_known is False when
+    some call site passes a non-literal (then TPU007 must stay quiet —
+    the value may be an axis the context does bind)."""
+    values: Set[str] = set()
+    all_known = True
+    idx = _axis_param_index(fn, param)
+    default = _param_default(fn, param)
+    if default is not None:
+        values.add(default)
+    for _caller, call in project.call_sites(fn):
+        got = None
+        for kw in call.keywords:
+            if kw.arg == param:
+                got = kw.value
+        if got is None and idx is not None and idx < len(call.args):
+            got = call.args[idx]
+        if got is None:
+            continue           # omitted → default (already counted)
+        if isinstance(got, ast.Constant) and isinstance(got.value, str):
+            values.add(got.value)
+        else:
+            all_known = False
+    return values, all_known
+
+
+def _axis_exprs(call: ast.Call, tail: str) -> List[ast.AST]:
+    out = [kw.value for kw in call.keywords if kw.arg == "axis_name"]
+    i = 0 if tail in _AXIS_ARG0 else 1
+    if not out and len(call.args) > i:
+        out.append(call.args[i])
+    return out
+
+
+def _literal_axes(expr: ast.AST) -> Optional[Set[str]]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)) and expr.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in expr.elts):
+        return {e.value for e in expr.elts}
+    return None
+
+
+def check_tpu007(project: Project, fn: FunctionInfo) -> List[Finding]:
+    if not fn.trace_reachable:
+        return []
+    # no shard context reaches this function, or a context we couldn't
+    # extract axes from does: both mean no ground truth to check against
+    if fn.shard_axes is None or fn.shard_axes_unknown:
+        return []
+    bound = fn.shard_axes
+    out: List[Finding] = []
+    local_strs: Dict[str, str] = {}
+    for node in project.iter_own_nodes(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            local_strs[node.targets[0].id] = node.value.value
+
+    def flag(node, axes: Set[str]):
+        shown = ", ".join(sorted(axes))
+        have = ", ".join(sorted(bound)) or "(none)"
+        out.append(Finding(
+            "TPU007",
+            f"collective over axis `{shown}` but no enclosing "
+            f"shard_map/pmap context reachable from here binds it "
+            f"(bound axes: {have}) — fails with an unbound-axis error at "
+            f"trace time, or silently reduces over the wrong mesh axis",
+            fn.module.path, node.lineno, node.col_offset, fn.full_name))
+
+    params = {a.arg for a in (fn.node.args.posonlyargs + fn.node.args.args
+                              + fn.node.args.kwonlyargs)}
+    for node in project.iter_own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d is None:
+            continue
+        resolved = project.resolve(fn.module, d)
+        if resolved not in COLLECTIVE_FUNCS:
+            continue
+        tail = resolved.rpartition(".")[2]
+        for expr in _axis_exprs(node, tail):
+            axes = _literal_axes(expr)
+            if axes is None and isinstance(expr, ast.Name):
+                if expr.id in params:
+                    vals, known = _caller_axis_values(project, fn, expr.id)
+                    if not known or not vals:
+                        continue
+                    axes = vals
+                elif expr.id in local_strs:
+                    axes = {local_strs[expr.id]}
+            if axes is None:
+                continue
+            missing = axes - bound
+            if missing:
+                flag(node, missing)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU008 — jit boundary closing over an array / outer tracer
+# ---------------------------------------------------------------------------
+
+
+_ARRAY_PRODUCER_PREFIXES = ("jax.numpy.", "jax.random.", "jax.nn.",
+                            "jax.lax.", "jax.scipy.")
+_ARRAY_PRODUCER_FUNCS = {"jax.device_put", "jax.device_put_replicated",
+                         "jax.device_put_sharded", "jax.block_until_ready"}
+
+
+# wrappers that start a NEW compiled program.  Control-flow primitives
+# (lax.scan/cond/...), shard_map, vmap/grad etc. inline their function
+# argument into the SAME trace — closing over outer tracers there is
+# normal JAX, not a bug.  eval_shape/make_jaxpr never compile at all.
+_COMPILE_BOUNDARIES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+                       "jax.experimental.pallas.pallas_call"}
+
+
+def _is_jit_entry(fn: FunctionInfo) -> bool:
+    return fn.seed_wrapper in _COMPILE_BOUNDARIES
+
+
+def _free_names(fn: FunctionInfo) -> Set[str]:
+    """Names `fn` reads but never binds — closure candidates."""
+    bound: Set[str] = set()
+    loads: Set[str] = set()
+    node = fn.node
+    arglike = [node.args]
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(sub.name)
+            arglike.append(sub.args)
+        elif isinstance(sub, ast.Lambda):
+            arglike.append(sub.args)
+        elif isinstance(sub, ast.ClassDef):
+            bound.add(sub.name)
+        elif isinstance(sub, ast.Name):
+            if isinstance(sub.ctx, ast.Load):
+                loads.add(sub.id)
+            else:
+                bound.add(sub.id)
+        elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+            bound.update(sub.names)
+        elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+            for a in sub.names:
+                bound.add((a.asname or a.name).split(".")[0])
+        elif isinstance(sub, ast.ExceptHandler) and sub.name:
+            bound.add(sub.name)
+    for args in arglike:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            bound.add(a.arg)
+        for va in (args.vararg, args.kwarg):
+            if va is not None:
+                bound.add(va.arg)
+    return loads - bound - set(dir(builtins))
+
+
+def _parent_of(project: Project, fn: FunctionInfo) -> Optional[FunctionInfo]:
+    qual, _, _ = fn.qualname.rpartition(".")
+    return fn.module.functions.get(qual) if qual else None
+
+
+def check_tpu008(project: Project, fn: FunctionInfo) -> List[Finding]:
+    if not _is_jit_entry(fn):
+        return []
+    parent = _parent_of(project, fn)
+    if parent is None:
+        return []
+    from .rules import Taint, _walk_stmts
+
+    class _ArrayTaint(Taint):
+        """Parent-scope taint extended with array *producers*: a local
+        assigned from jnp/jax.random/device_put is an array even though
+        it doesn't derive from a parameter."""
+
+        def call(self, node: ast.Call) -> bool:
+            d = dotted_name(node.func)
+            if d is not None:
+                resolved = self.project.resolve(self.fn.module, d)
+                if resolved in _ARRAY_PRODUCER_FUNCS or \
+                        resolved.startswith(_ARRAY_PRODUCER_PREFIXES):
+                    return True
+            return super().call(node)
+
+    taint = _ArrayTaint(project, parent)
+    if not parent.trace_reachable:
+        # host-side builder: its parameters are host objects (nets,
+        # pending steps, configs) — param-derived taint would call every
+        # attribute an array.  Only values with direct array-producer
+        # evidence (jnp.*/jax.random.*/device_put assignments) count.
+        taint.tainted.clear()
+        taint.containers.clear()
+    # closures late-bind: the state that matters is the parent's final
+    # one, after every statement ran
+    for stmt in _walk_stmts(parent.node.body):
+        taint.process_stmt(stmt)
+    captured = sorted(_free_names(fn) & taint.tainted)
+    out: List[Finding] = []
+    for name in captured:
+        if parent.trace_reachable:
+            msg = (f"jit boundary `{fn.name}` closes over `{name}`, a "
+                   f"tracer of the enclosing traced function "
+                   f"`{parent.qualname}` — leaks the outer trace into the "
+                   f"inner program and retraces on every outer trace; pass "
+                   f"it as an argument")
+        else:
+            msg = (f"jit boundary `{fn.name}` closes over array `{name}` "
+                   f"from `{parent.qualname}` — the array is constant-folded "
+                   f"into the compiled program (a frozen copy on every "
+                   f"device, retrace per rebuild); pass it as an argument")
+        out.append(Finding("TPU008", msg, fn.module.path, fn.node.lineno,
+                           fn.node.col_offset, fn.full_name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU009 — donated buffer referenced after the donating call
+# ---------------------------------------------------------------------------
+
+
+def _donating_positions(project: Project, fn: FunctionInfo,
+                        call: ast.Call,
+                        donators: Dict[str, Tuple[int, ...]]
+                        ) -> Optional[Tuple[int, ...]]:
+    """donate_argnums for this call if it invokes a donating jit:
+    a tracked local, `self.attr` recorded by the analyzer, a function
+    returning a donating jit called directly, or an immediately-invoked
+    `jax.jit(g, donate_argnums=...)(...)`."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in donators:
+        return donators[func.id]
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name) \
+            and func.value.id == "self" and fn.cls is not None:
+        return project.donating_attrs.get((id(fn.cls), func.attr))
+    if isinstance(func, ast.Call):
+        # immediately-invoked `jax.jit(g, donate_argnums=...)(x)`
+        return project.donating_jit_nums(fn.module, func)
+    return None
+
+
+def check_tpu009(project: Project, fn: FunctionInfo) -> List[Finding]:
+    out: List[Finding] = []
+    reported: Set[Tuple[str, int]] = set()
+    # locals bound to donating callables, seeded per scan
+    init_donators: Dict[str, Tuple[int, ...]] = {}
+    for node in project.iter_own_nodes(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            nums = project.donating_jit_nums(fn.module, node.value)
+            if nums is None and isinstance(node.value, ast.Call):
+                d = dotted_name(node.value.func)
+                if d is not None:
+                    called = project._resolve_call_target(fn, d)
+                    if called is not None:
+                        nums = called.returns_donating
+            if nums is not None:
+                init_donators[tgt] = nums
+
+    def flag(node, name, line):
+        if (name, node.lineno) in reported:
+            return
+        reported.add((name, node.lineno))
+        out.append(Finding(
+            "TPU009",
+            f"`{name}` was donated to the jitted call on line {line} "
+            f"(donate_argnums) and is referenced afterwards — the donated "
+            f"device buffer is deleted by XLA; use the call's result or "
+            f"drop the donation",
+            fn.module.path, node.lineno, node.col_offset, fn.full_name))
+
+    def scan_expr(node, donated: Dict[str, int]):
+        """Flag reads of donated names; aval metadata reads excluded."""
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _DONATION_SAFE_ATTRS:
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in donated:
+            flag(node, node.id, donated[node.id])
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler,
+                                  ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            scan_expr(child, donated)
+
+    def process_calls(stmt, donated, donators):
+        from .rules import _own_exprs
+
+        for node in _own_exprs(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            nums = _donating_positions(project, fn, node, donators)
+            if not nums:
+                continue
+            for p in nums:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                    donated[node.args[p].id] = node.lineno
+
+    def process_binds(stmt, donated, donators):
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    donated.pop(sub.id, None)
+                    if not (isinstance(stmt, ast.Assign)
+                            and sub.id in init_donators):
+                        donators.pop(sub.id, None)
+
+    def scan(body, donated: Dict[str, int],
+             donators: Dict[str, Tuple[int, ...]]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            # reads happen at evaluation time, before this statement's
+            # donation or rebinding takes effect
+            for node in ast.iter_child_nodes(stmt):
+                if not isinstance(node, (ast.stmt, ast.excepthandler)):
+                    scan_expr(node, donated)
+            process_calls(stmt, donated, donators)
+            process_binds(stmt, donated, donators)
+            if isinstance(stmt, (ast.For, ast.While)):
+                for _ in range(2):      # catch next-iteration reuse
+                    scan(stmt.body, donated, donators)
+                scan(stmt.orelse, donated, donators)
+            elif isinstance(stmt, ast.If):
+                left_d, left_f = dict(donated), dict(donators)
+                scan(stmt.body, left_d, left_f)
+                right_d, right_f = dict(donated), dict(donators)
+                scan(stmt.orelse, right_d, right_f)
+                donated.clear()
+                donated.update(right_d)
+                for k, v in left_d.items():   # donated on either branch
+                    donated.setdefault(k, v)
+                donators.clear()
+                donators.update({k: v for k, v in left_f.items()
+                                 if k in right_f})
+            elif isinstance(stmt, ast.Try):
+                scan(stmt.body, donated, donators)
+                for h in stmt.handlers:
+                    scan(h.body, donated, donators)
+                scan(stmt.orelse, donated, donators)
+                scan(stmt.finalbody, donated, donators)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                scan(stmt.body, donated, donators)
+
+    scan(fn.node.body, {}, dict(init_donators))
+    return out

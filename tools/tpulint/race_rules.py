@@ -1,0 +1,369 @@
+"""Thread-safety rules TPU011–TPU012.
+
+Both consume the analyzer's *thread reachability* pass (functions
+running on a ``threading.Thread`` target, transitively through the
+call graph):
+
+* TPU011 — an instance attribute written from a thread-reachable
+  method and read (or written) from a non-thread method with no common
+  lock held on both paths.  Lock tracking is a simple two-part pass:
+  locks held lexically (``with self._lock:`` around the site) plus
+  *entry locks* — the intersection, over every analyzed call site of a
+  method, of the locks its callers hold when calling it (two fixpoint
+  iterations, enough for the helper-under-lock idiom).
+* TPU012 — a class that starts a background thread whose
+  close/stop/``__del__`` path never joins it or signals it to exit
+  (Event ``set()``, queue ``put(None)`` sentinel) — or that has no
+  close path at all.  Either way pending work is silently dropped at
+  interpreter exit and the thread can never be flushed.
+
+Attributes only ever holding intrinsically thread-safe objects
+(queues, locks, events, deques, the threads themselves) are exempt
+from TPU011 — sharing the *object* is the point; it synchronizes
+internally.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import (THREAD_FACTORIES, ClassInfo, Finding, FunctionInfo,
+                       ModuleInfo, Project, dotted_name)
+
+# constructions whose instances synchronize internally — sharing the
+# attribute across threads is safe by design
+_THREADSAFE_CTORS = THREAD_FACTORIES | {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "collections.deque", "deque",
+    "threading.Lock", "threading.RLock", "threading.Event",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier", "threading.local",
+}
+
+_CLOSE_NAMES = {"close", "stop", "shutdown", "terminate", "finalize",
+                "teardown", "__del__", "__exit__"}
+_CLOSE_PREFIXES = ("close", "stop", "shutdown", "teardown",
+                   "_close", "_stop", "_shutdown", "_teardown")
+
+
+def _is_close_method(name: str) -> bool:
+    return name in _CLOSE_NAMES or name.startswith(_CLOSE_PREFIXES)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _class_methods(mod: ModuleInfo, cls: ClassInfo) -> List[FunctionInfo]:
+    return [f for f in mod.functions.values() if f.cls is cls]
+
+
+# ---------------------------------------------------------------------------
+# lock-held tracking (TPU011)
+# ---------------------------------------------------------------------------
+
+
+def _lock_token(expr: ast.AST) -> Optional[str]:
+    """Identity of a lock in a `with` item — its dotted source text
+    (`self._lock`, `_mod_lock`, `self._cv`); None for non-name ctxs."""
+    return dotted_name(expr)
+
+
+class _SiteCollector:
+    """One walk per method: every `self.X` read/write site annotated
+    with the set of locks lexically held there, plus lock sets at
+    outgoing call sites (for the entry-lock fixpoint)."""
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+        self.writes: List[Tuple[str, ast.AST, frozenset]] = []
+        self.reads: List[Tuple[str, ast.AST, frozenset]] = []
+        self.call_locks: Dict[int, frozenset] = {}   # id(Call) -> locks
+        self._walk(fn.node.body, frozenset())
+
+    def _walk(self, body: List[ast.stmt], held: frozenset):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                tokens = {t for t in (_lock_token(i.context_expr)
+                                      for i in stmt.items) if t}
+                self._exprs(stmt, held)
+                self._walk(stmt.body, held | tokens)
+                continue
+            self._exprs(stmt, held)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._walk(sub, held)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._walk(h.body, held)
+
+    def _exprs(self, stmt: ast.stmt, held: frozenset):
+        def rec(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.stmt, ast.excepthandler,
+                                      ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                a = _self_attr(child)
+                if a is not None:
+                    if isinstance(child.ctx, ast.Load):
+                        self.reads.append((a, child, held))
+                    else:
+                        self.writes.append((a, child, held))
+                if isinstance(child, ast.Call):
+                    self.call_locks[id(child)] = held
+                rec(child)
+
+        rec(stmt)
+
+
+def _entry_locks(project: Project,
+                 collectors: Dict[int, _SiteCollector]) -> Dict[int, frozenset]:
+    """Fixpoint (2 rounds): locks provably held on EVERY analyzed call
+    path into each method.  A method with no analyzed call sites gets
+    an empty set (it is a public entry — assume unlocked)."""
+    entry: Dict[int, frozenset] = {fid: frozenset() for fid in collectors}
+    for _ in range(2):
+        nxt: Dict[int, frozenset] = {}
+        for fid, col in collectors.items():
+            acc: Optional[frozenset] = None
+            for caller, call in project.call_sites(col.fn):
+                ccol = collectors.get(id(caller))
+                at_site = ccol.call_locks.get(id(call), frozenset()) \
+                    if ccol is not None else frozenset()
+                here = at_site | entry.get(id(caller), frozenset())
+                acc = here if acc is None else (acc & here)
+            nxt[fid] = acc if acc is not None else frozenset()
+        entry = nxt
+    return entry
+
+
+def _threadsafe_attrs(project: Project, mod: ModuleInfo,
+                      methods: List[FunctionInfo]) -> Set[str]:
+    safe: Set[str] = set()
+    unsafe: Set[str] = set()
+    for m in methods:
+        for node in project.iter_own_nodes(m):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    or node.value is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                a = _self_attr(tgt)
+                if a is None:
+                    continue
+                v = node.value
+                d = dotted_name(v.func) if isinstance(v, ast.Call) else None
+                resolved = project.resolve(mod, d) if d else None
+                if resolved in _THREADSAFE_CTORS \
+                        or isinstance(v, ast.Constant) and v.value is None:
+                    safe.add(a)       # None placeholder / safe object
+                else:
+                    unsafe.add(a)
+    return safe - unsafe
+
+
+def check_tpu011_class(project: Project, mod: ModuleInfo,
+                       cls: ClassInfo) -> List[Finding]:
+    methods = _class_methods(mod, cls)
+    if not any(m.thread_reachable for m in methods):
+        return []
+    collectors = {id(m): _SiteCollector(m) for m in methods}
+    entry = _entry_locks(project, collectors)
+    exempt = _threadsafe_attrs(project, mod, methods)
+    out: List[Finding] = []
+    reported: Set[str] = set()
+    for m in methods:
+        if not m.thread_reachable:
+            continue
+        for attr, node, held in collectors[id(m)].writes:
+            if attr in exempt or attr in reported:
+                continue
+            wlocks = held | entry[id(m)]
+            for other in methods:
+                if other.thread_reachable or other.name == "__init__":
+                    continue
+                ocol = collectors[id(other)]
+                for oattr, onode, oheld in ocol.reads + ocol.writes:
+                    if oattr != attr:
+                        continue
+                    olocks = oheld | entry[id(other)]
+                    if wlocks & olocks:
+                        continue
+                    reported.add(attr)
+                    out.append(Finding(
+                        "TPU011",
+                        f"`self.{attr}` is written from thread-side "
+                        f"`{m.qualname}` and accessed from "
+                        f"`{other.qualname}` (line {onode.lineno}) with no "
+                        f"common lock — torn/stale reads across threads; "
+                        f"guard both sides with one lock or use a "
+                        f"queue/Event",
+                        mod.path, node.lineno, node.col_offset,
+                        m.full_name))
+                    break
+                if attr in reported:
+                    break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU012 — started thread without a joining/signalling close path
+# ---------------------------------------------------------------------------
+
+
+def _thread_ctor_in(project: Project, mod: ModuleInfo,
+                    value: ast.AST) -> bool:
+    """Is `value` a Thread construction, or a list/tuple holding one
+    (incl. via a comprehension)?"""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            d = dotted_name(sub.func)
+            if d is not None and project.resolve(mod, d) in THREAD_FACTORIES:
+                return True
+    return False
+
+
+def _event_queue_attrs(project: Project, mod: ModuleInfo,
+                       methods: List[FunctionInfo]) -> Tuple[Set[str], Set[str]]:
+    events: Set[str] = set()
+    queues: Set[str] = set()
+    for m in methods:
+        for node in project.iter_own_nodes(m):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    or node.value is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            d = dotted_name(node.value.func) \
+                if isinstance(node.value, ast.Call) else None
+            resolved = project.resolve(mod, d) if d else None
+            for tgt in targets:
+                a = _self_attr(tgt)
+                if a is None:
+                    continue
+                if resolved == "threading.Event":
+                    events.add(a)
+                elif resolved in ("queue.Queue", "queue.LifoQueue",
+                                  "queue.PriorityQueue", "queue.SimpleQueue"):
+                    queues.add(a)
+    return events, queues
+
+
+def _close_reachable(project: Project, cls: ClassInfo,
+                     methods: List[FunctionInfo]) -> List[FunctionInfo]:
+    seeds = [m for m in methods if _is_close_method(m.name)]
+    seen = {id(m) for m in seeds}
+    work = list(seeds)
+    while work:
+        m = work.pop()
+        for callee in project.callees(m):
+            if callee.cls is cls and id(callee) not in seen:
+                seen.add(id(callee))
+                work.append(callee)
+                seeds.append(callee)
+    return seeds
+
+
+def check_tpu012_class(project: Project, mod: ModuleInfo,
+                       cls: ClassInfo) -> List[Finding]:
+    methods = _class_methods(mod, cls)
+    # thread attrs: self.X = Thread(...) / [Thread(...), ...]
+    thread_attrs: Dict[str, ast.AST] = {}
+    started: Set[str] = set()
+    for m in methods:
+        loop_alias: Dict[str, str] = {}
+        for node in project.iter_own_nodes(m):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                    and node.value is not None:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    a = _self_attr(tgt)
+                    if a is not None and a not in thread_attrs \
+                            and _thread_ctor_in(project, mod, node.value):
+                        thread_attrs[a] = tgt
+            elif isinstance(node, ast.For):
+                a = _self_attr(node.iter)
+                if a is not None and isinstance(node.target, ast.Name):
+                    loop_alias[node.target.id] = a
+        for node in project.iter_own_nodes(m):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "start":
+                recv = node.func.value
+                a = _self_attr(recv)
+                if a is None and isinstance(recv, ast.Name):
+                    a = loop_alias.get(recv.id)
+                if a is not None:
+                    started.add(a)
+    live = {a: tgt for a, tgt in thread_attrs.items() if a in started}
+    if not live:
+        return []
+
+    close_set = _close_reachable(project, cls, methods)
+    events, queues = _event_queue_attrs(project, mod, methods)
+
+    if not close_set:
+        a, tgt = next(iter(live.items()))
+        return [Finding(
+            "TPU012",
+            f"`{cls.name}` starts background thread `self.{a}` but has no "
+            f"close/stop/__del__ path at all — the thread can never be "
+            f"joined or told to exit, and queued work is dropped at "
+            f"interpreter exit; add a close() that signals and joins it",
+            mod.path, tgt.lineno, tgt.col_offset,
+            f"{mod.name}.{cls.name}")]
+
+    # evidence inside the close-reachable set: a join of the thread
+    # attr (or of a loop var over it), an Event.set(), or a queue
+    # sentinel put(None)
+    joined: Set[str] = set()
+    signalled = False
+    for m in close_set:
+        loop_alias = {}
+        for node in project.iter_own_nodes(m):
+            if isinstance(node, ast.For):
+                a = _self_attr(node.iter)
+                if a is not None and isinstance(node.target, ast.Name):
+                    loop_alias[node.target.id] = a
+        for node in project.iter_own_nodes(m):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = node.func.value
+            a = _self_attr(recv)
+            if a is None and isinstance(recv, ast.Name):
+                a = loop_alias.get(recv.id)
+            if node.func.attr == "join" and a in live:
+                joined.add(a)
+            elif node.func.attr == "set" and a in events:
+                signalled = True
+            elif node.func.attr in ("put", "put_nowait") and a in queues \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value is None:
+                signalled = True
+
+    out: List[Finding] = []
+    for a, tgt in live.items():
+        if a in joined or signalled:
+            continue
+        rep = min((m for m in close_set if _is_close_method(m.name)),
+                  key=lambda m: m.node.lineno)
+        out.append(Finding(
+            "TPU012",
+            f"`{cls.name}.{rep.name}()` never joins or signals started "
+            f"thread `self.{a}` — close returns while the worker still "
+            f"runs (in-flight work races teardown); set a stop "
+            f"Event/sentinel and join it",
+            mod.path, rep.node.lineno, rep.node.col_offset,
+            rep.full_name))
+    return out

@@ -215,9 +215,18 @@ class Taint:
                 if self.expr(stmt.value) or stmt.target.id in self.tainted:
                     self.tainted.add(stmt.target.id)
         elif isinstance(stmt, ast.For):
-            if self.expr(stmt.iter) or (
-                    isinstance(stmt.iter, ast.Name)
-                    and stmt.iter.id in self.containers):
+            it = stmt.iter
+            tainted_iter = self.expr(it) or (
+                isinstance(it, ast.Name) and it.id in self.containers)
+            # `for i, x in enumerate(xs)`: the counter is a host int
+            # even when xs holds tracers — only the element is tainted
+            if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                    and it.func.id == "enumerate" \
+                    and isinstance(stmt.target, ast.Tuple) \
+                    and len(stmt.target.elts) == 2:
+                self.assign(stmt.target.elts[0], False)
+                self.assign(stmt.target.elts[1], tainted_iter)
+            elif tainted_iter:
                 self.assign(stmt.target, True)
 
 
@@ -578,10 +587,14 @@ def check_tpu006(project: Project, fn: FunctionInfo) -> List[Finding]:
 # driver
 # ---------------------------------------------------------------------------
 
-ALL_RULES = ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006")
+ALL_RULES = ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
+             "TPU007", "TPU008", "TPU009", "TPU010", "TPU011", "TPU012")
 
 
 def run_rules(project: Project, select: Optional[Set[str]] = None) -> List[Finding]:
+    # deferred: mesh_rules/race_rules import taint helpers from here
+    from . import cache_rules, mesh_rules, race_rules
+
     findings: List[Finding] = []
     active = set(select) if select else set(ALL_RULES)
     for fn in project.iter_functions():
@@ -598,5 +611,23 @@ def run_rules(project: Project, select: Optional[Set[str]] = None) -> List[Findi
             findings.extend(check_tpu005(project, fn))
         if "TPU006" in active:
             findings.extend(check_tpu006(project, fn))
+        if "TPU007" in active:
+            findings.extend(mesh_rules.check_tpu007(project, fn))
+        if "TPU008" in active:
+            findings.extend(mesh_rules.check_tpu008(project, fn))
+        if "TPU009" in active:
+            findings.extend(mesh_rules.check_tpu009(project, fn))
+    # module/class-scoped rules: a cache's (or attribute's) accesses
+    # are spread across functions, so these run once per module
+    for mod in project.modules.values():
+        if "TPU010" in active:
+            findings.extend(cache_rules.check_tpu010_module(project, mod))
+        for cls in mod.classes.values():
+            if "TPU011" in active:
+                findings.extend(
+                    race_rules.check_tpu011_class(project, mod, cls))
+            if "TPU012" in active:
+                findings.extend(
+                    race_rules.check_tpu012_class(project, mod, cls))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
