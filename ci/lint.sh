@@ -5,7 +5,10 @@
 # fails ONLY on NEW findings, so pre-existing accepted ones never block
 # an unrelated change.  Refresh the baseline with
 #   python -m tools.tpulint incubator_mxnet_tpu tools ci --strict --write-baseline
-# Plus a bytecode compile of package + tools as a syntax gate.
+# Plus a bytecode compile of package + tools as a syntax gate, and
+# hlolint (tools/hlolint/): compiled-program contracts over the HLO of
+# the flagship programs, gated against .hlolint_contracts.json — refresh
+# with   JAX_PLATFORMS=cpu python ci/hlolint_gate.py --write-contracts
 # See docs/static_analysis.md.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -16,6 +19,9 @@ python -m tools.tpulint incubator_mxnet_tpu tools ci \
 
 echo "compileall: incubator_mxnet_tpu/ tools/ tests/ ci/"
 python -m compileall -q incubator_mxnet_tpu/ tools/ tests/ ci/
+
+echo "hlolint: compiled-program contracts (.hlolint_contracts.json)"
+JAX_PLATFORMS=cpu python ci/hlolint_gate.py
 
 echo "telemetry smoke: 3-step train with MXTPU_TELEMETRY_DUMP=1"
 JAX_PLATFORMS=cpu python ci/telemetry_smoke.py

@@ -4,19 +4,25 @@ Quantizes a tiny bf16 TransformerLM for decode and asserts, on CPU:
 
 1. greedy decode token parity >= 95% vs the bf16 program (the ISSUE 7
    quality floor, at smoke scale) for BOTH dequant strategies;
-2. the compiled quantized programs take s8 weight parameters;
-3. the optimized HLO contains NO bf16 copy of any quantized weight
-   shape — the whole point of the pass is to break the bf16
-   weight-streaming floor, so a materialized bf16[O,I] would mean the
-   dequant was hoisted out of the matmul epilogue.  (XLA:CPU legalizes
-   the mixed dot through an f32 weight convert and the int dot through
-   s32 — backend artifacts with no TPU analogue — so the literal gate
-   is on bf16, the dtype the float path would stream.)
+2. the compiled quantized programs take s8 weight parameters (hlolint
+   dtype census over optimized HLO and lowered StableHLO);
+3. the optimized HLO contains NO bf16 buffer of any quantized weight
+   shape (hlolint ``float_weight_materializations``) — the whole point
+   of the pass is to break the bf16 weight-streaming floor, so a
+   materialized bf16[O,I] would mean the dequant was hoisted out of
+   the matmul epilogue.  (XLA:CPU legalizes the mixed dot through an
+   f32 weight convert and the int dot through s32 — backend artifacts
+   with no TPU analogue — so the gate is on bf16, the dtype the float
+   path would stream.)
 4. for the dynamic-activation program, the lowered StableHLO contains
-   NO float tensor of any quantized weight shape at all — dequant acts
-   on the (batch, out) activation, never on the weight matrix.  (The
-   mixed dot is excluded here by construction: jax spells it as a
-   convert feeding the dot, which fuses in-register on TPU.)
+   NO float tensor of any quantized weight shape at all (hlolint
+   ``stablehlo_census``) — dequant acts on the (batch, out)
+   activation, never on the weight matrix.  (The mixed dot is excluded
+   here by construction: jax spells it as a convert feeding the dot,
+   which fuses in-register on TPU.)
+
+All compiled-artifact checks go through the shared tools/hlolint
+parser — this file holds no HLO string matching of its own.
 
 Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/quantized_decode_smoke.py
 """
@@ -33,14 +39,15 @@ import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu.models import generation as G
 from incubator_mxnet_tpu.models.transformer import TransformerLM
 from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from tools import hlolint
 
 V, C, DFF, L, H, MAXLEN = 97, 32, 96, 2, 4, 64
 B, P, N = 2, 5, 16
 
 
 def _lower_quantized(net):
-    """The quantized program's (StableHLO, optimized-HLO) text plus the
-    set of quantized-weight shapes."""
+    """The quantized program parsed through hlolint — (StableHloModule,
+    HloModule, quantized-weight shapes)."""
     qc = net._decode_quant
     fn = next(f for s, f in net._gen_programs.items()
               if s[-2] == qc.cache_key())
@@ -49,7 +56,9 @@ def _lower_quantized(net):
     lowered = fn.lower(params, prompt, jax.random.PRNGKey(0))
     shapes = {tuple(qc.packed(d)["w8"].shape) for d in qc._targets.values()}
     assert shapes, "quant pass registered no target denses"
-    return lowered.as_text(), lowered.compile().as_text(), shapes
+    smod = hlolint.parse_stablehlo(lowered.as_text())
+    hmod = hlolint.parse_hlo(lowered.compile().as_text())
+    return smod, hmod, shapes
 
 
 def main():
@@ -71,24 +80,27 @@ def main():
         assert parity >= 0.95, \
             f"[{aq}] greedy parity {parity:.2%} < 95% vs bf16"
 
-        stablehlo, optimized, w_shapes = _lower_quantized(net)
-        assert "xi8>" in stablehlo, \
-            f"[{aq}] no int8 tensors in the lowered program"
-        assert "s8[" in optimized, f"[{aq}] no s8 buffers in the optimized HLO"
-        for (o, i) in w_shapes:
-            for a, b in ((o, i), (i, o)):
-                pat = f"bf16[{a},{b}]"
-                assert pat not in optimized, \
-                    f"[{aq}] optimized HLO materializes a bf16 copy of a " \
-                    f"quantized weight ({pat}) — dequant was hoisted out " \
-                    f"of the epilogue"
-                if aq == "dynamic":
-                    for elt in ("f32", "bf16", "f16"):
-                        spat = f"tensor<{a}x{b}x{elt}>"
-                        assert spat not in stablehlo, \
-                            f"[{aq}] lowered program builds a float weight " \
-                            f"({spat}); dequant must stay on the " \
-                            f"activation side"
+        smod, hmod, w_shapes = _lower_quantized(net)
+        assert smod.dtypes().get("s8", 0) > 0, \
+            f"[{aq}] no int8 tensors in the lowered program: {smod.dtypes()}"
+        census = hlolint.dtype_census(hmod)
+        assert census["dtypes"].get("s8", {}).get("count", 0) > 0, \
+            f"[{aq}] no s8 buffers in the optimized HLO: " \
+            f"{sorted(census['dtypes'])}"
+        mats = hlolint.float_weight_materializations(
+            hmod, w_shapes, float_dtypes=("bf16",))
+        assert not mats, \
+            f"[{aq}] optimized HLO materializes a bf16 copy of a " \
+            f"quantized weight — dequant was hoisted out of the " \
+            f"epilogue: {mats}"
+        if aq == "dynamic":
+            sc = hlolint.facts.stablehlo_census(
+                smod, weight_shapes=w_shapes,
+                float_dtypes=("f32", "bf16", "f16"))
+            assert not sc["float_weight_tensors"], \
+                f"[{aq}] lowered program builds a float weight " \
+                f"({sc['float_weight_tensors']}); dequant must stay " \
+                f"on the activation side"
         print(f"quantized decode smoke [{aq}]: parity {parity:.0%}, "
               f"{len(w_shapes)} weight shapes gated")
         net.dequantize_decode()

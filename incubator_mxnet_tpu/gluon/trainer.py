@@ -197,6 +197,10 @@ class Trainer:
         self._zero_warned: set = set()  # one-time warning keys
         self._capture_hlo = False       # tests/dryrun: keep last_step_hlo
         self.last_step_hlo: Optional[str] = None
+        # lowered (pre-XLA) StableHLO of the same step: carries the
+        # jax.buffer_donor markers hlolint's donation-coverage fact
+        # holds the compiled input_output_alias header against
+        self.last_step_stablehlo: Optional[str] = None
         # perf-attribution program name of the step path that last ran
         # (telemetry.perf roofline/MFU gauges key on it)
         self._perf_program: Optional[str] = None
@@ -1489,23 +1493,31 @@ class Trainer:
 
     def _capture_step_artifacts(self, fn, ctx, args):
         """AOT lower+compile of the full-step program (the regular jit
-        call cache is untouched) feeding both consumers: compiled-HLO
-        text when `_capture_hlo`, telemetry.perf cost/memory analysis
-        when telemetry is enabled."""
+        call cache is untouched) feeding every consumer of the ONE
+        compile: compiled-HLO + lowered-StableHLO text when
+        `_capture_hlo`, telemetry.perf cost/memory analysis (and, when
+        its text capture is on, the hlolint contract-gate feed) when
+        telemetry is enabled."""
         try:
-            compiled = fn.lower(*args).compile()
+            lowered = fn.lower(*args)
+            compiled = lowered.compile()
         except Exception:
             if self._capture_hlo:
                 self.last_step_hlo = None
+                self.last_step_stablehlo = None
             return
         if self._capture_hlo:
             try:
                 self.last_step_hlo = compiled.as_text()
             except Exception:
                 self.last_step_hlo = None
+            try:
+                self.last_step_stablehlo = lowered.as_text()
+            except Exception:
+                self.last_step_stablehlo = None
         if telemetry.enabled():
             telemetry.perf.capture_compiled(ctx["perf_program"], compiled,
-                                            sig=ctx["sig"])
+                                            sig=ctx["sig"], lowered=lowered)
 
     def _lower_step_hlo(self, fn, pending, ctx):
         """Compiled-HLO text of the fused step (tests/dryrun gates:

@@ -49,7 +49,6 @@ The updated weight shards ride back through the symmetric bucketed
 from __future__ import annotations
 
 import os
-import re
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 __all__ = [
@@ -211,36 +210,26 @@ def unpack_gathered(flat, chunks: Sequence[int], D: int):
 # --------------------------------------------------------------------- #
 # compiled-HLO schedule analysis (the dryrun/bench overlap gate)
 # --------------------------------------------------------------------- #
-_DTYPE_BYTES = {
-    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
-    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
-}
 # op kinds that represent real backward/forward compute the scheduler
 # could hide a collective behind (fusions cover elementwise chains;
 # dot/convolution appear unfused on some backends)
 _COMPUTE_KINDS = frozenset({"dot", "fusion", "convolution"})
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?(%?[\w\.\-]+)\s*=\s*(\(?[^\s]*)\s*([a-z][\w\-]*)\(")
-_NAME_RE = re.compile(r"%([\w\.\-]+)")
 
 
-def _shape_bytes(type_str: str) -> int:
-    """Total bytes of an HLO result type string (handles tuples by
-    summing every dtype[shape] token)."""
-    total = 0
-    for m in _SHAPE_RE.finditer(type_str):
-        dt, dims = m.group(1), m.group(2)
-        item = _DTYPE_BYTES.get(dt)
-        if item is None:
-            continue
-        n = 1
-        if dims:
-            for d in dims.split(","):
-                n *= int(d)
-        total += n * item
-    return total
+def _hlolint_parser():
+    """The shared HLO parser (tools/hlolint) — imported lazily so the
+    package works from an installed layout too; when `tools` is not
+    already importable, fall back to the repo root this file lives in."""
+    try:
+        from tools.hlolint import parser as hparser
+    except ImportError:
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from tools.hlolint import parser as hparser
+    return hparser
 
 
 def parse_hlo_schedule(hlo_text: str) -> List[dict]:
@@ -248,28 +237,18 @@ def parse_hlo_schedule(hlo_text: str) -> List[dict]:
     an ordered instruction list.  Each entry:
     ``{"name", "kind", "bytes", "operands"}`` — operands include control
     predecessors (they are real scheduling dependencies).  Instruction
-    order in a scheduled module IS the schedule."""
-    out: List[dict] = []
-    in_entry = False
-    for line in hlo_text.splitlines():
-        if line.startswith("ENTRY"):
-            in_entry = True
-            continue
-        if not in_entry:
-            continue
-        if line.startswith("}"):
-            break
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        name, type_str, kind = m.group(1).lstrip("%"), m.group(2), m.group(3)
-        # operand/attribute names on the rest of the line; the result
-        # name itself may reappear in sharding attrs — drop it
-        rest = line[m.end():]
-        operands = {n for n in _NAME_RE.findall(rest) if n != name}
-        out.append({"name": name, "kind": kind,
-                    "bytes": _shape_bytes(type_str), "operands": operands})
-    return out
+    order in a scheduled module IS the schedule.
+
+    Thin adapter over the shared :mod:`tools.hlolint` parser (this
+    module used to carry its own regex parser; hlolint's IR replaced
+    it)."""
+    hparser = _hlolint_parser()
+    entry = hparser.parse_hlo(hlo_text).entry
+    if entry is None:
+        return []
+    return [{"name": ins.name, "kind": ins.opcode,
+             "bytes": ins.result_bytes, "operands": set(ins.operands)}
+            for ins in entry.instructions]
 
 
 def _descendants(instrs: List[dict], start: int) -> set:

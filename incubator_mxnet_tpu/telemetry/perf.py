@@ -52,6 +52,8 @@ from . import registry as _registry_mod
 
 __all__ = ["ProgramCost", "capture", "capture_compiled", "note_timing",
            "programs", "roofline_table", "clear",
+           "set_hlo_text_capture", "hlo_text_capture_enabled",
+           "program_hlo", "hlo_texts",
            "sample_device_memory", "per_device_bytes", "reset_peaks",
            "start_poller", "stop_poller"]
 
@@ -131,6 +133,60 @@ _programs: Dict[str, ProgramCost] = {}
 _lock = threading.Lock()
 _peaks_cache: Dict[str, float] = {}
 
+# ---- program text capture (the hlolint contract-gate feed) ----------- #
+# Off by default: program texts run to hundreds of KB and only the
+# contract gate / ad-hoc inspection wants them.  The same AOT compile
+# that feeds cost analysis serves them — no extra compilation.
+_hlo_texts: Dict[str, Dict[str, str]] = {}
+_hlo_text_capture: Optional[bool] = None
+
+
+def set_hlo_text_capture(on: Optional[bool]) -> None:
+    """Force program-text capture on/off (None = defer to the
+    ``MXTPU_HLO_TEXT_CAPTURE`` env)."""
+    global _hlo_text_capture
+    _hlo_text_capture = on
+
+
+def hlo_text_capture_enabled() -> bool:
+    if _hlo_text_capture is not None:
+        return _hlo_text_capture
+    import os
+
+    return os.environ.get("MXTPU_HLO_TEXT_CAPTURE", "").strip().lower() \
+        in ("1", "on", "true", "yes")
+
+
+def _store_hlo_text(program: str, compiled, lowered) -> None:
+    texts: Dict[str, str] = {}
+    try:
+        texts["hlo"] = compiled.as_text()
+    except Exception:
+        pass
+    if lowered is not None:
+        try:
+            texts["stablehlo"] = lowered.as_text()
+        except Exception:
+            pass
+    if texts:
+        with _lock:
+            _hlo_texts[program] = texts
+
+
+def program_hlo(program: str) -> Optional[Dict[str, str]]:
+    """Captured program texts for one program name:
+    ``{"hlo": <compiled/optimized text>, "stablehlo": <lowered MLIR>}``
+    (``stablehlo`` present only when the capture site had the lowered
+    stage in hand).  None when never captured."""
+    with _lock:
+        t = _hlo_texts.get(program)
+        return dict(t) if t else None
+
+
+def hlo_texts() -> Dict[str, Dict[str, str]]:
+    with _lock:
+        return {k: dict(v) for k, v in _hlo_texts.items()}
+
 
 def _peak_flops() -> float:
     v = _peaks_cache.get("flops")
@@ -167,13 +223,23 @@ def _cost_dict(compiled) -> dict:
     return dict(ca) if ca else {}
 
 
-def capture_compiled(program: str, compiled, sig=None) -> Optional[ProgramCost]:
+def capture_compiled(program: str, compiled, sig=None,
+                     lowered=None) -> Optional[ProgramCost]:
     """Record the cost/memory analysis of an already-compiled program
     under `program`; sets the per-program compile-time gauges.  Returns
     the record, or None (telemetry off / analysis unavailable — e.g. a
-    backend without cost-analysis support)."""
+    backend without cost-analysis support).
+
+    When program-text capture is on (`set_hlo_text_capture` /
+    ``MXTPU_HLO_TEXT_CAPTURE=1``) the compiled HLO text — and the
+    lowered StableHLO when the caller passes its ``lowered`` stage —
+    is stored for `program_hlo()`; tools/hlolint and ci/hlolint_gate.py
+    read contracts off it, so ONE AOT compile serves roofline, HLO
+    capture, and contract checking."""
     if not _registry_mod._enabled:
         return None
+    if hlo_text_capture_enabled():
+        _store_hlo_text(program, compiled, lowered)
     try:
         cost = _cost_dict(compiled)
     except Exception:
@@ -227,7 +293,7 @@ def capture(program: str, fn, *args, sig=None, force=False,
         compiled = lowered.compile()
     except Exception:
         return None
-    return capture_compiled(program, compiled, sig=sig)
+    return capture_compiled(program, compiled, sig=sig, lowered=lowered)
 
 
 def note_timing(program: Optional[str], seconds: float) -> None:
@@ -282,6 +348,7 @@ def clear() -> None:
     """Drop captured program records and peak caches (tests)."""
     with _lock:
         _programs.clear()
+        _hlo_texts.clear()
     _peaks_cache.clear()
     with _mem_lock:
         _peak_bytes.clear()

@@ -1,0 +1,123 @@
+"""Regenerate the committed hlolint HLO fixtures.
+
+Three small REAL lowered/compiled programs (not hand-written samples),
+so parser regressions surface against text XLA actually prints:
+
+* ``monolithic_step.hlo.txt``   — MLP data-parallel full step (D=8
+  virtual CPU devices, zero_stage=0): all-reduce gradient sync.
+* ``zero_bucketed_step.hlo.txt`` — same MLP, ZeRO explicit tier with
+  `zero_overlap=True` and a 0.002 MB bucket cap → 3 buckets, one
+  reduce-scatter each, and a populated ``input_output_alias`` header.
+* ``int8_decode.hlo.txt`` / ``int8_decode.stablehlo.txt`` — tiny
+  TransformerLM int8 weight-quantized greedy decode (single device):
+  s8 buffers, ``while`` loops, fusions; the StableHLO side carries the
+  ``tensor<...xi8>`` weight arg types.
+
+Run from the repo root (fixture text is jaxlib-version dependent;
+refresh deliberately, reviewing the test expectations alongside):
+
+    python tests/fixtures/hlolint/regen.py
+"""
+import os
+import sys
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                     "..", "..", ".."))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
+
+_FLAGS = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(
+    _FLAGS + ["--xla_force_host_platform_device_count=8"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import autograd, gluon  # noqa: E402
+from incubator_mxnet_tpu.gluon import nn  # noqa: E402
+from incubator_mxnet_tpu.models import generation as G  # noqa: E402
+from incubator_mxnet_tpu.models.transformer import TransformerLM  # noqa: E402
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray  # noqa: E402
+from incubator_mxnet_tpu.parallel import create_mesh  # noqa: E402
+
+
+class MLPWithLoss(gluon.nn.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.d1 = nn.Dense(64, activation="relu", in_units=32)
+        self.d2 = nn.Dense(8, in_units=64)
+        self.loss = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def forward(self, x, y):
+        return self.loss(self.d2(self.d1(x)), y).mean()
+
+
+def _train_hlo(zero_stage, zero_overlap=None):
+    np.random.seed(0)
+    mx.random.seed(0)
+    mesh = create_mesh(data=len(jax.devices()))
+    net = MLPWithLoss()
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1e-2, "momentum": 0.9},
+                       mesh=mesh, zero_stage=zero_stage,
+                       zero_overlap=zero_overlap, zero_bucket_mb=0.002)
+    tr._capture_hlo = True
+    with mesh:
+        for s in range(2):
+            rs = np.random.RandomState(s)
+            x = rs.randn(16, 32).astype(np.float32)
+            y = rs.randint(0, 8, (16,)).astype(np.int32)
+            with autograd.record():
+                loss = net(mx.nd.array(x), mx.nd.array(y))
+            loss.backward()
+            tr.step(16)
+    bks = tr._fullstep_ctx.get("zero_buckets")
+    return tr.last_step_hlo, bks
+
+
+def _decode_hlo():
+    V, C, DFF, L, H, MAXLEN = 31, 8, 16, 1, 2, 16
+    B, P, N = 1, 4, 4
+    mx.random.seed(0)
+    net = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
+                        num_heads=H, max_len=MAXLEN, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((1, 4), jnp.int32)))
+    net.cast("bfloat16")
+    net.quantize_for_decode(act_quant="none")
+    net.generate(np.zeros((B, P), dtype="int32"), N)
+    qc = net._decode_quant
+    fn = next(f for s, f in net._gen_programs.items()
+              if s[-2] == qc.cache_key())
+    params = G._gather_params(net, P + N, qc)
+    low = fn.lower(params, jnp.zeros((B, P), jnp.int32),
+                   jax.random.PRNGKey(0))
+    shapes = sorted(tuple(qc.packed(d)["w8"].shape)
+                    for d in qc._targets.values())
+    return low.as_text(), low.compile().as_text(), shapes
+
+
+def main():
+    mono, _ = _train_hlo(0)
+    zero, bks = _train_hlo(1, zero_overlap=True)
+    assert bks and len(bks) == 3, \
+        f"expected the 0.002 MB cap to make 3 buckets, got {bks}"
+    stablehlo, optimized, shapes = _decode_hlo()
+    for fname, text in (("monolithic_step.hlo.txt", mono),
+                        ("zero_bucketed_step.hlo.txt", zero),
+                        ("int8_decode.hlo.txt", optimized),
+                        ("int8_decode.stablehlo.txt", stablehlo)):
+        with open(os.path.join(_HERE, fname), "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"{fname}: {len(text)} bytes")
+    print(f"buckets={len(bks)} int8_weight_shapes={shapes}")
+
+
+if __name__ == "__main__":
+    main()

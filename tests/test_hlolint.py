@@ -1,0 +1,397 @@
+"""hlolint parser + fact-extractor + contract tests.
+
+Everything here runs against the committed fixtures under
+tests/fixtures/hlolint/ (real lowered/compiled programs — see
+regen.py there) plus small synthetic modules for the corner cases; NO
+test in this file invokes a compile, so parser regressions surface in
+milliseconds, not after a jit.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import hlolint
+from tools.hlolint import facts as hfacts
+from tools.hlolint import contracts as hcontracts
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "hlolint")
+
+
+def _read(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as fh:
+        return fh.read()
+
+
+@pytest.fixture(scope="module")
+def mono():
+    return hlolint.parse_hlo(_read("monolithic_step.hlo.txt"))
+
+
+@pytest.fixture(scope="module")
+def zero():
+    return hlolint.parse_hlo(_read("zero_bucketed_step.hlo.txt"))
+
+
+@pytest.fixture(scope="module")
+def int8():
+    return hlolint.parse_hlo(_read("int8_decode.hlo.txt"))
+
+
+@pytest.fixture(scope="module")
+def int8_stablehlo():
+    return hlolint.parse_stablehlo(_read("int8_decode.stablehlo.txt"))
+
+
+# the int8 fixture's quantized weight shapes (regen.py prints them)
+INT8_WEIGHT_SHAPES = [(8, 8), (8, 16), (16, 8), (24, 8)]
+
+
+# --------------------------------------------------------------------- #
+# parser: real fixtures
+# --------------------------------------------------------------------- #
+class TestParserFixtures:
+    def test_header(self, mono, zero):
+        assert mono.is_scheduled and zero.is_scheduled
+        assert mono.num_partitions == 8
+        assert zero.num_partitions == 8
+        assert mono.entry is not None and mono.entry.is_entry
+
+    def test_every_computation_parses(self, mono, zero, int8):
+        # one parsed computation per textual head — a head the parser
+        # chokes on silently drops its whole body (that bug hid a
+        # `while` once)
+        for name in ("monolithic_step.hlo.txt", "zero_bucketed_step.hlo.txt",
+                     "int8_decode.hlo.txt"):
+            text = _read(name)
+            raw_heads = sum(
+                1 for line in text.splitlines()
+                if line.rstrip().endswith("{") and "->" in line
+                and not line.startswith("HloModule"))
+            parsed = hlolint.parse_hlo(text)
+            assert len(parsed.computations) == raw_heads, name
+
+    def test_alias_header(self, zero, mono):
+        # the bucketed ZeRO step donates weights+states: 9 aliased
+        # inputs in the fixture; the alias list's nested braces must
+        # not truncate the parse
+        assert len(zero.input_output_alias) == 9
+        out_idx, param, p_idx, kind = zero.input_output_alias[0]
+        assert out_idx == (0,) and param == 7 and kind == "may-alias"
+        # the monolithic step donates too (weights + optimizer state)
+        assert len(mono.input_output_alias) == 9
+
+    def test_instruction_shape_bytes(self, mono):
+        root = mono.entry.root
+        assert root is not None and root.is_root
+        # entry params have known byte sizes
+        p_bytes = sum(i.result_bytes for i in mono.entry.parameters())
+        assert p_bytes > 0
+
+    def test_collectives_and_async_pairs(self, zero):
+        colls = list(zero.collectives())
+        kinds = {c.opcode for c in colls}
+        assert "reduce-scatter" in kinds
+        for c in colls:
+            if c.opcode.endswith("-start"):
+                continue
+            assert c.attrs.get("replica_groups") is not None
+
+    def test_while_bodies_counted(self, int8):
+        stats = hfacts.while_fusion_stats(int8)
+        assert stats["while"] == 3
+        assert stats["fusion"] > 0
+        assert stats["max_fusion_instructions"] > 1
+
+
+# --------------------------------------------------------------------- #
+# parser: synthetic corner cases
+# --------------------------------------------------------------------- #
+_SYNTH = """\
+HloModule synth, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, must-alias) }, num_partitions=8
+
+%add_comp (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64], p1: f32[64], p2: f64[4]) -> (f32[64], f32[8]) {
+  %p0 = f32[64]{0} parameter(0)
+  %p1 = f32[64]{0} parameter(1)
+  %p2 = f64[4]{0} parameter(2)
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %p0), channel_id=1, replica_groups=[2,4]<=[8], use_global_device_ids=true, to_apply=%add_comp
+  %rs-start = f32[8]{0} reduce-scatter-start(f32[64]{0} %p1), channel_id=2, replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add_comp
+  %cp = f32[64]{0} collective-permute(f32[64]{0} %ar), channel_id=3, source_target_pairs={{0,4},{4,0},{1,5},{5,1}}
+  %red = bf16[8]{0} reduce(f32[64]{0} %cp, f32[] %p0), dimensions={0}, to_apply=%add_comp
+  %outfeed = token[] outfeed(f32[64]{0} %cp)
+  %rs = f32[8]{0} reduce-scatter-done(f32[8]{0} %rs-start)
+  ROOT %t = (f32[64], f32[8]) tuple(f32[64]{0} %ar, f32[8]{0} %rs)
+}
+"""
+
+
+class TestParserSynthetic:
+    @pytest.fixture(scope="class")
+    def mod(self):
+        return hlolint.parse_hlo(_SYNTH)
+
+    def test_alias_kinds(self, mod):
+        assert mod.input_output_alias == [
+            ((0,), 0, (), "may-alias"), ((1,), 2, (), "must-alias")]
+
+    def test_iota_replica_groups(self, mod):
+        ar = mod.entry.by_name["ar"]
+        groups = ar.replica_group_members(8)
+        assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_explicit_replica_groups(self, mod):
+        rs = mod.entry.by_name["rs-start"]
+        assert rs.replica_group_members(8) == [[0, 1, 2, 3, 4, 5, 6, 7]]
+
+    def test_async_pairing_by_operand_not_name(self, mod):
+        pairs = mod.async_pairs()
+        assert [(s.name, d.name) for s, d in pairs] == [("rs-start", "rs")]
+
+    def test_axis_attribution(self, mod):
+        inv = hfacts.collective_inventory(
+            mod, axis_order=["data", "model"],
+            axis_sizes={"data": 2, "model": 4})
+        # iota [2,4]<=[8]: group {0..3} = stride-1 members -> model axis
+        assert inv["per_axis"]["all-reduce[model]"]["count"] == 1
+        # full group {0..7} spans both axes
+        assert inv["per_axis"]["reduce-scatter[data+model]"]["count"] == 1
+        # permute pairs step |4| = data stride
+        assert inv["per_axis"]["collective-permute[data]"]["count"] == 1
+        assert inv["n_async"] == 1
+
+    def test_async_bytes_counted_once(self, mod):
+        inv = hfacts.collective_inventory(mod)
+        # reduce-scatter: only the -start half counts (f32[8] = 32 B),
+        # the -done consumes it and must not double-count
+        assert inv["per_op"]["reduce-scatter"] == {"count": 1, "bytes": 32}
+
+    def test_f64_flag_and_census(self, mod):
+        census = hfacts.dtype_census(mod)
+        assert census["has_f64"]
+        assert census["dtypes"]["f64"]["bytes"] == 32
+
+    def test_sub_f32_accumulator(self, mod):
+        accs = hfacts.reduction_accumulators(mod)
+        assert [a["instruction"] for a in accs] == ["red"]
+        assert accs[0]["dtype"] == "bf16"
+
+    def test_host_transfers(self, mod):
+        ht = hfacts.host_transfers(mod)
+        assert ht["count"] == 1
+        assert ht["ops"][0]["opcode"] == "outfeed"
+
+    def test_empty_replica_groups_means_all(self):
+        text = _SYNTH.replace("replica_groups={{0,1,2,3,4,5,6,7}}",
+                              "replica_groups={}")
+        mod = hlolint.parse_hlo(text)
+        rs = mod.entry.by_name["rs-start"]
+        assert rs.replica_group_members(8) == [[0, 1, 2, 3, 4, 5, 6, 7]]
+
+    def test_float_weight_materialization_detects(self):
+        text = _SYNTH.replace("%cp = f32[64]{0}", "%cp = bf16[16,4]{1,0}")
+        mod = hlolint.parse_hlo(text)
+        hits = hfacts.float_weight_materializations(mod, [(4, 16)])
+        assert len(hits) == 1 and hits[0]["shape"] == [16, 4]
+
+
+# --------------------------------------------------------------------- #
+# StableHLO view
+# --------------------------------------------------------------------- #
+class TestStableHlo:
+    def test_i8_census(self, int8_stablehlo):
+        dts = int8_stablehlo.dtypes()
+        assert dts.get("s8", 0) > 0
+        assert dts.get("bf16", 0) > 0
+
+    def test_weight_arg_types_seen(self, int8_stablehlo):
+        # the signature line carries the packed s8 weight arg types
+        for dims in INT8_WEIGHT_SHAPES:
+            shapes = int8_stablehlo.shapes_with_dims(dims)
+            assert any(sh.dtype == "s8" for sh in shapes), dims
+
+    def test_no_donation_in_decode(self, int8_stablehlo):
+        assert int8_stablehlo.donated_args == []
+
+    def test_donor_attrs_synthetic(self):
+        text = (
+            "module @jit_f attributes {mhlo.num_partitions = 1 : i32} {\n"
+            "  func.func public @main(%arg0: tensor<64xf32>, "
+            "%arg1: tensor<64xf32> {jax.buffer_donor = true}, "
+            "%arg2: tensor<4x2xf32> {tf.aliasing_output = 0 : i32}) "
+            "-> (tensor<64xf32>) {\n"
+            "    %0 = stablehlo.add %arg0, %arg1 : tensor<64xf32>\n"
+            "    return %0 : tensor<64xf32>\n"
+            "  }\n"
+            "}\n")
+        smod = hlolint.parse_stablehlo(text)
+        assert smod.donated_args == [1, 2]
+        assert smod.aliased_args == [2]
+        assert smod.dtypes()["f32"] >= 5
+
+    def test_donation_coverage(self):
+        hlo = ("HloModule jit_f, is_scheduled=true, "
+               "input_output_alias={ {0}: (1, {}, may-alias) }\n\n"
+               "ENTRY %main (p0: f32[64], p1: f32[64]) -> f32[64] {\n"
+               "  %p0 = f32[64]{0} parameter(0)\n"
+               "  %p1 = f32[64]{0} parameter(1)\n"
+               "  ROOT %add = f32[64]{0} add(f32[64]{0} %p0, f32[64]{0} %p1)\n"
+               "}\n")
+        sh = ("module @jit_f {\n"
+              "  func.func public @main(%arg0: tensor<64xf32> "
+              "{jax.buffer_donor = true}, %arg1: tensor<64xf32> "
+              "{jax.buffer_donor = true}) -> (tensor<64xf32>) {\n"
+              "    return %arg0 : tensor<64xf32>\n  }\n}\n")
+        don = hfacts.donation(hlolint.parse_hlo(hlo),
+                              hlolint.parse_stablehlo(sh))
+        # 2 donated, 1 actually aliased -> coverage 0.5
+        assert don == {"aliased": 1, "aliased_params": [1],
+                       "donated": 2, "coverage": 0.5}
+
+
+# --------------------------------------------------------------------- #
+# fact summaries over the fixtures (what the CI gate consumes)
+# --------------------------------------------------------------------- #
+class TestFixtureFacts:
+    def test_mono_collectives(self, mono):
+        s = hlolint.fact_summary(mono, axis_order=["data"],
+                                 axis_sizes={"data": 8})
+        per_op = s["collectives"]["per_op"]
+        assert per_op["all-reduce"]["count"] > 0
+        assert "reduce-scatter" not in per_op
+        assert set(s["collectives"]["per_axis"]) == {"all-reduce[data]"}
+        assert not s["dtypes"]["has_f64"]
+        assert s["host_transfers"]["count"] == 0
+
+    def test_zero_bucketed_contract_facts(self, zero):
+        # the properties the committed contract pins: one
+        # reduce-scatter per bucket (fixture has 3), residual
+        # all-reduce tiny, full donation aliasing
+        s = hlolint.fact_summary(zero, axis_order=["data"],
+                                 axis_sizes={"data": 8})
+        per_op = s["collectives"]["per_op"]
+        assert per_op["reduce-scatter"]["count"] == 3
+        assert per_op["all-reduce"]["bytes"] <= 64
+        assert per_op["all-gather"]["count"] <= 6
+        assert s["donation"]["aliased"] == 9
+
+    def test_int8_decode_facts(self, int8, int8_stablehlo):
+        s = hlolint.fact_summary(int8, stablehlo=int8_stablehlo,
+                                 weight_shapes=INT8_WEIGHT_SHAPES)
+        assert "s8" in s["dtypes"]["dtypes"]
+        assert s["weights"]["float_materializations"] == []
+        assert s["sub_f32_accumulators"] == []
+        assert s["stats"]["while"] == 3
+        # act_quant="none" StableHLO carries the dequant converts (f32
+        # weight-shaped tensors) — they fuse away in the optimized HLO,
+        # which is exactly why the bf16-materialization gate runs there
+        assert s["stablehlo"]["dtypes"]["s8"] > 0
+
+    def test_schedule_stats_via_shared_parser(self):
+        # overlap.py's analyzer now rides the same IR: one collective
+        # per bucket on the zero fixture
+        from incubator_mxnet_tpu.parallel import overlap
+
+        st = overlap.schedule_overlap_stats(
+            _read("zero_bucketed_step.hlo.txt"))
+        assert st["n_collectives"] == 3
+        assert 0.0 <= st["overlap_fraction"] <= 1.0
+
+
+# --------------------------------------------------------------------- #
+# contracts
+# --------------------------------------------------------------------- #
+class TestContracts:
+    def _facts(self, mono):
+        return {"prog": hlolint.fact_summary(
+            mono, axis_order=["data"], axis_sizes={"data": 8})}
+
+    def test_pass_and_fail(self, mono):
+        facts = self._facts(mono)
+        contracts = {"version": 1, "programs": {"prog": {"checks": [
+            {"rule": "HLO003",
+             "expr": "collective_count('all-reduce') > 0"}]}}}
+        v, unc = hcontracts.evaluate(contracts, facts)
+        assert v == [] and unc == []
+        contracts["programs"]["prog"]["checks"][0]["expr"] = \
+            "collective_count('all-reduce') == 0"
+        v, _ = hcontracts.evaluate(contracts, facts)
+        assert len(v) == 1
+        r = v[0].render()
+        assert "prog" in r and "HLO003" in r and "per_op" in r
+
+    def test_uncontracted_vs_accepted(self, mono):
+        facts = self._facts(mono)
+        v, unc = hcontracts.evaluate({"programs": {}}, facts)
+        assert unc == ["prog"]
+        v, unc = hcontracts.evaluate(
+            {"programs": {}, "accepted": ["prog"]}, facts)
+        assert unc == []
+
+    def test_default_checks_apply_everywhere(self):
+        mod = hlolint.parse_hlo(_SYNTH)  # has f64 + an outfeed
+        facts = {"prog": hlolint.fact_summary(mod)}
+        v, _ = hcontracts.evaluate(
+            {"programs": {}, "accepted": ["prog"]}, facts)
+        assert {x.rule for x in v} == {"HLO001", "HLO005"}
+
+    def test_bad_expr_is_a_violation_not_a_pass(self, mono):
+        facts = self._facts(mono)
+        contracts = {"programs": {"prog": {"checks": [
+            {"rule": "HLO003", "expr": "no_such_name > 0"}]}}}
+        v, _ = hcontracts.evaluate(contracts, facts)
+        assert len(v) == 1 and "NameError" in v[0].observed
+
+    def test_ctx_and_cross_program(self, mono, zero):
+        facts = {
+            "mono": hlolint.fact_summary(mono),
+            "zero": hlolint.fact_summary(zero),
+        }
+        contracts = {"programs": {
+            "mono": {"checks": [
+                {"rule": "HLO003",
+                 "expr": "collective_count('all-reduce') == ctx['n_ar']"}]},
+            "zero": {"checks": [
+                {"rule": "HLO003",
+                 "expr": "param_bytes < programs['mono']['entry']"
+                         "['param_bytes']"}]},
+        }}
+        n_ar = facts["mono"]["collectives"]["per_op"]["all-reduce"]["count"]
+        v, unc = hcontracts.evaluate(contracts, facts,
+                                     ctx={"n_ar": n_ar})
+        assert v == [] and unc == []
+
+    def test_bootstrap_roundtrip(self, zero):
+        facts = {"zero": hlolint.fact_summary(
+            zero, axis_order=["data"], axis_sizes={"data": 8})}
+        doc = hcontracts.bootstrap_contracts(facts)
+        v, unc = hcontracts.evaluate(doc, facts)
+        assert v == [] and unc == []
+
+    def test_committed_contract_file_is_wellformed(self):
+        path = os.path.join(os.path.dirname(FIXTURES), "..", "..",
+                            ".hlolint_contracts.json")
+        doc = hcontracts.load_contracts(path)
+        assert doc["version"] == 1
+        names = set(doc["programs"])
+        assert {"trainer_full_step", "trainer_full_step_zero_bucketed",
+                "decode_float", "decode_int8"} <= names
+        for prog in doc["programs"].values():
+            for chk in prog["checks"]:
+                assert chk["rule"] in hcontracts.RULES
+                compile(chk["expr"], "<contract>", "eval")
+
+    def test_load_rejects_garbage(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"not": "contracts"}))
+        with pytest.raises(ValueError):
+            hcontracts.load_contracts(str(p))

@@ -230,6 +230,69 @@ def test_baseline_round_trip(tmp_path):
     assert bl.filter_new(bl.fingerprint_findings(other), accepted)
 
 
+def test_baseline_survives_file_rename(tmp_path):
+    # seed against one path, re-lint the SAME content under another:
+    # the fingerprint (which hashes the path) misses, the cross-path
+    # second pass absorbs every finding
+    old = tmp_path / "old_name.py"
+    shutil.copy(os.path.join(FIXDIR, "tpu001_case.py"), old)
+    _, findings = run([str(old)])
+    assert findings
+    base = tmp_path / "base.json"
+    bl.write_baseline(str(base), findings)
+    entries = bl.load_baseline_entries(str(base))
+
+    new = tmp_path / "renamed.py"
+    old.rename(new)
+    _, moved = run([str(new)])
+    pairs = bl.fingerprint_findings(moved)
+    # exact pass alone would report everything as new...
+    assert len(bl.filter_new(pairs, {e["fingerprint"]
+                                     for e in entries})) == len(moved)
+    # ...the rename-tolerant pass absorbs it all
+    survivors, n_exact, n_renamed = bl.filter_new_with_renames(pairs, entries)
+    assert survivors == [] and n_exact == 0 and n_renamed == len(moved)
+
+
+def test_rename_pass_is_multiset_not_wildcard(tmp_path):
+    # one baselined finding cannot absorb TWO findings with the same
+    # (rule, function, line-text) — each entry is consumable once
+    src = ("import jax\nimport numpy as np\n\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    x = np.tanh(x)\n"
+           "    return x\n")
+    old = tmp_path / "one.py"
+    old.write_text(src)
+    _, findings = run([str(old)])
+    assert len(findings) == 1
+    base = tmp_path / "base.json"
+    bl.write_baseline(str(base), findings)
+    entries = bl.load_baseline_entries(str(base))
+
+    dup = tmp_path / "two.py"
+    dup.write_text(src.replace("    x = np.tanh(x)\n",
+                               "    x = np.tanh(x)\n    x = np.tanh(x)\n"))
+    old.unlink()
+    _, moved = run([str(dup)])
+    assert len(moved) == 2
+    survivors, n_exact, n_renamed = bl.filter_new_with_renames(
+        bl.fingerprint_findings(moved), entries)
+    assert n_exact == 0 and n_renamed == 1 and len(survivors) == 1
+
+
+def test_cli_baseline_gate_tolerates_rename(tmp_path):
+    case = tmp_path / "case.py"
+    shutil.copy(os.path.join(FIXDIR, "tpu001_case.py"), case)
+    seed = _cli(["case.py", "--write-baseline", "--no-cache"], tmp_path)
+    assert seed.returncode == 0, seed.stderr
+    case.rename(tmp_path / "moved.py")
+    gate = _cli(["moved.py", "--baseline", ".tpulint_baseline.json",
+                 "--no-cache"], tmp_path)
+    assert gate.returncode == 0, gate.stdout + gate.stderr
+    assert "matched cross-path" in gate.stderr
+
+
 def test_cli_baseline_gate_fails_only_on_new(tmp_path):
     case = tmp_path / "case.py"
     shutil.copy(os.path.join(FIXDIR, "tpu001_case.py"), case)

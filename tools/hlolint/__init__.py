@@ -1,0 +1,50 @@
+"""hlolint — compiled-program contract checker over HLO / StableHLO.
+
+tpulint (tools/tpulint/) guards the Python side; the properties that
+actually decide TPU performance and correctness — which collectives a
+program issues, whether int8 weights stay int8, whether donated buffers
+alias, whether comms are async — live in the *compiled* artifact.
+hlolint is the static analyzer for that artifact:
+
+* :mod:`.parser` — ONE shared parser turning compiled/optimized HLO
+  text and lowered StableHLO (MLIR) into a structured module IR:
+  computations, instructions with opcode/shape/dtype/operands,
+  collective attributes (replica_groups, channel_id,
+  use_global_device_ids, source_target_pairs), async start/done
+  pairing, fusion bodies, and input/output aliasing from donation.  It
+  replaces the three ad-hoc regex/grep inspectors the repo grew
+  (``__graft_entry__`` dryrun collective counts, ``parallel/overlap.py``
+  schedule parsing, ``ci/quantized_decode_smoke.py`` substring asserts).
+* :mod:`.facts` — fact extractors over the IR: per-program collective
+  inventory (count + bytes by op and mesh axis, via replica-group
+  factorization against the active mesh), dtype census, host-transfer
+  ops, donation coverage, while/fusion stats, float-weight
+  materialization checks.
+* :mod:`.contracts` — declarative per-program contracts
+  (``.hlolint_contracts.json``, rules HLO001–HLO006) evaluated against
+  the facts; ``ci/hlolint_gate.py`` compiles the repo's flagship
+  programs and gates them in ci/lint.sh.
+
+CLI: ``python -m tools.hlolint facts FILE.hlo`` for ad-hoc inspection,
+``python -m tools.hlolint check --contracts ... --facts ...`` for the
+gate.  See docs/static_analysis.md ("compiled-program contracts").
+"""
+from .parser import (HloComputation, HloInstruction, HloModule, Shape,
+                     StableHloModule, parse_hlo, parse_stablehlo)
+from . import facts
+from .facts import (collective_inventory, donation, dtype_census,
+                    fact_summary, float_weight_materializations,
+                    host_transfers, reduction_accumulators,
+                    stablehlo_census, while_fusion_stats)
+from .contracts import (RULES, ContractViolation, bootstrap_contracts,
+                        evaluate, load_contracts)
+
+__all__ = [
+    "HloModule", "HloComputation", "HloInstruction", "Shape",
+    "StableHloModule", "parse_hlo", "parse_stablehlo",
+    "collective_inventory", "dtype_census", "donation", "host_transfers",
+    "while_fusion_stats", "float_weight_materializations",
+    "reduction_accumulators", "stablehlo_census", "fact_summary",
+    "RULES", "ContractViolation", "load_contracts", "evaluate",
+    "bootstrap_contracts",
+]

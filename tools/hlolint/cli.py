@@ -1,0 +1,106 @@
+"""hlolint command line.
+
+``python -m tools.hlolint facts step.hlo [--stablehlo step.mlir] ...``
+    Parse one program's artifacts and print its fact summary as JSON —
+    the ad-hoc inspection path ("what collectives does this program
+    actually issue?").
+
+``python -m tools.hlolint check --contracts .hlolint_contracts.json \\
+      --facts facts.json [--ctx ctx.json]``
+    Evaluate pre-extracted fact summaries (a JSON dict program →
+    summary, e.g. dumped by ci/hlolint_gate.py) against a contract
+    file.  Exit 1 on any violation or un-contracted program.
+
+The CI gate itself lives in ci/hlolint_gate.py because it must COMPILE
+the repo's flagship programs first; this module stays compile-free.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import contracts as _contracts
+from . import facts as _facts
+from .parser import parse_hlo, parse_stablehlo
+
+
+def _read(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _cmd_facts(args: argparse.Namespace) -> int:
+    module = parse_hlo(_read(args.hlo))
+    smod = parse_stablehlo(_read(args.stablehlo)) if args.stablehlo else None
+    axis_order = axis_sizes = None
+    if args.mesh:
+        # --mesh data=4,model=2 (order as written)
+        axis_sizes = {}
+        for part in args.mesh.split(","):
+            k, _, v = part.partition("=")
+            axis_sizes[k.strip()] = int(v)
+        axis_order = list(axis_sizes)
+    weight_shapes = []
+    if args.weight_shapes:
+        weight_shapes = [tuple(int(d) for d in w.split("x"))
+                         for w in args.weight_shapes.split(",")]
+    summary = _facts.fact_summary(module, stablehlo=smod,
+                                  axis_order=axis_order,
+                                  axis_sizes=axis_sizes,
+                                  weight_shapes=weight_shapes)
+    json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+def _cmd_check(args: argparse.Namespace) -> int:
+    contracts = _contracts.load_contracts(args.contracts)
+    with open(args.facts, "r", encoding="utf-8") as fh:
+        facts_by_program = json.load(fh)
+    ctx = {}
+    if args.ctx:
+        with open(args.ctx, "r", encoding="utf-8") as fh:
+            ctx = json.load(fh)
+    violations, uncontracted = _contracts.evaluate(
+        contracts, facts_by_program, ctx=ctx)
+    for v in violations:
+        print(v.render())
+    for name in uncontracted:
+        print(f"{name}: HLO000 ({_contracts.RULES['HLO000']}) — add a "
+              "contract under 'programs' or list it under 'accepted'")
+    n = len(violations) + len(uncontracted)
+    print(f"hlolint: {len(facts_by_program)} program(s), "
+          f"{len(violations)} violation(s), "
+          f"{len(uncontracted)} un-contracted")
+    return 1 if n else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hlolint",
+        description="compiled-program contract checker over HLO/StableHLO")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    fp = sub.add_parser("facts", help="print one program's fact summary")
+    fp.add_argument("hlo", help="compiled/optimized HLO text file")
+    fp.add_argument("--stablehlo", help="lowered StableHLO (MLIR) file")
+    fp.add_argument("--mesh", help="mesh axes, e.g. data=4,model=2")
+    fp.add_argument("--weight-shapes",
+                    help="quantized weight shapes, e.g. 96x32,32x96")
+    fp.set_defaults(func=_cmd_facts)
+
+    cp = sub.add_parser("check", help="evaluate contracts against facts")
+    cp.add_argument("--contracts", required=True)
+    cp.add_argument("--facts", required=True,
+                    help="JSON dict: program name -> fact summary")
+    cp.add_argument("--ctx", help="JSON dict of contract context values")
+    cp.set_defaults(func=_cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,259 @@
+"""Declarative per-program contracts over hlolint fact summaries.
+
+A contract file (``.hlolint_contracts.json`` at the repo root) pins
+what a compiled program is ALLOWED to look like:
+
+.. code-block:: json
+
+    {
+      "version": 1,
+      "programs": {
+        "trainer_full_step_zero_bucketed": {
+          "checks": [
+            {"rule": "HLO003",
+             "expr": "collective_count('reduce-scatter') == ctx['n_buckets']",
+             "note": "one reduce-scatter per gradient bucket"},
+            {"rule": "HLO004", "expr": "donation_coverage >= 0.9"}
+          ]
+        }
+      },
+      "accepted": ["some_legacy_program"]
+    }
+
+``expr`` is a python expression evaluated (restricted: no builtins
+beyond a safe whitelist, no attribute access on modules) against the
+program's fact summary (see :func:`namespace_for`): the raw ``facts``
+dict plus flat convenience names (``collective_count(op)``,
+``donation_coverage``, ``has_f64``, ``param_bytes``, ...), the gate's
+``ctx`` dict (mesh size, bucket count, grad bytes, ...), and
+``programs`` — every captured summary by name, for cross-program bounds
+like ``param_bytes < 0.75 * programs['decode_float']['entry']['param_bytes']``.
+
+The gate is tpulint-style two-sided: a contracted program FAILS on any
+violated check; a program with facts but NO contract is a NEW
+un-contracted regression unless listed under ``accepted`` (default
+rules HLO001/HLO005 still apply to accepted programs).  Bootstrap a
+contract skeleton from live facts with
+``ci/hlolint_gate.py --write-contracts``.
+
+Rule catalog (docs/static_analysis.md has the long form):
+
+========  ==========================================================
+HLO001    f64 (or c128) appears anywhere in the program
+HLO002    float materialization of a quantized/bf16 weight shape
+HLO003    collective budget: count/bytes per op vs contract bound
+HLO004    donation coverage below bound (donated input not aliased)
+HLO005    host-transfer op in a steady-state program
+HLO006    reduction accumulating in a sub-f32 float (bf16/f16/f8)
+HLO000    un-contracted program (meta-rule for the baseline gate)
+========  ==========================================================
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+RULES: Dict[str, str] = {
+    "HLO000": "program captured by the gate but has no contract",
+    "HLO001": "f64/c128 dtype present in compiled program",
+    "HLO002": "float materialization of a quantized weight",
+    "HLO003": "collective budget violated",
+    "HLO004": "donation coverage below bound",
+    "HLO005": "host-transfer op in steady-state program",
+    "HLO006": "sub-f32 reduction accumulator",
+}
+
+#: checks applied to EVERY captured program, contracted or accepted.
+DEFAULT_CHECKS: List[Dict[str, str]] = [
+    {"rule": "HLO001", "expr": "not has_f64",
+     "note": "f64 doubles bytes and runs at deci-rate on TPU"},
+    {"rule": "HLO005", "expr": "host_transfer_count == 0",
+     "note": "host round-trips stall the device every step"},
+]
+
+_SAFE_BUILTINS = {"abs": abs, "min": min, "max": max, "len": len,
+                  "sum": sum, "any": any, "all": all, "round": round,
+                  "sorted": sorted, "set": set, "float": float,
+                  "int": int, "bool": bool, "True": True,
+                  "False": False, "None": None}
+
+
+@dataclass
+class ContractViolation:
+    program: str
+    rule: str
+    expr: str
+    note: str = ""
+    observed: str = ""
+
+    def render(self) -> str:
+        head = f"{self.program}: {self.rule} ({RULES.get(self.rule, '?')})"
+        lines = [head, f"    check : {self.expr}"]
+        if self.note:
+            lines.append(f"    note  : {self.note}")
+        if self.observed:
+            lines.append(f"    facts : {self.observed}")
+        return "\n".join(lines)
+
+
+def load_contracts(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "programs" not in doc:
+        raise ValueError(f"{path}: not a hlolint contract file "
+                         "(missing 'programs')")
+    return doc
+
+
+def namespace_for(facts: Dict[str, Any],
+                  ctx: Optional[Dict[str, Any]] = None,
+                  programs: Optional[Dict[str, Dict]] = None
+                  ) -> Dict[str, Any]:
+    """The evaluation namespace one program's checks see."""
+    coll = facts.get("collectives", {})
+    per_op = coll.get("per_op", {})
+
+    def collective_count(op: str) -> int:
+        return int(per_op.get(op, {}).get("count", 0))
+
+    def collective_bytes(op: str) -> int:
+        return int(per_op.get(op, {}).get("bytes", 0))
+
+    don = facts.get("donation", {})
+    weights = facts.get("weights", {})
+    ns: Dict[str, Any] = dict(_SAFE_BUILTINS)
+    ns.update({
+        "facts": facts,
+        "ctx": dict(ctx or {}),
+        "programs": dict(programs or {}),
+        "collectives": coll,
+        "collective_count": collective_count,
+        "collective_bytes": collective_bytes,
+        "total_collective_bytes": int(coll.get("total_bytes", 0)),
+        "n_async_collectives": int(coll.get("n_async", 0)),
+        "dtypes": facts.get("dtypes", {}).get("dtypes", {}),
+        "has_f64": bool(facts.get("dtypes", {}).get("has_f64", False)),
+        "sub_f32_accumulators":
+            len(facts.get("sub_f32_accumulators", [])),
+        "host_transfer_count":
+            int(facts.get("host_transfers", {}).get("count", 0)),
+        "donation_coverage": don.get("coverage"),
+        "donated_inputs": don.get("donated"),
+        "aliased_inputs": don.get("aliased"),
+        "float_weight_materializations":
+            len(weights.get("float_materializations", [])),
+        "stablehlo_float_weight_tensors":
+            len(facts.get("stablehlo", {}).get("float_weight_tensors", [])),
+        "param_bytes": int(facts.get("entry", {}).get("param_bytes", 0)),
+        "output_bytes": int(facts.get("entry", {}).get("output_bytes", 0)),
+        "n_while": int(facts.get("stats", {}).get("while", 0)),
+        "n_fusion": int(facts.get("stats", {}).get("fusion", 0)),
+        "num_partitions": int(facts.get("num_partitions", 1)),
+    })
+    return ns
+
+
+def _observed(ns: Dict[str, Any], expr: str) -> str:
+    """Names from the namespace that appear in the failing expr, with
+    their current values — the per-rule diagnostic payload."""
+    shown = []
+    for name in ("collectives", "donation_coverage", "donated_inputs",
+                 "aliased_inputs", "has_f64", "host_transfer_count",
+                 "sub_f32_accumulators", "float_weight_materializations",
+                 "stablehlo_float_weight_tensors", "param_bytes",
+                 "output_bytes", "total_collective_bytes",
+                 "n_async_collectives", "n_while", "n_fusion",
+                 "num_partitions"):
+        if name in expr:
+            shown.append(f"{name}={ns.get(name)!r}")
+    if "ctx[" in expr or "ctx." in expr:
+        shown.append(f"ctx={ns.get('ctx')!r}")
+    if "collective_count(" in expr or "collective_bytes(" in expr:
+        shown.append(f"per_op={ns.get('collectives', {}).get('per_op')!r}")
+    return ", ".join(shown)
+
+
+def _run_checks(program: str, checks: List[Dict[str, Any]],
+                ns: Dict[str, Any]) -> List[ContractViolation]:
+    out = []
+    for chk in checks:
+        expr = chk.get("expr", "")
+        rule = chk.get("rule", "HLO003")
+        note = chk.get("note", "")
+        try:
+            ok = bool(eval(expr, {"__builtins__": {}}, ns))  # noqa: S307
+        except Exception as exc:  # bad expr IS a violation, not a pass
+            out.append(ContractViolation(
+                program=program, rule=rule, expr=expr, note=note,
+                observed=f"check raised {type(exc).__name__}: {exc}"))
+            continue
+        if not ok:
+            out.append(ContractViolation(
+                program=program, rule=rule, expr=expr, note=note,
+                observed=_observed(ns, expr)))
+    return out
+
+
+def evaluate(contracts: Dict[str, Any],
+             facts_by_program: Dict[str, Dict[str, Any]],
+             ctx: Optional[Dict[str, Any]] = None
+             ) -> Tuple[List[ContractViolation], List[str]]:
+    """Check every captured program against the contract file.
+
+    Returns ``(violations, uncontracted)``: violations from contracted
+    programs' checks plus the DEFAULT_CHECKS everyone gets, and the
+    names of captured programs with neither a contract nor an
+    ``accepted`` entry (the HLO000 baseline half of the gate).
+    """
+    prog_contracts = contracts.get("programs", {})
+    accepted = set(contracts.get("accepted", []))
+    violations: List[ContractViolation] = []
+    uncontracted: List[str] = []
+    for name in sorted(facts_by_program):
+        facts = facts_by_program[name]
+        ns = namespace_for(facts, ctx=ctx, programs=facts_by_program)
+        violations.extend(_run_checks(name, DEFAULT_CHECKS, ns))
+        entry = prog_contracts.get(name)
+        if entry is None:
+            if name not in accepted:
+                uncontracted.append(name)
+            continue
+        violations.extend(_run_checks(name, entry.get("checks", []), ns))
+    return violations, uncontracted
+
+
+def bootstrap_contracts(facts_by_program: Dict[str, Dict[str, Any]],
+                        ctx: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+    """Generate a contract skeleton from live facts: pins each
+    program's current collective counts, donation coverage (when
+    known), and weight-materialization cleanliness.  Review and tighten
+    before committing — a bootstrap records what IS, a contract should
+    say what MUST BE."""
+    programs: Dict[str, Any] = {}
+    for name in sorted(facts_by_program):
+        facts = facts_by_program[name]
+        checks: List[Dict[str, str]] = []
+        per_op = facts.get("collectives", {}).get("per_op", {})
+        for op in sorted(per_op):
+            checks.append({
+                "rule": "HLO003",
+                "expr": f"collective_count({op!r}) == {per_op[op]['count']}",
+                "note": "bootstrap: pinned observed count"})
+        cov = facts.get("donation", {}).get("coverage")
+        if cov is not None:
+            bound = 0.9 if cov >= 0.9 else round(cov - 0.05, 2)
+            checks.append({"rule": "HLO004",
+                           "expr": f"donation_coverage >= {bound}",
+                           "note": "bootstrap: donated inputs must alias"})
+        if "weights" in facts:
+            checks.append({"rule": "HLO002",
+                           "expr": "float_weight_materializations == 0",
+                           "note": "quantized weights stay quantized"})
+        checks.append({"rule": "HLO006",
+                       "expr": "sub_f32_accumulators == "
+                               f"{len(facts.get('sub_f32_accumulators', []))}",
+                       "note": "bootstrap: no NEW sub-f32 accumulators"})
+        programs[name] = {"checks": checks}
+    return {"version": 1, "programs": programs, "accepted": []}

@@ -1,0 +1,342 @@
+"""Fact extractors over the hlolint module IR.
+
+Every extractor is a pure function of the parsed :class:`~.parser.HloModule`
+(plus, where stated, the lowered StableHLO view) returning JSON-able
+dicts — the currency the contract checker (:mod:`.contracts`), the CI
+gate (ci/hlolint_gate.py), bench.py's ``detail.hlo_facts``, and the
+dryrun gates all trade in:
+
+* :func:`collective_inventory` — count + result bytes per collective
+  op, and per mesh axis via replica-group factorization against the
+  active mesh (the structured descendant of ``__graft_entry__``'s
+  ``_collective_axis_stats``);
+* :func:`dtype_census` — result-buffer counts/bytes per dtype, the f64
+  flag;
+* :func:`reduction_accumulators` — reductions whose accumulator is a
+  sub-f32 float (bf16/f16/f8) — silent precision loss on TPU;
+* :func:`host_transfers` — infeed/outfeed/send/recv and host-callback
+  custom-calls (steady-state programs should have none);
+* :func:`donation` — donated-argument count (StableHLO markers) vs
+  inputs the compiled module actually aliases to outputs;
+* :func:`while_fusion_stats` — control-flow/fusion shape of the program;
+* :func:`float_weight_materializations` — float buffers shaped like a
+  declared quantized weight (the int8-decode "no bf16 copy" gate);
+* :func:`fact_summary` — all of the above in one dict.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .parser import (COLLECTIVE_OPS, HloInstruction, HloModule,
+                     StableHloModule)
+
+__all__ = ["collective_inventory", "dtype_census",
+           "reduction_accumulators", "host_transfers", "donation",
+           "while_fusion_stats", "float_weight_materializations",
+           "stablehlo_census", "fact_summary"]
+
+_SUB_F32_FLOATS = frozenset({"bf16", "f16", "f8e4m3fn", "f8e5m2",
+                             "f8e4m3", "f8e3m4", "f8e4m3b11fnuz",
+                             "f8e4m3fnuz", "f8e5m2fnuz"})
+_REDUCE_OPS = frozenset({"reduce", "reduce-window", "all-reduce",
+                         "reduce-scatter", "all-reduce-start",
+                         "reduce-scatter-start"})
+_HOST_OPS = frozenset({"infeed", "outfeed", "send", "recv",
+                       "send-done", "recv-done"})
+# SPMD plumbing custom-calls that are NOT host transfers
+_BENIGN_CUSTOM_CALLS = ("Sharding", "SPMDFullToShardShape",
+                        "SPMDShardToFullShape", "AllocateBuffer")
+
+
+def _base_opcode(op: str) -> str:
+    for suf in ("-start", "-done"):
+        if op.endswith(suf):
+            return op[:-len(suf)]
+    return op
+
+
+# ------------------------------------------------------------------ #
+# collectives
+# ------------------------------------------------------------------ #
+def _axes_of(ins: HloInstruction, axis_order: Sequence[str],
+             axis_sizes: Dict[str, int], num_devices: int) -> List[str]:
+    """Mesh axes one collective spans: factorize its replica-group
+    membership (or permute neighbor strides) against per-axis device
+    strides — axis ``a`` participates iff stepping by ``stride[a]``
+    stays inside the group."""
+    strides = {}
+    s = 1
+    for a in reversed(list(axis_order)):
+        strides[a] = s
+        s *= axis_sizes[a]
+    live = [a for a in axis_order if axis_sizes[a] > 1]
+    pairs = ins.attrs.get("source_target_pairs")
+    if pairs:
+        # a permute's axis: the one whose stride equals the smallest
+        # |target - source| (wrap-around pairs jump stride*(size-1))
+        steps = [abs(b - a_) for a_, b in pairs if b != a_]
+        if not steps:
+            return []
+        step = min(steps)
+        return [a for a in live if strides[a] == step]
+    groups = ins.replica_group_members(num_devices)
+    if not groups:
+        return []
+    g = set(groups[0])
+    if not g:               # unresolved all-device group
+        return live
+    lo = min(g)
+    return [a for a in live if lo + strides[a] in g]
+
+
+def collective_inventory(module: HloModule,
+                         axis_order: Optional[Sequence[str]] = None,
+                         axis_sizes: Optional[Dict[str, int]] = None
+                         ) -> Dict:
+    """Per-program collective inventory.
+
+    Returns ``{"per_op": {op: {count, bytes}}, "per_axis":
+    {"op[axisA+axisB]": {count, bytes}}, "total_bytes", "n_async"}``.
+    Bytes are the collective's RESULT bytes (the async ``-start`` form
+    counts once; its ``-done`` half is skipped).  ``per_axis`` needs the
+    active mesh (`axis_order` + `axis_sizes`); without it only
+    ``per_op`` is attributed.
+    """
+    ndev = max(module.num_partitions, module.replica_count)
+    per_op: Dict[str, Dict[str, int]] = {}
+    per_axis: Dict[str, Dict[str, int]] = {}
+    n_async = 0
+    total = 0
+    for ins in module.collectives():
+        op = _base_opcode(ins.opcode)
+        if ins.opcode.endswith("-start"):
+            n_async += 1
+            # the start op's result is (operand, result[, scratch]) on
+            # some backends: take the LAST array shape as the payload
+            arrays = [sh for sh in ins.shapes if sh.dtype != "token"]
+            b = arrays[-1].nbytes if arrays else 0
+        else:
+            b = ins.result_bytes
+        ent = per_op.setdefault(op, {"count": 0, "bytes": 0})
+        ent["count"] += 1
+        ent["bytes"] += b
+        total += b
+        if axis_order is not None and axis_sizes is not None:
+            axes = _axes_of(ins, axis_order, axis_sizes, ndev)
+            key = f"{op}[{'+'.join(axes) if axes else '?'}]"
+            ent = per_axis.setdefault(key, {"count": 0, "bytes": 0})
+            ent["count"] += 1
+            ent["bytes"] += b
+    out = {"per_op": per_op, "total_bytes": total, "n_async": n_async}
+    if axis_order is not None and axis_sizes is not None:
+        out["per_axis"] = per_axis
+    return out
+
+
+# ------------------------------------------------------------------ #
+# dtypes
+# ------------------------------------------------------------------ #
+def dtype_census(module: HloModule) -> Dict:
+    """Result-buffer census per dtype over every computation:
+    ``{"dtypes": {dt: {count, bytes}}, "has_f64": bool}``."""
+    dts: Dict[str, Dict[str, int]] = {}
+    for ins in module.all_instructions():
+        for sh in ins.shapes:
+            if sh.dtype == "token":
+                continue
+            ent = dts.setdefault(sh.dtype, {"count": 0, "bytes": 0})
+            ent["count"] += 1
+            ent["bytes"] += sh.nbytes
+    return {"dtypes": dts, "has_f64": "f64" in dts or "c128" in dts}
+
+
+def reduction_accumulators(module: HloModule) -> List[Dict]:
+    """Reductions accumulating in a sub-f32 float: each reduce-family
+    instruction whose result element type is bf16/f16/f8.  (f32 and
+    integer accumulators are fine; a bf16 accumulator silently loses
+    mantissa on every partial sum.)"""
+    out = []
+    for comp in module.computations.values():
+        for ins in comp.instructions:
+            if ins.opcode not in _REDUCE_OPS:
+                continue
+            for sh in ins.shapes:
+                if sh.dtype in _SUB_F32_FLOATS:
+                    out.append({"instruction": ins.name,
+                                "opcode": ins.opcode,
+                                "computation": comp.name,
+                                "dtype": sh.dtype})
+                    break
+    return out
+
+
+# ------------------------------------------------------------------ #
+# host transfers
+# ------------------------------------------------------------------ #
+def host_transfers(module: HloModule) -> Dict:
+    """Ops that move data to/from the host: infeed/outfeed/send/recv
+    plus custom-calls whose target smells like a host callback.  A
+    steady-state training or decode program should have none."""
+    ops = []
+    for comp in module.computations.values():
+        for ins in comp.instructions:
+            if ins.opcode in _HOST_OPS:
+                ops.append({"instruction": ins.name, "opcode": ins.opcode,
+                            "computation": comp.name})
+            elif ins.opcode == "custom-call":
+                tgt = str(ins.attrs.get("custom_call_target", ""))
+                if tgt in _BENIGN_CUSTOM_CALLS:
+                    continue
+                if "callback" in tgt.lower() or "host" in tgt.lower():
+                    ops.append({"instruction": ins.name,
+                                "opcode": f"custom-call:{tgt}",
+                                "computation": comp.name})
+    return {"count": len(ops), "ops": ops}
+
+
+# ------------------------------------------------------------------ #
+# donation
+# ------------------------------------------------------------------ #
+def donation(module: HloModule,
+             stablehlo: Optional[StableHloModule] = None) -> Dict:
+    """Donation coverage: of the inputs jax was ASKED to donate (the
+    ``jax.buffer_donor``/``tf.aliasing_output`` markers in the lowered
+    StableHLO), how many the compiled module actually aliases to an
+    output (``input_output_alias`` header).  A donated-but-unaliased
+    input is a silent extra copy of that buffer every step.
+
+    Without the StableHLO view the donated count is unknown and
+    ``coverage`` is None (the aliased count still reports).
+    """
+    aliased_params = sorted({p for (_o, p, _pi, _k)
+                             in module.input_output_alias})
+    out = {"aliased": len(aliased_params),
+           "aliased_params": aliased_params,
+           "donated": None, "coverage": None}
+    if stablehlo is not None:
+        donors = stablehlo.donated_args
+        out["donated"] = len(donors)
+        if donors:
+            covered = sum(1 for d in donors if d in aliased_params)
+            out["coverage"] = covered / len(donors)
+        elif not aliased_params:
+            out["coverage"] = None      # nothing donated, nothing owed
+    return out
+
+
+# ------------------------------------------------------------------ #
+# control flow / fusion shape
+# ------------------------------------------------------------------ #
+def while_fusion_stats(module: HloModule) -> Dict:
+    n_while = n_fusion = n_instr = 0
+    max_fusion = 0
+    for comp in module.computations.values():
+        n_instr += len(comp.instructions)
+        if comp.is_fusion:
+            max_fusion = max(max_fusion, len(comp.instructions))
+        for ins in comp.instructions:
+            if ins.opcode == "while":
+                n_while += 1
+            elif ins.opcode == "fusion":
+                n_fusion += 1
+    return {"while": n_while, "fusion": n_fusion,
+            "computations": len(module.computations),
+            "instructions": n_instr,
+            "max_fusion_instructions": max_fusion}
+
+
+# ------------------------------------------------------------------ #
+# weight materialization (the int8-decode gate)
+# ------------------------------------------------------------------ #
+def float_weight_materializations(
+        module: HloModule,
+        weight_shapes: Iterable[Tuple[int, ...]],
+        float_dtypes: Sequence[str] = ("bf16",)) -> List[Dict]:
+    """Instructions producing a float buffer shaped like a declared
+    quantized weight — either orientation of each (O, I) shape.  Any
+    hit means the dequant was hoisted out of the matmul epilogue and
+    the program streams a float copy of a weight it was supposed to
+    keep int8."""
+    want = set()
+    for dims in weight_shapes:
+        dims = tuple(dims)
+        want.add(dims)
+        want.add(tuple(reversed(dims)))
+    hits = []
+    fd = set(float_dtypes)
+    for comp in module.computations.values():
+        for ins in comp.instructions:
+            for sh in ins.shapes:
+                if sh.dtype in fd and sh.dims in want:
+                    hits.append({"instruction": ins.name,
+                                 "opcode": ins.opcode,
+                                 "computation": comp.name,
+                                 "dtype": sh.dtype,
+                                 "shape": list(sh.dims)})
+                    break
+    return hits
+
+
+def stablehlo_census(smod: StableHloModule,
+                     weight_shapes: Iterable[Tuple[int, ...]] = (),
+                     float_dtypes: Sequence[str] = ("f32", "bf16", "f16")
+                     ) -> Dict:
+    """StableHLO-side census: per-dtype tensor-token counts plus any
+    float tensor shaped like a declared weight (the dynamic-activation
+    decode gate: dequant must act on the activation, never the
+    weight)."""
+    want = set()
+    for dims in weight_shapes:
+        dims = tuple(dims)
+        want.add(dims)
+        want.add(tuple(reversed(dims)))
+    fd = set(float_dtypes)
+    float_weights = sorted(
+        {repr(sh) for sh in smod.types
+         if sh.dtype in fd and sh.dims in want})
+    return {"dtypes": smod.dtypes(),
+            "float_weight_tensors": float_weights}
+
+
+# ------------------------------------------------------------------ #
+# the one-call summary
+# ------------------------------------------------------------------ #
+def fact_summary(module: HloModule,
+                 stablehlo: Optional[StableHloModule] = None,
+                 axis_order: Optional[Sequence[str]] = None,
+                 axis_sizes: Optional[Dict[str, int]] = None,
+                 weight_shapes: Iterable[Tuple[int, ...]] = (),
+                 weight_float_dtypes: Sequence[str] = ("bf16",)) -> Dict:
+    """Everything hlolint knows about one program, as one JSON-able
+    dict — the object contracts evaluate against and bench.py records
+    under ``detail.hlo_facts``."""
+    entry = module.entry
+    entry_params = entry.parameters() if entry else []
+    root = entry.root if entry else None
+    weight_shapes = [tuple(w) for w in weight_shapes]
+    out = {
+        "module": module.name,
+        "is_scheduled": module.is_scheduled,
+        "num_partitions": module.num_partitions,
+        "collectives": collective_inventory(module, axis_order, axis_sizes),
+        "dtypes": dtype_census(module),
+        "sub_f32_accumulators": reduction_accumulators(module),
+        "host_transfers": host_transfers(module),
+        "donation": donation(module, stablehlo),
+        "stats": while_fusion_stats(module),
+        "entry": {
+            "n_params": len(entry_params),
+            "param_bytes": sum(i.result_bytes for i in entry_params),
+            "output_bytes": root.result_bytes if root else 0,
+        },
+    }
+    if weight_shapes:
+        out["weights"] = {
+            "shapes": [list(w) for w in weight_shapes],
+            "float_materializations": float_weight_materializations(
+                module, weight_shapes, weight_float_dtypes),
+        }
+    if stablehlo is not None:
+        out["stablehlo"] = stablehlo_census(smod=stablehlo,
+                                            weight_shapes=weight_shapes)
+    return out

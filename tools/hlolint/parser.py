@@ -1,0 +1,555 @@
+"""Shared structured parser for compiled HLO text and lowered StableHLO.
+
+Compiled/optimized HLO (``compiled.as_text()``) is a line-oriented
+format::
+
+    HloModule jit_f, is_scheduled=true, input_output_alias={ {2}: (2, {}, may-alias) }, ...
+
+    %region_0.10 (Arg_0.11: f32[], Arg_1.12: f32[]) -> f32[] {
+      ...
+      ROOT %add.13 = f32[] add(f32[] %Arg_0.11, f32[] %Arg_1.12), metadata={...}
+    }
+
+    ENTRY %main_spmd (param: f32[64], ...) -> (f32[8], ...) {
+      %reduce-scatter.2 = f32[8]{0} reduce-scatter(f32[64]{0} %param),
+          channel_id=1, replica_groups={{0,...,7}}, use_global_device_ids=true,
+          dimensions={0}, to_apply=%region_0.10, metadata={...}
+      ...
+    }
+
+The parser handles both the ``%name``-prefixed and the bare-name
+spellings, tuple result types, the three printed ``replica_groups``
+forms (explicit ``{{..},{..}}``, iota-v2 ``[G,S]<=[dims]T(perm)``, and
+the empty all-device ``{}``), ``control-predecessors``, and the module
+header attributes (``is_scheduled``, ``input_output_alias``,
+``num_partitions``/``replica_count``).
+
+Lowered StableHLO (``lowered.as_text()``) is MLIR; :func:`parse_stablehlo`
+extracts what the fact extractors need — the entry func's argument
+attributes (``jax.buffer_donor`` / ``tf.aliasing_output`` donation
+markers), per-op names, and every ``tensor<...>`` type token with its
+shape and dtype — without pretending to be a full MLIR parser.
+
+In a *scheduled* module (``is_scheduled=true``) entry-instruction order
+IS the schedule; `parallel/overlap.py` builds its overlap measurement
+directly on this IR.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Shape", "HloInstruction", "HloComputation", "HloModule",
+           "StableHloModule", "parse_hlo", "parse_stablehlo",
+           "DTYPE_BYTES", "COLLECTIVE_OPS"]
+
+# bytes per element of every dtype XLA prints; sub-byte types (s4/u4)
+# round up to 1 — hlolint over- rather than under-counts them
+DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "s32": 4, "u32": 4, "s64": 8, "u64": 8,
+    "f8e3m4": 1, "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+    "f8e4m3fnuz": 1, "f8e5m2": 1, "f8e5m2fnuz": 1,
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# collective opcodes (sync spelling; async adds -start/-done)
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast", "ragged-all-to-all",
+})
+
+
+class Shape:
+    """One array shape: element dtype + dims.  ``dtype='token'`` and
+    other non-array types byte out at 0."""
+
+    __slots__ = ("dtype", "dims")
+
+    def __init__(self, dtype: str, dims: Tuple[int, ...]):
+        self.dtype = dtype
+        self.dims = tuple(dims)
+
+    @property
+    def nbytes(self) -> int:
+        item = DTYPE_BYTES.get(self.dtype)
+        if item is None:
+            return 0
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n * item
+
+    def __repr__(self):
+        return f"{self.dtype}[{','.join(map(str, self.dims))}]"
+
+    def __eq__(self, other):
+        return (isinstance(other, Shape) and self.dtype == other.dtype
+                and self.dims == other.dims)
+
+    def __hash__(self):
+        return hash((self.dtype, self.dims))
+
+
+_SHAPE_TOKEN_RE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+
+def parse_shapes(type_str: str) -> List[Shape]:
+    """Every dtype[dims] token in an HLO type string (tuple-aware —
+    a tuple type simply yields one Shape per element)."""
+    out = []
+    for m in _SHAPE_TOKEN_RE.finditer(type_str):
+        dt, dims = m.group(1), m.group(2)
+        if dt == "token":
+            out.append(Shape("token", ()))
+            continue
+        if dt not in DTYPE_BYTES:
+            continue
+        out.append(Shape(dt, tuple(int(d) for d in dims.split(",") if d)))
+    return out
+
+
+class HloInstruction:
+    """One HLO instruction: result name, opcode, result shapes (tuple
+    types give several), operand names, and the parsed attributes the
+    fact extractors read."""
+
+    __slots__ = ("name", "opcode", "shapes", "operands", "attrs",
+                 "is_root", "index", "raw")
+
+    def __init__(self, name, opcode, shapes, operands, attrs, is_root,
+                 index, raw):
+        self.name = name
+        self.opcode = opcode
+        self.shapes: List[Shape] = shapes
+        self.operands: Tuple[str, ...] = tuple(operands)
+        self.attrs: Dict[str, object] = attrs
+        self.is_root = is_root
+        self.index = index
+        self.raw = raw
+
+    @property
+    def result_bytes(self) -> int:
+        return sum(s.nbytes for s in self.shapes)
+
+    @property
+    def called_computations(self) -> List[str]:
+        out = []
+        for k in ("to_apply", "calls", "condition", "body",
+                  "branch_computations"):
+            v = self.attrs.get(k)
+            if isinstance(v, str):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                out.extend(v)
+        return out
+
+    def replica_group_members(self, num_devices: Optional[int] = None
+                              ) -> Optional[List[List[int]]]:
+        """The collective's replica groups as explicit member lists.
+        ``{}`` (all devices) resolves when `num_devices` is given, else
+        returns ``[[]]`` meaning "one group of everything"."""
+        rg = self.attrs.get("replica_groups")
+        if rg is None:
+            return None
+        if rg == "empty":
+            if num_devices:
+                return [list(range(num_devices))]
+            return [[]]
+        if isinstance(rg, dict):        # iota v2 form
+            G, S = rg["shape"]
+            dims, perm = rg["dims"], rg.get("perm")
+            n = 1
+            for d in dims:
+                n *= d
+            flat = list(range(n))
+            # reshape to dims, optionally transpose, reshape to (G, S)
+            # — plain-python strides, no numpy dependency
+            strides = [0] * len(dims)
+            s = 1
+            for i in reversed(range(len(dims))):
+                strides[i] = s
+                s *= dims[i]
+            order = perm if perm else list(range(len(dims)))
+            out_dims = [dims[i] for i in order]
+            out_strides = [strides[i] for i in order]
+
+            def unflatten(idx):
+                coord = []
+                for d in reversed(out_dims):
+                    coord.append(idx % d)
+                    idx //= d
+                coord.reverse()
+                return sum(c * st for c, st in zip(coord, out_strides))
+
+            flat = [unflatten(i) for i in range(n)]
+            return [flat[g * S:(g + 1) * S] for g in range(G)]
+        return [list(g) for g in rg]
+
+    def __repr__(self):
+        return f"<{self.opcode} %{self.name} {self.shapes}>"
+
+
+class HloComputation:
+    __slots__ = ("name", "instructions", "is_entry", "is_fusion", "by_name")
+
+    def __init__(self, name: str, is_entry: bool):
+        self.name = name
+        self.is_entry = is_entry
+        self.is_fusion = "fused_computation" in name
+        self.instructions: List[HloInstruction] = []
+        self.by_name: Dict[str, HloInstruction] = {}
+
+    @property
+    def root(self) -> Optional[HloInstruction]:
+        for ins in self.instructions:
+            if ins.is_root:
+                return ins
+        return self.instructions[-1] if self.instructions else None
+
+    def parameters(self) -> List[HloInstruction]:
+        return [i for i in self.instructions if i.opcode == "parameter"]
+
+
+class HloModule:
+    """Parsed compiled-HLO module: header attributes + computations."""
+
+    __slots__ = ("name", "is_scheduled", "num_partitions", "replica_count",
+                 "input_output_alias", "computations", "entry")
+
+    def __init__(self):
+        self.name = ""
+        self.is_scheduled = False
+        self.num_partitions = 1
+        self.replica_count = 1
+        # [(output_tuple_index, param_number, param_tuple_index, kind)]
+        self.input_output_alias: List[Tuple[Tuple[int, ...], int,
+                                            Tuple[int, ...], str]] = []
+        self.computations: Dict[str, HloComputation] = {}
+        self.entry: Optional[HloComputation] = None
+
+    def all_instructions(self) -> Iterable[HloInstruction]:
+        for comp in self.computations.values():
+            for ins in comp.instructions:
+                yield ins
+
+    def computation(self, name: str) -> Optional[HloComputation]:
+        return self.computations.get(name.lstrip("%"))
+
+    def async_pairs(self) -> List[Tuple[HloInstruction, HloInstruction]]:
+        """(start, done) pairs for split async ops, matched by the done
+        instruction consuming the start's result (never by name suffix)."""
+        pairs = []
+        for comp in self.computations.values():
+            starts = {i.name: i for i in comp.instructions
+                      if i.opcode.endswith("-start")}
+            for ins in comp.instructions:
+                if not ins.opcode.endswith("-done"):
+                    continue
+                for op in ins.operands:
+                    st = starts.get(op)
+                    if st is not None:
+                        pairs.append((st, ins))
+                        break
+        return pairs
+
+    def collectives(self, include_inner: bool = True
+                    ) -> List[HloInstruction]:
+        """Collective instructions (one per op: async ``-done`` halves
+        are excluded, the ``-start`` carries shape and attrs).  With
+        ``include_inner`` collectives inside called computations (while
+        bodies, fusions) count too."""
+        comps = self.computations.values() if include_inner else \
+            ([self.entry] if self.entry else [])
+        out = []
+        for comp in comps:
+            for ins in comp.instructions:
+                base = ins.opcode
+                for suf in ("-start", "-done"):
+                    if base.endswith(suf):
+                        base = base[:-len(suf)]
+                if base in COLLECTIVE_OPS and not ins.opcode.endswith("-done"):
+                    out.append(ins)
+        return out
+
+
+# ------------------------------------------------------------------ #
+# compiled-HLO text parsing
+# ------------------------------------------------------------------ #
+# parameter lists may nest parens (tuple-typed args like
+# `(arg_tuple.1: (s32[], bf16[2,4,4]))`), so the arg group is greedy
+_COMP_HEAD_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{\s*$")
+_INSTR_HEAD_RE = re.compile(
+    r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*")
+_NAME_RE = re.compile(r"%([\w.\-]+)")
+_ALIAS_RE = re.compile(
+    r"\{\s*([\d,\s]*)\}:\s*\(\s*(\d+)\s*,\s*\{([\d,\s]*)\}\s*"
+    r"(?:,\s*([\w\-]+))?\s*\)")
+_RG_IOTA_RE = re.compile(
+    r"\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+
+
+def _parse_header(line: str, mod: HloModule) -> None:
+    mod.name = line.split(",", 1)[0].split()[1] if " " in line else ""
+    if "is_scheduled=true" in line:
+        mod.is_scheduled = True
+    m = re.search(r"num_partitions=(\d+)", line)
+    if m:
+        mod.num_partitions = int(m.group(1))
+    m = re.search(r"replica_count=(\d+)", line)
+    if m:
+        mod.replica_count = int(m.group(1))
+    start = line.find("input_output_alias={")
+    if start >= 0:
+        # the alias list nests braces ({out_idx}: (p, {p_idx}, kind)) —
+        # take the balanced {...} body, not up-to-first-}
+        i = start + len("input_output_alias=")
+        depth = 0
+        end = i
+        for end in range(i, len(line)):
+            if line[end] == "{":
+                depth += 1
+            elif line[end] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+        body = line[i + 1:end]
+        for am in _ALIAS_RE.finditer(body):
+            out_idx = tuple(int(t) for t in am.group(1).split(",") if t.strip())
+            param = int(am.group(2))
+            p_idx = tuple(int(t) for t in am.group(3).split(",") if t.strip())
+            kind = am.group(4) or "may-alias"
+            mod.input_output_alias.append((out_idx, param, p_idx, kind))
+
+
+def _split_operand_attrs(rest: str) -> Tuple[str, str]:
+    """Split `opcode(<operands>), attr=..., ...` text after the opening
+    paren into (operand text, attr text) by matching parens/braces —
+    operand types carry `{1,0}` layouts, tuple operands nest parens."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i], rest[i + 1:]
+    return rest, ""
+
+
+def _parse_attrs(attr_text: str) -> Dict[str, object]:
+    attrs: Dict[str, object] = {}
+    m = re.search(r"channel_id=(\d+)", attr_text)
+    if m:
+        attrs["channel_id"] = int(m.group(1))
+    if "use_global_device_ids=true" in attr_text:
+        attrs["use_global_device_ids"] = True
+    m = re.search(r"custom_call_target=\"([^\"]*)\"", attr_text)
+    if m:
+        attrs["custom_call_target"] = m.group(1)
+    m = re.search(r"dimensions=\{([\d,\s]*)\}", attr_text)
+    if m:
+        attrs["dimensions"] = tuple(
+            int(t) for t in m.group(1).split(",") if t.strip())
+    for key in ("to_apply", "condition", "body", "calls"):
+        m = re.search(key + r"=%?([\w.\-]+)", attr_text)
+        if m:
+            attrs[key] = m.group(1)
+    m = re.search(r"control-predecessors=\{([^}]*)\}", attr_text)
+    if m:
+        attrs["control_predecessors"] = tuple(
+            t.strip().lstrip("%") for t in m.group(1).split(",") if t.strip())
+    m = re.search(r"source_target_pairs=\{\{(.*?)\}\}", attr_text)
+    if m:
+        attrs["source_target_pairs"] = [
+            tuple(int(t) for t in pair.split(","))
+            for pair in m.group(1).split("},{")]
+    # replica_groups: three printed forms
+    m = re.search(r"replica_groups=\{\{(.*?)\}\}", attr_text)
+    if m:
+        attrs["replica_groups"] = [
+            [int(t) for t in grp.split(",")]
+            for grp in m.group(1).split("},{")]
+    else:
+        m = re.search(r"replica_groups=" + _RG_IOTA_RE.pattern, attr_text)
+        if m:
+            attrs["replica_groups"] = {
+                "shape": (int(m.group(1)), int(m.group(2))),
+                "dims": [int(t) for t in m.group(3).split(",")],
+                "perm": [int(t) for t in m.group(4).split(",")]
+                if m.group(4) else None,
+            }
+        elif re.search(r"replica_groups=\{\}", attr_text):
+            attrs["replica_groups"] = "empty"
+    return attrs
+
+
+def _operand_names(op_text: str) -> List[str]:
+    """Operand result-names from the operand text.  `%`-prefixed names
+    when present; else bare identifiers left after stripping shape
+    tokens (newer jax prints `add(f32[] Arg_0.11, f32[] Arg_1.12)` or
+    `add(Arg_0.11, Arg_1.12)`)."""
+    names = _NAME_RE.findall(op_text)
+    if names or not op_text.strip():
+        return names
+    stripped = _SHAPE_TOKEN_RE.sub(" ", op_text)
+    stripped = re.sub(r"\{[\d,\s]*\}", " ", stripped)   # layouts
+    out = []
+    for tok in stripped.replace("(", " ").replace(")", " ").split(","):
+        tok = tok.strip()
+        if tok and re.fullmatch(r"[\w.\-]+", tok):
+            out.append(tok)
+    return out
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse compiled/optimized HLO text into an :class:`HloModule`."""
+    mod = HloModule()
+    comp: Optional[HloComputation] = None
+    idx = 0
+    for line in text.splitlines():
+        if line.startswith("HloModule"):
+            _parse_header(line, mod)
+            continue
+        stripped = line.strip()
+        if comp is None:
+            m = _COMP_HEAD_RE.match(stripped)
+            if m and "=" not in stripped.split("(")[0]:
+                comp = HloComputation(m.group(2), is_entry=bool(m.group(1)))
+                mod.computations[comp.name] = comp
+                if comp.is_entry:
+                    mod.entry = comp
+                idx = 0
+            continue
+        if stripped.startswith("}"):
+            comp = None
+            continue
+        m = _INSTR_HEAD_RE.match(line)
+        if m is None:
+            continue
+        is_root, name = bool(m.group(1)), m.group(2)
+        rest = line[m.end():]
+        # result type = text before the opcode; opcode = identifier
+        # immediately before the operand '('
+        om = re.search(r"([a-z][\w\-]*)\(", rest)
+        if om is None:
+            continue
+        type_str, opcode = rest[:om.start()], om.group(1)
+        op_text, attr_text = _split_operand_attrs(rest[om.end():])
+        operands = [n for n in _operand_names(op_text) if n != name]
+        attrs = _parse_attrs(attr_text)
+        operands += [n for n in attrs.get("control_predecessors", ())
+                     if n != name]
+        comp.instructions.append(HloInstruction(
+            name=name, opcode=opcode, shapes=parse_shapes(type_str),
+            operands=operands, attrs=attrs, is_root=is_root, index=idx,
+            raw=stripped))
+        comp.by_name[name] = comp.instructions[-1]
+        idx += 1
+    return mod
+
+
+# ------------------------------------------------------------------ #
+# StableHLO (MLIR) text parsing
+# ------------------------------------------------------------------ #
+# dims are `\d+x` repeats; the element type never contains a bare `x`
+# (i8, ui32, bf16, f8E4M3FN, ...), so anchor the dtype after the last
+# `<digits>x` run — a plain `[a-z]+` dtype group would swallow the `x`
+# separators themselves.
+_TENSOR_RE = re.compile(r"tensor<((?:\d+x)*)([a-zA-Z][a-zA-Z0-9]*)>")
+_MLIR_ARG_RE = re.compile(r"%arg(\d+):\s*tensor<[^>]*>\s*(\{[^}]*\})?")
+_MLIR_OP_RE = re.compile(
+    r"^\s*(?:%[\w#:]+\s*=\s*)?(?:\"?)([\w.]+)(?:\"?)[\s(]")
+
+_MLIR_DTYPES = {
+    "i1": "pred", "i2": "s2", "i4": "s4", "i8": "s8", "i16": "s16",
+    "i32": "s32", "i64": "s64", "ui8": "u8", "ui16": "u16", "ui32": "u32",
+    "ui64": "u64", "bf16": "bf16", "f16": "f16", "f32": "f32",
+    "f64": "f64", "f8E4M3FN": "f8e4m3fn", "f8E5M2": "f8e5m2",
+}
+
+
+class StableHloModule:
+    """Lightweight view of a lowered StableHLO module: entry argument
+    donation attributes, op-name census, and every tensor type token."""
+
+    __slots__ = ("name", "arg_attrs", "ops", "types")
+
+    def __init__(self):
+        self.name = ""
+        # per entry argument: the raw attr dict text ('' when none)
+        self.arg_attrs: List[str] = []
+        self.ops: Dict[str, int] = {}
+        self.types: Dict[Shape, int] = {}
+
+    @property
+    def donated_args(self) -> List[int]:
+        """Argument indices jax marked for donation — either the
+        ``jax.buffer_donor`` marker or an explicit
+        ``tf.aliasing_output`` assignment."""
+        return [i for i, a in enumerate(self.arg_attrs)
+                if "jax.buffer_donor" in a or "tf.aliasing_output" in a]
+
+    @property
+    def aliased_args(self) -> List[int]:
+        return [i for i, a in enumerate(self.arg_attrs)
+                if "tf.aliasing_output" in a]
+
+    def dtypes(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for sh, n in self.types.items():
+            out[sh.dtype] = out.get(sh.dtype, 0) + n
+        return out
+
+    def shapes_with_dims(self, dims: Tuple[int, ...]) -> List[Shape]:
+        return [sh for sh in self.types if sh.dims == tuple(dims)]
+
+
+def _mlir_shape(dims_str: str, dtype_str: str) -> Optional[Shape]:
+    dt = _MLIR_DTYPES.get(dtype_str)
+    if dt is None:
+        return None
+    dims = tuple(int(d) for d in dims_str.split("x") if d) \
+        if dims_str else ()
+    return Shape(dt, dims)
+
+
+def parse_stablehlo(text: str) -> StableHloModule:
+    """Parse lowered StableHLO (MLIR) text into a
+    :class:`StableHloModule` — arg donation attrs from the first public
+    func signature, op-name counts, and a census of every ``tensor<>``
+    type token (operand and result positions both — exactly what the
+    no-float-weight gate needs)."""
+    smod = StableHloModule()
+    m = re.search(r"module\s+@([\w.\-]+)", text)
+    if m:
+        smod.name = m.group(1)
+    in_sig = False
+    sig = ""
+    for line in text.splitlines():
+        # the type census covers EVERY line, signature included — the
+        # entry arg types are where the weight tensors live
+        for tm in _TENSOR_RE.finditer(line):
+            sh = _mlir_shape(tm.group(1), tm.group(2))
+            if sh is not None:
+                smod.types[sh] = smod.types.get(sh, 0) + 1
+        if "func.func" in line and "@main" in line:
+            in_sig = True
+        if in_sig:
+            sig += line
+            if "{" in line.split("->")[-1] or line.rstrip().endswith("{"):
+                in_sig = False
+                args = sig.split("->")[0]
+                for am in _MLIR_ARG_RE.finditer(args):
+                    i = int(am.group(1))
+                    while len(smod.arg_attrs) <= i:
+                        smod.arg_attrs.append("")
+                    smod.arg_attrs[i] = am.group(2) or ""
+            continue
+        om = _MLIR_OP_RE.match(line)
+        if om:
+            op = om.group(1)
+            if op not in ("func.func", "module"):
+                smod.ops[op] = smod.ops.get(op, 0) + 1
+    return smod

@@ -16,6 +16,12 @@ index disambiguates identical lines flagged more than once in the
 same function (index is per (rule, path, function, line-text) group,
 in (line, col) order).
 
+Renames get a second chance: a finding whose fingerprint misses (the
+path is hashed) is matched against the baseline entries the exact pass
+did not consume on (rule, function, line text) alone — multiset
+semantics, each entry usable once — so moving a file does not
+resurrect every accepted finding in it (:func:`filter_new_with_renames`).
+
 The same module hosts the **result cache**: a full project analysis
 parses every file and runs a half-dozen interprocedural fixpoints, so
 repeat CI invocations memoize the *findings* (not ASTs — measured:
@@ -104,8 +110,10 @@ def write_baseline(path: str, findings: Sequence[Finding],
     return len(entries)
 
 
-def load_baseline(path: str) -> Set[str]:
-    """Fingerprint set from a baseline file (raises on bad file)."""
+def load_baseline_entries(path: str) -> List[dict]:
+    """Full baseline entries (rule/function/line_text/fingerprint) —
+    the cross-path rename-tolerance pass needs more than the
+    fingerprint set.  Raises on a bad or version-skewed file."""
     with open(path, "r", encoding="utf-8") as fh:
         blob = json.load(fh)
     if blob.get("version") != BASELINE_VERSION:
@@ -113,13 +121,84 @@ def load_baseline(path: str) -> Set[str]:
             f"{path}: baseline version {blob.get('version')!r} "
             f"(this tpulint writes {BASELINE_VERSION}) — regenerate with "
             f"--write-baseline")
-    return {e["fingerprint"] for e in blob.get("findings", [])}
+    return list(blob.get("findings", []))
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Fingerprint set from a baseline file (raises on bad file)."""
+    return {e["fingerprint"] for e in load_baseline_entries(path)}
 
 
 def filter_new(pairs: Iterable[Tuple[Finding, str]],
                baseline: Set[str]) -> List[Tuple[Finding, str]]:
     """Drop findings whose fingerprint the baseline already accepts."""
     return [(f, fp) for f, fp in pairs if fp not in baseline]
+
+
+def _cross_path_function(path: str, function: str) -> str:
+    """The rename-invariant part of a finding's function name.
+
+    ``Finding.function`` is module-qualified (``pkg.mod.Class.method``)
+    and the module name derives from the file path, so a rename changes
+    it along with the path.  Strip everything up to and including the
+    path's stem component, leaving the qualname — picking the LAST
+    stem occurrence that still leaves a non-empty tail, so a package
+    directory sharing the stem's name doesn't confuse the split."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = function.split(".")
+    for i in range(len(parts) - 2, -1, -1):
+        if parts[i] == stem:
+            return ".".join(parts[i + 1:])
+    return function
+
+
+def filter_new_with_renames(pairs: Iterable[Tuple[Finding, str]],
+                            entries: Sequence[dict],
+                            sources: Optional[Dict[str, str]] = None
+                            ) -> Tuple[List[Tuple[Finding, str]], int, int]:
+    """Two-pass baseline filter: exact fingerprints, then a cross-path
+    (rule, function, line-text) match so a file RENAME or move doesn't
+    resurrect every baselined finding inside it.
+
+    Pass 1 drops findings whose fingerprint the baseline holds (same
+    semantics as :func:`filter_new`).  Pass 2 matches the leftovers
+    against the baseline entries pass 1 did NOT consume, on (rule,
+    enclosing function, stripped line text) with the path ignored —
+    each entry consumable once, so a genuinely new DUPLICATE of a
+    baselined finding still fails the gate.
+
+    Returns ``(new_pairs, n_exact, n_renamed)``.
+    """
+    pairs = list(pairs)
+    sources = dict(sources) if sources else {}
+    accepted = {e["fingerprint"] for e in entries}
+    matched_fps: Set[str] = set()
+    survivors: List[Tuple[Finding, str]] = []
+    for f, fp in pairs:
+        if fp in accepted:
+            matched_fps.add(fp)
+        else:
+            survivors.append((f, fp))
+    n_exact = len(pairs) - len(survivors)
+    pool: Dict[Tuple[str, str, str], int] = {}
+    for e in entries:
+        if e["fingerprint"] in matched_fps:
+            continue
+        k = (e["rule"],
+             _cross_path_function(e.get("path", ""), e.get("function", "")),
+             e.get("line_text", ""))
+        pool[k] = pool.get(k, 0) + 1
+    out: List[Tuple[Finding, str]] = []
+    n_renamed = 0
+    for f, fp in survivors:
+        k = (f.code, _cross_path_function(f.path, f.function),
+             _line_text(sources, f.path, f.line))
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+            n_renamed += 1
+        else:
+            out.append((f, fp))
+    return out, n_exact, n_renamed
 
 
 # -- result cache --------------------------------------------------------- #

@@ -134,10 +134,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     new_pairs = pairs
-    n_baselined = 0
+    n_baselined = n_renamed = 0
     if args.baseline is not None:
         try:
-            accepted = bl.load_baseline(args.baseline)
+            entries = bl.load_baseline_entries(args.baseline)
         except FileNotFoundError:
             print(f"tpulint: baseline {args.baseline} not found — seed it "
                   f"with --write-baseline", file=sys.stderr)
@@ -145,8 +145,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (ValueError, KeyError) as e:
             print(f"tpulint: bad baseline: {e}", file=sys.stderr)
             return 2
-        new_pairs = bl.filter_new(pairs, accepted)
-        n_baselined = len(pairs) - len(new_pairs)
+        new_pairs, n_exact, n_renamed = bl.filter_new_with_renames(
+            pairs, entries)
+        n_baselined = n_exact + n_renamed
 
     _emit(new_pairs, args.format)
     if args.format == "text":
@@ -154,6 +155,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"({n_reach} trace-reachable functions)")
         if n_baselined:
             tail += f"; {n_baselined} baselined finding(s) suppressed"
+            if n_renamed:
+                tail += f" ({n_renamed} matched cross-path)"
         print(tail, file=sys.stderr)
     if args.stats:
         src = "hit" if cached is not None else "miss"
