@@ -1,6 +1,6 @@
 """CI gate: compiled-program contracts over the repo's flagship programs.
 
-Compiles the four programs whose compiled-artifact properties the repo
+Compiles the five programs whose compiled-artifact properties the repo
 stakes perf claims on, extracts hlolint fact summaries from the SAME
 AOT compile that feeds the roofline (telemetry.perf text capture — no
 extra compilation beyond what trainer/generation already do), and
@@ -11,6 +11,9 @@ evaluates the committed `.hlolint_contracts.json`:
   overlapped gradient sync (one reduce-scatter per bucket)
 * ``decode_float`` / ``decode_int8``  — generation's bf16 and
   int8-weight greedy decode programs
+* ``checkpoint_snapshot``             — the async checkpointer's
+  on-device copy (must stay pure per-shard copies: no collectives,
+  no host transfers)
 
 Contract context (``ctx``) carries the run's ground truth: the mesh
 size ``D``, the bucket count ``n_buckets``, the global gradient bytes
@@ -81,9 +84,12 @@ class MLPWithLoss(gluon.nn.HybridBlock):
         return self.loss(self.d3(self.d2(self.d1(x))), y).mean()
 
 
-def _train_program(zero):
+def _train_program(zero, checkpoint_dir=None):
     """One 2-step train; telemetry.perf captures the step program's HLO
-    under its perf name.  Returns (n_buckets, grad_bytes)."""
+    under its perf name.  With ``checkpoint_dir``, a synchronous
+    checkpoint save afterwards additionally captures the
+    ``checkpoint_snapshot`` on-device copy program.  Returns
+    (n_buckets, grad_bytes)."""
     np.random.seed(0)
     mx.random.seed(0)
     mesh = create_mesh(data=len(jax.devices()))
@@ -103,6 +109,11 @@ def _train_program(zero):
                 loss = net(mx.nd.array(x), mx.nd.array(y))
             loss.backward()
             trainer.step(16)
+    if checkpoint_dir is not None:
+        from incubator_mxnet_tpu.utils.checkpoint import CheckpointManager
+
+        with CheckpointManager(checkpoint_dir, async_save=False) as mgr:
+            mgr.save(2, net=net, trainer=trainer)
     bks = (trainer._fullstep_ctx or {}).get("zero_buckets")
     grad_bytes = sum(
         int(np.prod(p.data().shape)) * 4
@@ -133,7 +144,9 @@ def collect_facts():
     telemetry.enable()
     telemetry.perf.set_hlo_text_capture(True)
     _, _ = _train_program(zero=False)
-    n_buckets, grad_bytes = _train_program(zero=True)
+    n_buckets, grad_bytes = _train_program(
+        zero=True,
+        checkpoint_dir=tempfile.mkdtemp(prefix="mxtpu_hlolint_ckpt_"))
     assert n_buckets and n_buckets >= 2, \
         f"bucket cap did not split the grads: {n_buckets}"
     weight_shapes = _decode_programs()
@@ -141,7 +154,7 @@ def collect_facts():
     D = len(jax.devices())
     texts = telemetry.perf.hlo_texts()
     want = ("trainer_full_step", "trainer_full_step_zero_bucketed",
-            "decode_float", "decode_int8")
+            "decode_float", "decode_int8", "checkpoint_snapshot")
     missing = [p for p in want if p not in texts]
     assert not missing, \
         f"programs not captured (telemetry text capture broken?): " \

@@ -38,4 +38,7 @@ JAX_PLATFORMS=cpu python ci/quantized_decode_smoke.py
 echo "flight recorder smoke: SIGTERM mid-train ships a parseable bundle"
 JAX_PLATFORMS=cpu python ci/flight_recorder_smoke.py
 
+echo "resume smoke: kill-and-resume on a halved mesh, async stall < 10% sync"
+JAX_PLATFORMS=cpu python ci/resume_smoke.py
+
 echo "lint gates: OK"

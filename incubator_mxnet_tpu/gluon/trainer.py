@@ -350,6 +350,59 @@ class Trainer:
                     lambda x: onp.asarray(jax.device_get(x)), st)
         return out
 
+    def device_states(self) -> dict:
+        """Live device references to every optimizer state, post-flush
+        and post-sync — NO copy, NO host fetch.  This is the async
+        checkpoint hook: the CheckpointManager snapshots these with one
+        on-device copy program, so the caller stalls only for the copy
+        dispatch, never a device→host transfer.  Explicit-tier entries
+        come back as ``Zero1State`` (shard-local; the worker re-assembles
+        the canonical layout on host via ``zero.host_canonical``)."""
+        self._flush_chain()
+        self._sync_states()
+        return dict(self._states)
+
+    def adopt_restored_states(self) -> int:
+        """Re-shard freshly-restored canonical optimizer state onto this
+        trainer's CURRENT mesh (elastic resume: a checkpoint taken on
+        data=8 restoring onto data=4 re-flat-pads + re-slices here).
+
+        Checkpoints always store the canonical full-shape layout, and
+        ``_canonicalize_states`` runs before every fullstep (re)build, so
+        eagerly adopting is safe and also pre-places each leaf shard-
+        local — the first step after restore never materializes a full
+        replica per device.  Off the explicit ZeRO tier this is a no-op.
+        Returns the number of states adopted."""
+        from . import zero as zero_mod
+
+        zr = self._resolve_zero()
+        if zr is None or zr["tier"] != "explicit":
+            return 0
+        mesh, axis, D = zr["mesh"], zr["axis"], zr["D"]
+        opt = self._optimizer
+        adopted = 0
+        for i, st in list(self._states.items()):
+            p = self._params[i]
+            if p._data_nd is None:
+                continue
+            w = p._data_nd._data
+            try:
+                if isinstance(st, zero_mod.Zero1State):
+                    if st.meta.D == D:
+                        continue
+                    self._states[i] = zero_mod.reshard(st, D, mesh, axis)
+                else:
+                    mp = bool(opt.multi_precision
+                              and w.dtype in (jnp.float16, jnp.bfloat16))
+                    self._states[i] = zero_mod.adopt(st, w, D, mesh, axis, mp)
+                adopted += 1
+            except zero_mod.ZeroIncompatible:
+                # the fullstep build will settle the tier (gspmd
+                # fallback) — leave this state canonical
+                continue
+        self._fullstep_ctx = None
+        return adopted
+
     def _shard_state_like(self, state, w):
         """Place same-shape optimizer-state leaves (momentum, fp32
         master, ...) on the weight's sharding — TP memory savings apply

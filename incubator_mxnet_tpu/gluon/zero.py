@@ -42,8 +42,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Zero1State", "ZeroMeta", "adopt", "canonical", "host_canonical",
-           "spec_state", "state_bytes_per_device", "leaf_shard_bytes",
-           "gspmd_state_sharding", "ZeroIncompatible"]
+           "reshard", "spec_state", "state_bytes_per_device",
+           "leaf_shard_bytes", "gspmd_state_sharding", "ZeroIncompatible"]
 
 
 class ZeroIncompatible(Exception):
@@ -177,6 +177,20 @@ def host_canonical(z: Zero1State):
             host = host[:nleaf].reshape(shape)
         full.append(host)
     return jax.tree_util.tree_unflatten(m.treedef, full)
+
+
+def reshard(z: Zero1State, D: int, mesh, axis: str) -> Zero1State:
+    """Re-shard a :class:`Zero1State` onto a DIFFERENT data-axis size
+    (elastic resume: a checkpoint taken on data=8 restoring onto
+    data=4).  Goes through the canonical full-shape layout — slice off
+    the old padding, then re-flat-pad to a multiple of the new D — so
+    the result is exactly what :func:`adopt` would have built on the
+    new mesh from the same canonical state."""
+    m = z.meta
+    if m.D == D and m.npad == -(-m.n // D) * D:
+        return z
+    w_spec = jax.ShapeDtypeStruct(m.w_shape, jnp.dtype(m.w_dtype))
+    return adopt(canonical(z), w_spec, D, mesh, axis, m.mp)
 
 
 def spec_state(meta: ZeroMeta, axis: str) -> Zero1State:

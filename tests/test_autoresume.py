@@ -1,0 +1,160 @@
+"""tools/autoresume.py unit coverage (ISSUE 11 satellite — the
+supervisor previously had zero tests of its own; the kill-and-resume
+integration lives in test_checkpoint_resume.py and ci/resume_smoke.py).
+
+Covers the hardened contract: exponential backoff between restarts,
+SIGTERM→grace→SIGKILL escalation for hung children, and propagation of
+the child's final exit code (128+signum for signal deaths)."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(_ROOT, "tools"))
+
+import autoresume  # noqa: E402
+
+
+def _run(args, timeout=120):
+    # fast default poll so the supervisor notices child exits promptly;
+    # tests passing their own --poll-interval override it (last wins)
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "autoresume.py"),
+         "--poll-interval", "0.05"]
+        + args, timeout=timeout, capture_output=True, text=True)
+
+
+def test_exit_code_mapping():
+    assert autoresume._exit_code(0) == 0
+    assert autoresume._exit_code(7) == 7
+    assert autoresume._exit_code(-signal.SIGTERM) == 128 + signal.SIGTERM
+    assert autoresume._exit_code(-signal.SIGKILL) == 128 + signal.SIGKILL
+
+
+def test_success_passthrough(tmp_path):
+    rc = autoresume.supervise([sys.executable, "-c", "pass"],
+                              max_restarts=0)
+    assert rc == 0
+
+
+def test_final_exit_code_propagates(tmp_path):
+    """After the restart budget is exhausted the supervisor exits with
+    the CHILD's final exit code, not a generic 1."""
+    proc = _run(["--max-restarts", "1", "--backoff", "0.05", "--",
+                 sys.executable, "-c", "import sys; sys.exit(7)"])
+    assert proc.returncode == 7
+    assert "restart budget exhausted" in proc.stderr
+
+
+def test_signal_death_maps_to_128_plus_signum(tmp_path):
+    proc = _run(["--max-restarts", "0", "--",
+                 sys.executable, "-c",
+                 "import os, signal; os.kill(os.getpid(), signal.SIGTERM)"])
+    assert proc.returncode == 128 + signal.SIGTERM
+
+
+def test_exponential_backoff_between_restarts(tmp_path):
+    """Consecutive restarts sleep backoff, 2*backoff, ... — visible both
+    in the log lines and in the wall clock."""
+    t0 = time.time()
+    proc = _run(["--max-restarts", "3", "--backoff", "0.2", "--",
+                 sys.executable, "-c", "import sys; sys.exit(3)"])
+    elapsed = time.time() - t0
+    assert proc.returncode == 3
+    assert "restarting in 0.2s (1/3)" in proc.stderr
+    assert "restarting in 0.4s (2/3)" in proc.stderr
+    assert "restarting in 0.8s (3/3)" in proc.stderr
+    assert elapsed >= 0.2 + 0.4 + 0.8
+
+
+def test_backoff_capped(tmp_path):
+    proc = _run(["--max-restarts", "2", "--backoff", "0.2",
+                 "--backoff-max", "0.3", "--",
+                 sys.executable, "-c", "import sys; sys.exit(3)"])
+    assert "restarting in 0.2s (1/2)" in proc.stderr
+    assert "restarting in 0.3s (2/2)" in proc.stderr
+
+
+def test_hung_child_gets_sigterm_then_exits(tmp_path):
+    """A stale-heartbeat child that honors SIGTERM is terminated
+    gracefully (no SIGKILL) — the window the flight recorder and the
+    checkpoint worker rely on."""
+    hb = str(tmp_path / "hb")
+    marker = str(tmp_path / "got_term")
+    hang = str(tmp_path / "hang.py")
+    with open(hang, "w") as f:
+        f.write(
+            "import signal, sys, time\n"
+            f"open({hb!r}, 'w').write('x')\n"
+            "def onterm(sig, frame):\n"
+            f"    open({marker!r}, 'w').write('term')\n"
+            "    sys.exit(9)\n"
+            "signal.signal(signal.SIGTERM, onterm)\n"
+            "time.sleep(600)\n")
+    proc = _run(["--max-restarts", "0", "--heartbeat-file", hb,
+                 "--heartbeat-timeout", "1", "--poll-interval", "0.1",
+                 "--grace", "10", "--", sys.executable, hang])
+    assert proc.returncode == 9          # child's graceful exit code
+    assert "heartbeat stale" in proc.stderr
+    assert os.path.exists(marker)        # SIGTERM handler actually ran
+
+
+def test_hung_child_ignoring_sigterm_is_sigkilled(tmp_path):
+    """Escalation backstop: a child wedged past SIGTERM is SIGKILLed
+    after the grace window."""
+    hb = str(tmp_path / "hb")
+    hang = str(tmp_path / "hang.py")
+    with open(hang, "w") as f:
+        f.write(
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            f"open({hb!r}, 'w').write('x')\n"
+            "time.sleep(600)\n")
+    proc = _run(["--max-restarts", "0", "--heartbeat-file", hb,
+                 "--heartbeat-timeout", "1", "--poll-interval", "0.1",
+                 "--grace", "0.5", "--", sys.executable, hang])
+    assert proc.returncode == 128 + signal.SIGKILL
+    assert "heartbeat stale" in proc.stderr
+
+
+def test_supervisor_forwards_sigterm_to_child(tmp_path):
+    """Preemption hits the supervisor first: it forwards the signal to
+    the child (grace escalation) and exits with the child's code —
+    never orphaning the training process."""
+    marker = str(tmp_path / "child_term")
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(
+            "import signal, sys, time\n"
+            "def onterm(sig, frame):\n"
+            f"    open({marker!r}, 'w').write('term')\n"
+            "    sys.exit(11)\n"
+            "signal.signal(signal.SIGTERM, onterm)\n"
+            "print('READY', flush=True)\n"
+            "time.sleep(600)\n")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_ROOT, "tools", "autoresume.py"),
+         "--max-restarts", "0", "--poll-interval", "0.1", "--",
+         sys.executable, child],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    # wait for the grandchild to be up before signalling the supervisor
+    deadline = time.time() + 60
+    line = ""
+    while time.time() < deadline and "READY" not in line:
+        line += proc.stdout.readline()
+    assert "READY" in line
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=60)
+    assert proc.returncode == 11         # child's exit code, propagated
+    assert "forwarding to job" in err
+    assert os.path.exists(marker)        # child saw the forwarded TERM
+
+
+def test_no_command_is_usage_error():
+    proc = _run(["--max-restarts", "0"])
+    assert proc.returncode == 2
+    assert "no command given" in proc.stderr

@@ -69,14 +69,16 @@ def test_trains_through_trainer_on_expert_mesh():
 
     params = list(dense_in.collect_params().values()) \
         + list(moe.collect_params().values())
-    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-2})
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 3e-2})
     k = jax.random.PRNGKey(3)
     x = NDArray(jax.random.normal(k, (B, T, D), jnp.float32))
     tgt = NDArray(jax.random.normal(jax.random.fold_in(k, 1), (B, T, D),
                                     jnp.float32))
     loss_fn = gluon.loss.L2Loss()
     losses = []
-    for _ in range(30):
+    # eager (un-hybridized) MoE steps cost seconds each on the virtual
+    # mesh — 12 steps at lr 3e-2 reach the same loss bar 30 did at 1e-2
+    for _ in range(12):
         with autograd.record():
             h = dense_in(x)
             y, aux = moe(h)
@@ -86,7 +88,7 @@ def test_trains_through_trainer_on_expert_mesh():
         losses.append(float(L.asnumpy().mean()))
     assert losses[-1] < losses[0] * 0.8, losses
     g = onp.asarray(moe.expert_win.grad().asnumpy())
-    # top-2 routing with capacity: every expert sees tokens over 25 steps
+    # top-2 routing with capacity: every expert sees tokens
     assert (onp.abs(g).reshape(E, -1).sum(axis=1) > 0).all()
 
 

@@ -8,6 +8,23 @@ its latest checkpoint (`utils.checkpoint.CheckpointManager.restore`).
 Restart count is bounded; steady progress (heartbeat mtime advancing)
 resets the budget.
 
+Hardened contract (docs/robustness.md):
+
+* **Exponential backoff** between restarts (``--backoff``, doubling up
+  to ``--backoff-max``) so a crash-looping job doesn't hammer shared
+  infrastructure (checkpoint filesystem, coordinator) at poll speed.
+* **Graceful kill escalation**: a hung child gets SIGTERM first — its
+  flight recorder (telemetry.flight_recorder) dumps the last-N-steps
+  bundle and the checkpoint worker flushes — then SIGKILL after
+  ``--grace`` seconds if it still won't die.
+* **Exit-code propagation**: the supervisor's own exit status is the
+  child's FINAL exit code (128+signum for a signal death, shell
+  convention), so outer schedulers see why the job ultimately stopped.
+* **Signal forwarding**: SIGTERM/SIGINT at the supervisor (pod
+  preemption hits the process group leader first) forwards to the
+  child with the same grace escalation, then exits with the child's
+  code — the supervisor never orphans a training process.
+
 Heartbeat contract: the training script touches `--heartbeat-file`
 every step (one os.utime / write).  If the file goes stale for longer
 than `--heartbeat-timeout` seconds the job is declared hung (the
@@ -25,7 +42,10 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+
+__all__ = ["supervise", "main", "build_parser"]
 
 
 def build_parser():
@@ -34,6 +54,13 @@ def build_parser():
     p.add_argument("--heartbeat-file", type=str, default=None)
     p.add_argument("--heartbeat-timeout", type=float, default=300.0)
     p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument("--backoff", type=float, default=1.0,
+                   help="initial sleep before a restart (doubles each "
+                        "consecutive restart, resets on progress)")
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--grace", type=float, default=10.0,
+                   help="seconds between SIGTERM and SIGKILL when the "
+                        "supervisor has to kill the child")
     p.add_argument("command", nargs=argparse.REMAINDER)
     return p
 
@@ -45,50 +72,114 @@ def _heartbeat_age(path):
         return None  # not yet written
 
 
+def _terminate(proc, grace: float) -> int:
+    """SIGTERM → wait up to ``grace`` → SIGKILL.  The TERM-first window
+    lets the child's flight recorder dump its bundle and the checkpoint
+    worker finish an in-flight commit; KILL is the backstop for a child
+    wedged past signal delivery (stuck collective, D2H hang).  Returns
+    the child's exit code."""
+    if proc.poll() is not None:
+        return proc.returncode
+    try:
+        proc.send_signal(signal.SIGTERM)
+    except OSError:
+        pass
+    try:
+        return proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        pass
+    try:
+        proc.send_signal(signal.SIGKILL)
+    except OSError:
+        pass
+    return proc.wait()
+
+
+def _exit_code(rc: int) -> int:
+    """Child exit status → supervisor exit status: negative (signal
+    death) becomes the shell's 128+signum so outer schedulers can tell
+    SIGKILL(137)/SIGTERM(143) from ordinary failures."""
+    return 128 - rc if rc < 0 else rc
+
+
 def supervise(command, max_restarts=3, heartbeat_file=None,
-              heartbeat_timeout=300.0, poll_interval=1.0) -> int:
+              heartbeat_timeout=300.0, poll_interval=1.0,
+              backoff=1.0, backoff_max=60.0, grace=10.0) -> int:
     restarts = 0
-    while True:
-        start = time.time()
-        if heartbeat_file is not None:
-            # reset staleness: the relaunched process needs init time
-            # before its first beat — a stale mtime from the previous
-            # incarnation must not kill it instantly
+    delay = backoff
+    stop = {"sig": None}
+
+    def _forward(signum, _frame):
+        stop["sig"] = signum
+
+    # forward preemption signals to the child (main thread only —
+    # supervise() is also called from test threads, where signal
+    # handlers are unavailable)
+    installed = {}
+    if threading.current_thread() is threading.main_thread():
+        for signum in (signal.SIGTERM, signal.SIGINT):
             try:
-                os.utime(heartbeat_file, None)
-            except OSError:
+                installed[signum] = signal.signal(signum, _forward)
+            except (ValueError, OSError):
                 pass
-        proc = subprocess.Popen(command)
-        hung = False
+    try:
         while True:
-            rc = proc.poll()
-            if rc is not None:
-                break
+            start = time.time()
             if heartbeat_file is not None:
-                age = _heartbeat_age(heartbeat_file)
-                if age is not None and age > heartbeat_timeout:
-                    print(f"autoresume: heartbeat stale {age:.0f}s > "
-                          f"{heartbeat_timeout:.0f}s — killing job",
-                          file=sys.stderr, flush=True)
-                    proc.send_signal(signal.SIGKILL)
-                    proc.wait()
-                    rc, hung = -9, True
+                # reset staleness: the relaunched process needs init time
+                # before its first beat — a stale mtime from the previous
+                # incarnation must not kill it instantly
+                try:
+                    os.utime(heartbeat_file, None)
+                except OSError:
+                    pass
+            proc = subprocess.Popen(command)
+            hung = False
+            while True:
+                rc = proc.poll()
+                if rc is not None:
                     break
-            time.sleep(poll_interval)
-        if rc == 0:
-            return 0
-        # sustained progress earns the budget back — BEFORE the
-        # exhaustion check, so a long-healthy job gets a fresh budget
-        if time.time() - start > 10 * heartbeat_timeout:
-            restarts = 0
-        restarts += 1
-        reason = "hang" if hung else f"rc={rc}"
-        if restarts > max_restarts:
-            print(f"autoresume: {reason}; restart budget exhausted "
-                  f"({max_restarts})", file=sys.stderr, flush=True)
-            return rc if rc else 1
-        print(f"autoresume: {reason}; restarting ({restarts}/{max_restarts})",
-              file=sys.stderr, flush=True)
+                if stop["sig"] is not None:
+                    print(f"autoresume: received signal {stop['sig']} — "
+                          f"forwarding to job and exiting",
+                          file=sys.stderr, flush=True)
+                    return _exit_code(_terminate(proc, grace))
+                if heartbeat_file is not None:
+                    age = _heartbeat_age(heartbeat_file)
+                    if age is not None and age > heartbeat_timeout:
+                        print(f"autoresume: heartbeat stale {age:.0f}s > "
+                              f"{heartbeat_timeout:.0f}s — killing job",
+                              file=sys.stderr, flush=True)
+                        rc, hung = _terminate(proc, grace), True
+                        if rc == 0:
+                            rc = 1  # a hung-then-killed job never "passed"
+                        break
+                time.sleep(poll_interval)
+            if rc == 0:
+                return 0
+            # sustained progress earns the budget back — BEFORE the
+            # exhaustion check, so a long-healthy job gets a fresh
+            # budget and the backoff clock restarts from its base
+            if time.time() - start > 10 * heartbeat_timeout:
+                restarts = 0
+                delay = backoff
+            restarts += 1
+            reason = "hang" if hung else f"rc={rc}"
+            if restarts > max_restarts:
+                print(f"autoresume: {reason}; restart budget exhausted "
+                      f"({max_restarts})", file=sys.stderr, flush=True)
+                return _exit_code(rc) or 1
+            print(f"autoresume: {reason}; restarting in {delay:.1f}s "
+                  f"({restarts}/{max_restarts})",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay)
+            delay = min(delay * 2, backoff_max)
+    finally:
+        for signum, prev in installed.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):
+                pass
 
 
 def main(argv=None):
@@ -100,7 +191,9 @@ def main(argv=None):
         print("autoresume: no command given", file=sys.stderr)
         return 2
     return supervise(command, args.max_restarts, args.heartbeat_file,
-                     args.heartbeat_timeout, args.poll_interval)
+                     args.heartbeat_timeout, args.poll_interval,
+                     backoff=args.backoff, backoff_max=args.backoff_max,
+                     grace=args.grace)
 
 
 if __name__ == "__main__":
