@@ -1,0 +1,161 @@
+"""Open-loop serving load harness — continuous batching under overload.
+
+Drives the paged-KV ServingEngine (incubator_mxnet_tpu/serving/) with
+Poisson arrivals at a configurable offered load, optionally injecting
+faults (a slowed decode step, mid-flight client cancellations), and
+reports the latency/goodput envelope:
+
+    python benchmark/serving_bench.py [--rate HZ] [--requests N]
+        [--max-batch B] [--max-queue Q] [--prompt-len P] [--new-tokens T]
+        [--slow-step-ms MS] [--cancel-frac F] [--seed S] [--out FILE]
+
+Open loop: arrival gaps are pre-sampled exponentials and submit() never
+blocks on the engine — requests the bounded queue cannot hold are shed,
+exactly as a real frontend would see.  Per-request timestamps come from
+the engine itself (Request.t_submit / t_first / t_done), so TTFT
+includes queueing delay and TPOT is pure decode cadence.
+
+Emits ONE BENCH-style JSON row (the repo convention, see bench.py /
+BENCH_r05.json): {"metric", "value", "unit", "detail"} where value is
+goodput (decoded tok/s of requests that COMPLETED — shed and evicted
+work counts as zero) and detail carries offered load, shed fraction,
+and TTFT/TPOT p50/p95/p99.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bench model: big enough that a decode step does real work, small
+# enough to warm up in seconds on any host
+V, C, DFF, L, H = 1024, 128, 512, 2, 4
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="offered load, requests/s (Poisson)")
+    ap.add_argument("--requests", type=int, default=80)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=24)
+    ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--slow-step-ms", type=float, default=0.0,
+                    help="fault injection: sleep this long in every "
+                         "decode step (models a slow/contended device)")
+    ap.add_argument("--cancel-frac", type=float, default=0.0,
+                    help="fault injection: cancel this fraction of "
+                         "requests ~one step after submission")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", help="also write the JSON row here")
+    args = ap.parse_args()
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    mx.random.seed(args.seed)
+    msl = args.prompt_len + args.new_tokens + 8
+    net = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
+                        num_heads=H, max_len=msl + 32, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((1, 4), jnp.int32)))
+    net.cast("bfloat16")
+
+    eng = ServingEngine(net, max_batch=args.max_batch, block_size=16,
+                        max_seq_len=msl, max_queue=args.max_queue)
+
+    rng = np.random.RandomState(args.seed)
+    prompts = [rng.randint(0, V, size=args.prompt_len).astype(np.int32)
+               for _ in range(args.requests)]
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+    cancel = rng.random_sample(args.requests) < args.cancel_frac
+
+    # warmup: compile prefill bucket + step OUTSIDE the timed run
+    eng.submit(prompts[0], args.new_tokens).result(timeout=120)
+    assert eng.drain(timeout=60)
+    if args.slow_step_ms > 0:
+        eng.set_fault_hook(
+            lambda ph: time.sleep(args.slow_step_ms / 1e3)
+            if ph == "step" else None)
+
+    reqs = []
+    t0 = time.monotonic()
+    for i in range(args.requests):
+        time.sleep(gaps[i])
+        r = eng.submit(prompts[i], args.new_tokens, seed=i)
+        reqs.append(r)
+        if cancel[i]:
+            r.cancel()
+    assert eng.drain(timeout=600), "engine failed to drain"
+    wall = time.monotonic() - t0
+    stats = eng.stats()
+    eng.close()
+
+    done = [r for r in reqs if r.status == "done"]
+    shed = sum(stats["shed"].values())
+    evicted = sum(stats["evicted"].values())
+    cancelled = sum(1 for r in reqs if r.status == "cancelled")
+    ttft = sorted(r.t_first - r.t_submit for r in done
+                  if r.t_first is not None)
+    tpot = sorted((r.t_done - r.t_first) / (len(r.tokens) - 1)
+                  for r in done if len(r.tokens) > 1)
+    good_tokens = sum(len(r.tokens) for r in done)
+    goodput = good_tokens / wall
+
+    row = {
+        "metric": "serving_goodput",
+        "value": round(goodput, 1),
+        "unit": "tok/s",
+        "detail": {
+            "offered_load_hz": args.rate,
+            "requests": args.requests,
+            "served": len(done),
+            "shed": shed,
+            "shed_fraction": round(shed / args.requests, 4),
+            "evicted": evicted,
+            "cancelled": cancelled,
+            "ttft_ms": {"p50": _pct(ttft, 50), "p95": _pct(ttft, 95),
+                        "p99": _pct(ttft, 99)},
+            "tpot_ms": {"p50": _pct(tpot, 50), "p95": _pct(tpot, 95),
+                        "p99": _pct(tpot, 99)},
+            "decode_steps": stats["steps"],
+            "wall_s": round(wall, 2),
+            "max_batch": args.max_batch,
+            "max_queue": args.max_queue,
+            "prompt_len": args.prompt_len,
+            "new_tokens": args.new_tokens,
+            "slow_step_ms": args.slow_step_ms,
+            "cancel_frac": args.cancel_frac,
+            "model": f"TransformerLM {L}L/{C}D V={V} bf16",
+            "device": jax.devices()[0].device_kind,
+        },
+    }
+    for d in (row["detail"]["ttft_ms"], row["detail"]["tpot_ms"]):
+        for k, v in d.items():
+            d[k] = None if v is None else round(v * 1e3, 2)
+    line = json.dumps(row)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

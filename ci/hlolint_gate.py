@@ -1,6 +1,6 @@
 """CI gate: compiled-program contracts over the repo's flagship programs.
 
-Compiles the five programs whose compiled-artifact properties the repo
+Compiles the programs whose compiled-artifact properties the repo
 stakes perf claims on, extracts hlolint fact summaries from the SAME
 AOT compile that feeds the roofline (telemetry.perf text capture — no
 extra compilation beyond what trainer/generation already do), and
@@ -14,6 +14,10 @@ evaluates the committed `.hlolint_contracts.json`:
 * ``checkpoint_snapshot``             — the async checkpointer's
   on-device copy (must stay pure per-shard copies: no collectives,
   no host transfers)
+* ``serving_prefill_float`` / ``serving_step_float`` and their
+  ``_int8`` twins — the continuous-batching engine's paged-KV
+  programs (donation must hold so eviction never doubles the pool;
+  the int8 path must not materialize bf16 weight copies)
 
 Contract context (``ctx``) carries the run's ground truth: the mesh
 size ``D``, the bucket count ``n_buckets``, the global gradient bytes
@@ -139,8 +143,30 @@ def _decode_programs():
                   for d in qc._targets.values())
 
 
+def _serving_programs():
+    """Compile the continuous-batching engine's four programs
+    (float/int8 x prefill/step) by running one request through a float
+    engine and one through an int8 engine on a fresh tiny net."""
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    mx.random.seed(0)
+    net = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
+                        num_heads=H, max_len=MAXLEN, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((1, 4), jnp.int32)))
+    net.cast("bfloat16")
+    prompt = np.zeros((P,), dtype="int32")
+    with ServingEngine(net, max_batch=1, block_size=4,
+                       poll_interval=0.001) as eng:
+        eng.submit(prompt, N).result(timeout=60)   # serving_*_float
+    net.quantize_for_decode(act_quant="none")
+    with ServingEngine(net, max_batch=1, block_size=4,
+                       poll_interval=0.001) as eng:
+        eng.submit(prompt, N).result(timeout=60)   # serving_*_int8
+
+
 def collect_facts():
-    """Compile the four programs and return (facts_by_program, ctx)."""
+    """Compile the nine programs and return (facts_by_program, ctx)."""
     telemetry.enable()
     telemetry.perf.set_hlo_text_capture(True)
     _, _ = _train_program(zero=False)
@@ -150,11 +176,14 @@ def collect_facts():
     assert n_buckets and n_buckets >= 2, \
         f"bucket cap did not split the grads: {n_buckets}"
     weight_shapes = _decode_programs()
+    _serving_programs()
 
     D = len(jax.devices())
     texts = telemetry.perf.hlo_texts()
     want = ("trainer_full_step", "trainer_full_step_zero_bucketed",
-            "decode_float", "decode_int8", "checkpoint_snapshot")
+            "decode_float", "decode_int8", "checkpoint_snapshot",
+            "serving_prefill_float", "serving_step_float",
+            "serving_prefill_int8", "serving_step_int8")
     missing = [p for p in want if p not in texts]
     assert not missing, \
         f"programs not captured (telemetry text capture broken?): " \
@@ -169,7 +198,7 @@ def collect_facts():
         kw = {}
         if name.startswith("trainer"):
             kw = dict(axis_order=["data"], axis_sizes={"data": D})
-        if name == "decode_int8":
+        if name.endswith("int8"):
             kw = dict(weight_shapes=weight_shapes)
         facts[name] = hlolint.fact_summary(module, stablehlo=smod, **kw)
     ctx = {"D": D, "n_buckets": n_buckets, "grad_bytes": grad_bytes,

@@ -41,4 +41,7 @@ JAX_PLATFORMS=cpu python ci/flight_recorder_smoke.py
 echo "resume smoke: kill-and-resume on a halved mesh, async stall < 10% sync"
 JAX_PLATFORMS=cpu python ci/resume_smoke.py
 
+echo "serving smoke: overloaded Poisson run — sheds, drains, 0 recompiles"
+JAX_PLATFORMS=cpu python ci/serving_smoke.py
+
 echo "lint gates: OK"

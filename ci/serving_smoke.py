@@ -1,0 +1,129 @@
+"""CI gate: the serving engine survives overload without stalling or
+recompiling (ISSUE 12 acceptance criteria).
+
+One short open-loop Poisson run against a tiny LM, arrival rate forced
+above capacity (an injected slow decode step caps throughput), then
+asserts the overload contract:
+
+1. **Zero recompiles after warmup** — a budget-0 `RetraceGuard` over
+   the serving program names spans the whole loaded run: admission,
+   eviction and shedding may only change argument VALUES, never
+   program shapes.
+2. **Sheds rather than stalls** — the bounded queue sheds at least one
+   request (``serving_shed_total`` > 0) and every submit() returns
+   promptly (open-loop: the generator never blocks on the engine).
+3. **Admitted requests meet the TTFT budget** — p50 TTFT of admitted
+   requests stays under a pinned CPU-smoke bound.
+4. **Graceful drain + close** — all admitted work completes, the
+   scheduler thread joins, blocks all return to the pool.
+5. **Metrics present** — the serving counters/histograms documented in
+   docs/observability.md actually populated.
+
+Budget: well under 30 s on the CPU smoke host.
+Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/serving_smoke.py
+"""
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("MXTPU_TELEMETRY_DUMP", None)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import telemetry  # noqa: E402
+from incubator_mxnet_tpu.models.transformer import TransformerLM  # noqa: E402
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray  # noqa: E402
+from incubator_mxnet_tpu.retrace_guard import RetraceGuard  # noqa: E402
+from incubator_mxnet_tpu.serving import ServingEngine  # noqa: E402
+
+# pinned smoke bounds (generous for a shared CPU host; the contract is
+# "bounded", not "fast")
+TTFT_P50_BUDGET_S = 2.0
+N_REQUESTS = 24
+ARRIVAL_RATE_HZ = 60.0        # >> capacity with the slow step below
+SLOW_STEP_S = 0.02
+MAX_QUEUE = 3
+SEED = 0
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    mx.random.seed(SEED)
+    telemetry.enable()
+    net = TransformerLM(vocab=61, units=16, hidden_size=32, num_layers=1,
+                        num_heads=2, max_len=64, dropout=0.0)
+    net.initialize()
+    net(NDArray(jnp.ones((1, 4), jnp.int32)))
+
+    eng = ServingEngine(net, max_batch=2, block_size=8, max_queue=MAX_QUEUE,
+                        poll_interval=0.001)
+
+    # -- warmup: compile the step program and both prompt buckets ------ #
+    for p in ((3, 7, 11), (2, 9, 4, 1, 5, 8, 6, 3, 2)):   # buckets 8, 16
+        eng.submit(np.array(p, np.int32), 4).result(timeout=60)
+    assert eng.drain(timeout=30)
+
+    # -- loaded run: Poisson arrivals above capacity, zero-compile ----- #
+    eng.set_fault_hook(lambda ph: time.sleep(SLOW_STEP_S)
+                       if ph == "step" else None)
+    rng = np.random.RandomState(SEED)
+    gaps = rng.exponential(1.0 / ARRIVAL_RATE_HZ, size=N_REQUESTS)
+    prompts = [rng.randint(0, 61, size=rng.choice([3, 5, 9]))
+               .astype(np.int32) for _ in range(N_REQUESTS)]
+    reqs = []
+    with RetraceGuard(budget=0,
+                      watch={"serving_step", "serving_prefill"}) as guard:
+        for gap, prompt in zip(gaps, prompts):
+            time.sleep(gap)
+            reqs.append(eng.submit(prompt, 6))    # open loop: never blocks
+        assert eng.drain(timeout=60), "engine failed to drain under load"
+        guard.check()     # zero serving-program compiles after warmup
+
+    # -- overload contract --------------------------------------------- #
+    stats = eng.stats()
+    shed = sum(stats["shed"].values())
+    done = [r for r in reqs if r.status == "done"]
+    assert shed >= 1, f"no sheds at {ARRIVAL_RATE_HZ} Hz offered: {stats}"
+    assert done, f"nothing admitted: {stats}"
+    assert len(done) + shed == len(reqs), stats
+    assert stats["blocks_free"] == stats["blocks_total"], stats
+    ttfts = sorted(r.t_first - r.t_submit for r in done)
+    p50 = ttfts[len(ttfts) // 2]
+    assert p50 < TTFT_P50_BUDGET_S, \
+        f"TTFT p50 {p50:.3f}s over the {TTFT_P50_BUDGET_S}s budget"
+
+    # -- metrics present ----------------------------------------------- #
+    reg = telemetry.get_registry()
+    for name, labels in (("serving_admitted_total", None),
+                         ("serving_queue_depth", None),
+                         ("serving_batch_occupancy", None),
+                         ("serving_kv_blocks_in_use", None),
+                         ("serving_ttft_seconds", {"path": "float"}),
+                         ("serving_tpot_seconds", {"path": "float"})):
+        assert reg.get(name, labels) is not None, f"metric missing: {name}"
+    assert reg.get("serving_shed_total",
+                   {"reason": "queue_full"}).value >= 1
+
+    # -- graceful shutdown --------------------------------------------- #
+    thread = eng._thread
+    eng.close()
+    assert not thread.is_alive(), "scheduler thread not joined"
+
+    telemetry.disable()
+    dt = time.perf_counter() - t_start
+    print(f"serving smoke: OK — {len(done)}/{len(reqs)} served, "
+          f"{shed} shed, TTFT p50 {p50 * 1e3:.1f} ms, "
+          f"{stats['steps']} steps, 0 recompiles after warmup, "
+          f"{dt:.1f}s total on {jax.devices()[0].platform}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,8 +35,8 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 
-__all__ = ["lm_generate", "lm_beam_search", "lm_score", "nmt_translate",
-           "bucket_length"]
+__all__ = ["lm_generate", "lm_beam_search", "lm_score", "lm_stream",
+           "nmt_translate", "bucket_length"]
 
 # LRU caps for the per-net compiled-program / pe-table caches (ADVICE
 # r5 #3: exact-(B, P, N, sampling) keys grow without bound under
@@ -575,6 +575,32 @@ def lm_generate(net, prompt, max_new_tokens: int, *, temperature: float = 0.0,
     gen = _timed_decode(f"decode_{path}", path, B * N,
                         fn, params, padded, jnp.int32(P), key)
     return jnp.concatenate([prompt, gen], axis=1)
+
+
+def lm_stream(net, prompt, max_new_tokens: int, *, engine=None,
+              deadline=None, seed: int = 0, **engine_kw):
+    """Stream generated tokens one at a time through the net's shared
+    continuous-batching engine (`serving.default_engine`): yields int
+    token ids as the engine emits them, so concurrent `lm_stream`
+    callers are CO-BATCHED into one decode program instead of running
+    serial `lm_generate` calls.
+
+    Abandoning the returned generator mid-stream (break / close / GC)
+    CANCELS the request and releases its paged KV blocks back to the
+    pool — streaming callers cannot leak cache memory (the regression
+    test pins the pool's free-block count).
+
+    ``deadline`` (seconds) bounds the request end-to-end — past it the
+    engine evicts the sequence mid-batch and the generator raises
+    `serving.RequestTimedOut`.  ``engine_kw`` (temperature, top_k,
+    eos_id, max_batch, ...) configures the shared engine on first use;
+    pass ``engine=`` to target an explicit `ServingEngine`.
+    """
+    from ..serving import default_engine
+
+    eng = engine if engine is not None else default_engine(net, **engine_kw)
+    req = eng.submit(prompt, max_new_tokens, deadline=deadline, seed=seed)
+    return req.stream()
 
 
 # --------------------------------------------------------------------- #

@@ -261,6 +261,16 @@ class TransformerLM(HybridBlock):
 
         return lm_score(self, tokens, **kw)
 
+    def serve(self, **kw):
+        """This net's shared continuous-batching serving engine
+        (paged KV cache, bounded admission queue, deadlines/eviction);
+        built on first use, reused after.  See
+        `serving.ServingEngine` for the config kwargs and
+        `generation.lm_stream` for one-call streaming."""
+        from ..serving import default_engine
+
+        return default_engine(self, **kw)
+
     def quantize_for_decode(self, **kw):
         """Weight-quantize this net's transformer matmuls for decode
         (per-channel int8 + fp32 scales; int8 weights stream through

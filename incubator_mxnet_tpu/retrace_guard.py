@@ -65,6 +65,10 @@ PROGRAM_NAMES: Set[str] = {
                                                 # programs and legitimately
                                                 # compiles this once
     "_flash_core",                              # flash-attention kernel jit
+    "serving_step", "serving_prefill",          # continuous-batching decode:
+                                                # ONE step program per engine
+                                                # + one prefill per prompt
+                                                # bucket (LRU-capped)
 }
 
 

@@ -1,0 +1,31 @@
+"""Overload-safe continuous-batching serving (ISSUE 12 tentpole).
+
+A production decode engine over `models.generation`'s programs:
+
+* `kv_pool`   — paged KV-cache block accounting (scratch block 0,
+  deterministic lowest-first allocation, double-free guards);
+* `programs`  — the static-shaped compiled programs (one batched
+  decode step per engine + LRU-capped per-bucket prefill), pool
+  arrays donated;
+* `engine`    — the iteration-level scheduler: bounded admission
+  queue with backpressure, SLO-aware shedding, per-request deadlines
+  with exact mid-batch eviction, cancellation that releases KV
+  blocks, clean drain()/close().
+
+Entry points: ``net.serve()`` / `default_engine(net)` for a shared
+engine, `ServingEngine` for explicit config, and
+``models.generation.lm_stream`` for one-call streaming generation.
+docs/serving.md is the architecture note; benchmark/serving_bench.py
+the open-loop load + fault-injection harness; ci/serving_smoke.py the
+CI gate (zero recompiles after warmup, sheds under overload, drains).
+"""
+from .engine import (Request, RequestCancelled, RequestFailed, RequestShed,
+                     RequestTimedOut, ServingEngine, ServingError,
+                     default_engine)
+from .kv_pool import SCRATCH_BLOCK, BlockPool
+from .programs import PagedPrograms
+
+__all__ = ["ServingEngine", "ServingError", "Request", "RequestShed",
+           "RequestTimedOut", "RequestCancelled", "RequestFailed",
+           "default_engine", "BlockPool", "SCRATCH_BLOCK",
+           "PagedPrograms"]

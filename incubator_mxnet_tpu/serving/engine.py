@@ -1,0 +1,738 @@
+"""Continuous-batching serving engine with overload safety.
+
+`ServingEngine` runs an iteration-level (Orca-style) scheduler on a
+background thread: between decode steps it retires finished sequences,
+evicts timed-out/cancelled ones, admits queued requests (prefill
+interleaved with decode), then executes ONE batched decode step for
+every live lane.  The KV cache is a paged pool (`kv_pool`,
+`programs`): admission and eviction move *block table entries*, never
+array shapes, so after warmup nothing recompiles — ci/serving_smoke.py
+pins this with a zero-budget RetraceGuard.
+
+The robustness envelope (the reason this engine exists — an engine
+that stalls or corrupts neighbours under overload is worse than none):
+
+* **Bounded admission queue** — `submit(block=False)` (default) SHEDS
+  when the queue is full (`RequestShed`, counted in
+  ``serving_shed_total{reason="queue_full"}``, never an unbounded
+  buffer); `block=True` waits with backpressure, observing close().
+* **SLO-aware shedding** — with a ``ttft_budget``, a request whose
+  estimated TTFT (queue wait so far + EWMA prefill time) already
+  exceeds the budget is shed at admission instead of admitted late.
+* **Deadlines** — a request past its deadline is shed while queued and
+  EVICTED mid-batch while running; eviction frees its blocks and
+  leaves every co-batched sequence bit-identical to an unperturbed run
+  (docs/serving.md §"Why eviction is exact" — lanes are independent
+  and masked scratch reads contribute exactly 0.0).
+* **Cancellation** — `Request.cancel()` is non-blocking and safe from
+  any thread; `Request.stream()` cancels in a ``finally`` so a caller
+  abandoning the generator mid-stream releases the KV blocks (the
+  r12 leak fix; regression-tested).
+* **Clean shutdown** — `close()` stops and JOINS the scheduler thread
+  (tpulint TPU012); scheduler errors are parked under a lock and
+  re-raised on the caller (TPU011, the checkpoint-worker idiom), and a
+  failed engine refuses new work instead of hanging it.
+
+Thread-safety: ONE lock (`self._lock`, shared by the `self._work`
+condition and every request's condition) guards the queue, slots,
+stats and pool accounting.  The scheduler thread is the only toucher
+of the device-side pool arrays, so device calls run lock-free; only
+bookkeeping holds the lock.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..models import generation as G
+from .kv_pool import SCRATCH_BLOCK, BlockPool
+from .programs import PagedPrograms
+
+__all__ = ["ServingError", "RequestShed", "RequestTimedOut",
+           "RequestCancelled", "RequestFailed", "Request", "ServingEngine",
+           "default_engine"]
+
+_POLL_S = float(os.environ.get("MXTPU_SERVING_POLL", "0.002"))
+_MAX_QUEUE = int(os.environ.get("MXTPU_SERVING_QUEUE", "16"))
+
+# terminal request statuses (everything else is live)
+_TERMINAL = ("done", "shed", "evicted", "cancelled", "failed")
+
+
+class ServingError(RuntimeError):
+    """Base class for per-request serving failures."""
+
+
+class RequestShed(ServingError):
+    """Rejected by admission control (bounded queue / SLO estimate /
+    queued-past-deadline); carries ``.reason``."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request shed ({reason})")
+        self.reason = reason
+
+
+class RequestTimedOut(ServingError):
+    """Evicted mid-batch: the per-request deadline passed."""
+
+
+class RequestCancelled(ServingError):
+    """Cancelled by the caller (or by engine shutdown)."""
+
+
+class RequestFailed(ServingError):
+    """The scheduler hit an internal error; the cause is chained."""
+
+
+class Request:
+    """A submitted generation request — a future over its token stream.
+
+    ``tokens`` grows as the engine emits (generated tokens only, prompt
+    excluded); `result()` blocks for completion, `stream()` iterates
+    tokens as they land and CANCELS on early exit.  Timing fields
+    (``t_submit``/``t_first``/``t_done``, ``time.monotonic`` seconds)
+    feed the load harness's TTFT/TPOT percentiles.
+    """
+
+    def __init__(self, engine: "ServingEngine", prompt: np.ndarray,
+                 max_new_tokens: int, deadline: Optional[float],
+                 seed: int):
+        self._engine = engine
+        self._cond = threading.Condition(engine._lock)
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline            # absolute monotonic, or None
+        self.seed = int(seed)
+        self.status = "new"
+        self.tokens: list = []
+        self.error: Optional[BaseException] = None
+        self.block_ids: tuple = ()
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._cancel = False
+
+    # -- engine side (engine lock held) ------------------------------- #
+    def _deliver(self, tok: int, now: float) -> None:
+        if self.t_first is None:
+            self.t_first = now
+        self.tokens.append(tok)
+        self._cond.notify_all()
+
+    def _finish(self, status: str, error: Optional[BaseException] = None):
+        self.status = status
+        self.error = error
+        self.t_done = time.monotonic()
+        self._cond.notify_all()
+
+    # -- caller side --------------------------------------------------- #
+    @property
+    def finished(self) -> bool:
+        return self.status in _TERMINAL
+
+    def cancel(self) -> None:
+        """Request cancellation (non-blocking, any thread, idempotent).
+        A queued request is discarded; a running one is evicted at the
+        next scheduler tick, freeing its KV blocks."""
+        self._cancel = True
+        eng = self._engine
+        with eng._work:
+            eng._work.notify_all()
+
+    def result(self, timeout: Optional[float] = None) -> list:
+        """Block until terminal; the generated token list, or raises
+        the request's `ServingError` (shed/evicted/cancelled/failed)."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self.status not in _TERMINAL:
+                left = None if end is None else end - time.monotonic()
+                if left is not None and left <= 0:
+                    raise TimeoutError(
+                        f"request not finished within {timeout}s "
+                        f"(status={self.status})")
+                self._cond.wait(_POLL_S if left is None
+                                else min(_POLL_S, left))
+            if self.error is not None:
+                raise self.error
+            return list(self.tokens)
+
+    def stream(self):
+        """Yield generated tokens as the engine emits them.  Exhausts
+        on completion; raises the request's error on shed/evict/fail.
+        Abandoning the generator (break / close / GC) cancels the
+        request so its KV blocks return to the pool — tested by
+        tests/test_serving.py::test_abandoned_stream_releases_blocks."""
+        idx = 0
+        try:
+            while True:
+                tok = None
+                with self._cond:
+                    while idx >= len(self.tokens) \
+                            and self.status not in _TERMINAL:
+                        self._cond.wait(_POLL_S)
+                    if idx < len(self.tokens):
+                        tok = self.tokens[idx]
+                        idx += 1
+                    elif self.error is not None:
+                        raise self.error
+                    else:
+                        return
+                yield tok
+        finally:
+            if not self.finished:
+                self.cancel()
+
+
+class _Slot:
+    """Host bookkeeping of one occupied batch lane."""
+
+    __slots__ = ("req", "blocks")
+
+    def __init__(self, req: Request, blocks: list):
+        self.req = req
+        self.blocks = blocks
+
+
+class ServingEngine:
+    """Continuous-batching decode over a `models.TransformerLM`.
+
+    Parameters (all static — changing them means a new engine):
+
+    max_batch       decode lanes run per step (batch width).
+    block_size      KV block width in positions (power of two).
+    max_seq_len     cap on prompt+generated per request; defaults to
+                    ``net._max_len`` rounded down to a block multiple.
+    num_blocks      pool size; default fits ``max_batch`` full-length
+                    sequences plus the scratch block.
+    max_queue       admission queue bound (default env
+                    ``MXTPU_SERVING_QUEUE`` = 16).
+    temperature/top_k/eos_id   sampling config (compiled into the
+                    programs, as in `lm_generate`).
+    ttft_budget     SLO seconds; estimated-late requests are shed.
+    default_deadline   per-request deadline seconds (overridable per
+                    submit).
+    quantized       weight path selector, as in `lm_generate`.
+    poll_interval   scheduler idle/wait tick (default env
+                    ``MXTPU_SERVING_POLL`` = 2 ms).
+    fault_hook      callable(phase: str) invoked before each
+                    "prefill"/"step" device call — the fault-injection
+                    seam the load harness and tests use (sleep = slow
+                    step, raise = scheduler failure).
+    """
+
+    def __init__(self, net, *, max_batch: int = 4, block_size: int = 16,
+                 max_seq_len: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: int = -1, ttft_budget: Optional[float] = None,
+                 default_deadline: Optional[float] = None,
+                 quantized=None, poll_interval: Optional[float] = None,
+                 fault_hook=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if block_size < 1 or (block_size & (block_size - 1)):
+            raise ValueError(
+                f"block_size must be a power of two, got {block_size}")
+        msl = int(max_seq_len if max_seq_len is not None else net._max_len)
+        msl = (msl // block_size) * block_size
+        if msl < block_size:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} < one block ({block_size})")
+        if msl > net._max_len:
+            raise ValueError(
+                f"max_seq_len {msl} exceeds net.max_len {net._max_len}")
+        self._net = net
+        self._B = int(max_batch)
+        self._bs = int(block_size)
+        self._msl = msl
+        self._nbps = msl // block_size
+        nb_default = self._B * self._nbps + 1
+        self._num_blocks = int(num_blocks if num_blocks is not None
+                               else nb_default)
+        self._max_queue = int(max_queue if max_queue is not None
+                              else _MAX_QUEUE)
+        self._eos = int(eos_id)
+        self._ttft_budget = ttft_budget
+        self._default_deadline = default_deadline
+        self._poll = float(poll_interval if poll_interval is not None
+                           else _POLL_S)
+        self._fault_hook = fault_hook
+
+        self._programs = PagedPrograms(
+            net, max_batch=self._B, block_size=self._bs,
+            blocks_per_seq=self._nbps, temperature=temperature,
+            top_k=top_k, quantized=quantized)
+        self._path = self._programs.path          # "float" / "int8"
+        self._params = self._programs.gather_params(self._msl)
+        G._record_decode_weight_bytes(self._params,
+                                      self._programs._qc)
+
+        # device pool: per-layer (num_blocks, H, bs, D); the engine
+        # holds the ONLY reference and replaces it after every donated
+        # call (the buffers really are deleted on XLA:CPU too)
+        emb = self._params["embed"]
+        H = net._layers[0].attn._num_heads
+        D = net._units // H
+        dt = emb.dtype
+        L = len(net._layers)
+        self._pool_k = tuple(
+            jnp.zeros((self._num_blocks, H, self._bs, D), dt)
+            for _ in range(L))
+        self._pool_v = tuple(
+            jnp.zeros((self._num_blocks, H, self._bs, D), dt)
+            for _ in range(L))
+        self._pool = BlockPool(self._num_blocks)
+
+        # per-lane step inputs (scheduler thread only; snapshots are
+        # passed to the program, so the jit never closes over state)
+        B, nbps = self._B, self._nbps
+        self._tables = np.full((B, nbps), SCRATCH_BLOCK, np.int32)
+        self._toks = np.zeros((B,), np.int32)
+        self._pos = np.zeros((B,), np.int32)
+        self._active = np.zeros((B,), bool)
+        self._keys = np.zeros((B, 2), np.uint32)
+        self._slots: list = [None] * B
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._stop = threading.Event()
+        self._closed = False
+        self._err_lock = threading.Lock()
+        self._pending_err: Optional[BaseException] = None
+        self._prefill_ewma: Optional[float] = None
+        self._stats = {"admitted": 0, "done": 0, "steps": 0,
+                       "shed": OrderedDict(), "evicted": OrderedDict()}
+        self._thread = threading.Thread(
+            target=self._scheduler, daemon=True,
+            name="mxtpu-serving-scheduler")
+        self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # public API
+    # ------------------------------------------------------------------ #
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def max_seq_len(self) -> int:
+        return self._msl
+
+    def set_fault_hook(self, hook) -> None:
+        with self._lock:
+            self._fault_hook = hook
+
+    def set_ttft_budget(self, seconds: Optional[float]) -> None:
+        with self._lock:
+            self._ttft_budget = seconds
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               deadline: Optional[float] = None, seed: int = 0,
+               block: bool = False,
+               timeout: Optional[float] = None) -> Request:
+        """Enqueue a generation request; returns its `Request` handle
+        immediately (inspect ``.status`` / call ``.result()``).
+
+        ``deadline`` is seconds from now (default the engine's
+        ``default_deadline``); a queue-full engine SHEDS the request
+        (``block=False``, the open-loop default) or waits for space up
+        to ``timeout`` (``block=True``) — waiting observes `close()`.
+        """
+        prompt = self._as_prompt(prompt)
+        P = prompt.shape[0]
+        N = int(max_new_tokens)
+        if N < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {N}")
+        if P < 1:
+            raise ValueError("prompt must be non-empty")
+        if P + N > self._msl:
+            raise ValueError(
+                f"prompt+new = {P + N} exceeds max_seq_len {self._msl}")
+        if self._blocks_needed(P, N) > self._num_blocks - 1:
+            raise ValueError(
+                f"request needs {self._blocks_needed(P, N)} KV blocks "
+                f"but the pool only has {self._num_blocks - 1} — it "
+                "could never be admitted")
+        if deadline is None:
+            deadline = self._default_deadline
+        abs_deadline = None if deadline is None \
+            else time.monotonic() + float(deadline)
+        req = Request(self, prompt, N, abs_deadline, seed)
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._work:
+            self._check_alive()
+            while len(self._queue) >= self._max_queue:
+                if not block:
+                    self._shed_locked(req, "queue_full")
+                    return req
+                left = None if end is None else end - time.monotonic()
+                if left is not None and left <= 0:
+                    self._shed_locked(req, "queue_full")
+                    return req
+                self._work.wait(self._poll if left is None
+                                else min(self._poll, left))
+                self._check_alive()
+            req.status = "queued"
+            self._queue.append(req)
+            self._note_queue_depth_locked()
+            self._work.notify_all()
+        return req
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the queue is empty and every lane idle; True on
+        success, False on timeout (work still in flight)."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._work:
+            while self._queue or any(s is not None for s in self._slots):
+                if self._has_pending_err() or self._closed:
+                    return not (self._queue
+                                or any(s is not None for s in self._slots))
+                left = None if end is None else end - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._work.wait(self._poll if left is None
+                                else min(self._poll, left))
+            return True
+
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        """Stop and JOIN the scheduler thread, abort any unfinished
+        requests (their handles see `RequestCancelled`), release all
+        blocks, and re-raise a parked scheduler error (idempotent)."""
+        with self._work:
+            already = self._closed
+            self._closed = True
+            self._stop.set()
+            self._work.notify_all()
+        if not already:
+            self._thread.join(timeout)
+            with self._work:
+                self._abort_all_locked(
+                    RequestCancelled("serving engine closed"))
+                self._work.notify_all()
+        with self._err_lock:
+            err, self._pending_err = self._pending_err, None
+        if err is not None:
+            raise RequestFailed("serving scheduler failed") from err
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        """Snapshot of the engine's counters (host-side, lock-held)."""
+        with self._lock:
+            return {
+                "admitted": self._stats["admitted"],
+                "done": self._stats["done"],
+                "steps": self._stats["steps"],
+                "shed": dict(self._stats["shed"]),
+                "evicted": dict(self._stats["evicted"]),
+                "queue_depth": len(self._queue),
+                "active": int(self._active.sum()),
+                "blocks_free": self._pool.num_free,
+                "blocks_total": self._num_blocks - 1,
+            }
+
+    # ------------------------------------------------------------------ #
+    # internals
+    # ------------------------------------------------------------------ #
+    @staticmethod
+    def _as_prompt(prompt) -> np.ndarray:
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(prompt, NDArray):
+            prompt = prompt._data
+        arr = np.asarray(prompt, np.int32)
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1:
+            raise ValueError(
+                f"prompt must be 1-D (or (1, P)), got shape {arr.shape}")
+        return arr
+
+    def _has_pending_err(self) -> bool:
+        with self._err_lock:
+            return self._pending_err is not None
+
+    def _check_alive(self) -> None:
+        with self._err_lock:
+            err = self._pending_err
+        if err is not None:
+            raise RequestFailed("serving scheduler failed") from err
+        if self._closed:
+            raise RuntimeError("serving engine is closed")
+
+    def _blocks_needed(self, P: int, N: int) -> int:
+        nbp_prefill = -(-self._bucket(P) // self._bs)
+        return max(nbp_prefill, -(-(P + N) // self._bs))
+
+    def _bucket(self, P: int) -> int:
+        return min(G.bucket_length(P, floor=self._bs), self._msl)
+
+    def _count(self, table: OrderedDict, reason: str) -> None:
+        table[reason] = table.get(reason, 0) + 1
+
+    def _note_queue_depth_locked(self) -> None:
+        if telemetry.enabled():
+            telemetry.gauge("serving_queue_depth").set(len(self._queue))
+
+    def _shed_locked(self, req: Request, reason: str) -> None:
+        req._finish("shed", RequestShed(reason))
+        self._count(self._stats["shed"], reason)
+        if telemetry.enabled():
+            telemetry.counter("serving_shed_total",
+                              labels={"reason": reason}).inc()
+
+    def _abort_all_locked(self, error: BaseException) -> None:
+        while self._queue:
+            self._queue.popleft()._finish("cancelled", error)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            self._release_lane_locked(i)
+            slot.req._finish("cancelled", error)
+        self._note_queue_depth_locked()
+
+    def _release_lane_locked(self, i: int) -> None:
+        slot = self._slots[i]
+        self._pool.free(slot.blocks)
+        self._slots[i] = None
+        self._tables[i, :] = SCRATCH_BLOCK
+        self._active[i] = False
+        self._toks[i] = 0
+        self._pos[i] = 0
+        if telemetry.enabled():
+            telemetry.gauge("serving_kv_blocks_in_use") \
+                .set(self._pool.num_allocated)
+
+    def _evict_locked(self, i: int, reason: str,
+                      error: BaseException) -> None:
+        req = self._slots[i].req
+        self._release_lane_locked(i)
+        req._finish("cancelled" if reason == "cancel" else "evicted",
+                    error)
+        self._count(self._stats["evicted"], reason)
+        if telemetry.enabled():
+            telemetry.counter("serving_evicted_total",
+                              labels={"reason": reason}).inc()
+
+    # -- scheduler thread ---------------------------------------------- #
+    def _scheduler(self) -> None:
+        try:
+            self._loop()
+        except BaseException as e:
+            with self._err_lock:
+                self._pending_err = e
+            failure = RequestFailed("serving scheduler failed")
+            failure.__cause__ = e
+            with self._work:
+                while self._queue:
+                    self._queue.popleft()._finish("failed", failure)
+                for i, slot in enumerate(self._slots):
+                    if slot is not None:
+                        self._release_lane_locked(i)
+                        slot.req._finish("failed", failure)
+                self._note_queue_depth_locked()
+                self._work.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                if self._stop.is_set():
+                    return
+                now = time.monotonic()
+                self._reap_locked(now)
+                self._admit_locked(now)
+                live = [(i, s.req) for i, s in enumerate(self._slots)
+                        if s is not None and self._active[i]]
+                if not live:
+                    if not self._queue:
+                        self._work.wait(self._poll)
+                    continue
+                snap = (self._tables.copy(), self._toks.copy(),
+                        self._pos.copy(), self._active.copy(),
+                        self._keys.copy())
+                hook = self._fault_hook
+            self._decode_step(snap, live, hook)
+
+    def _reap_locked(self, now: float) -> None:
+        # queued requests: cancellation and deadlines apply while waiting
+        if self._queue:
+            keep = deque()
+            for req in self._queue:
+                if req._cancel:
+                    req._finish("cancelled", RequestCancelled("cancelled"))
+                elif req.deadline is not None and now > req.deadline:
+                    self._shed_locked(req, "deadline")
+                else:
+                    keep.append(req)
+            if len(keep) != len(self._queue):
+                # mutate in place: the deque identity is shared with
+                # every lock-holding reader (submit/stats/drain)
+                self._queue.clear()
+                self._queue.extend(keep)
+                self._note_queue_depth_locked()
+                self._work.notify_all()     # queue space freed
+        # running lanes: evict mid-batch (blocks freed, neighbours
+        # untouched — see docs/serving.md for why this is exact)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.req._cancel:
+                self._evict_locked(i, "cancel",
+                                   RequestCancelled("cancelled"))
+            elif slot.req.deadline is not None \
+                    and now > slot.req.deadline:
+                self._evict_locked(
+                    i, "timeout",
+                    RequestTimedOut(f"deadline exceeded after "
+                                    f"{len(slot.req.tokens)} token(s)"))
+
+    def _admit_locked(self, now: float) -> None:
+        while self._queue:
+            req = self._queue[0]
+            if self._ttft_budget is not None \
+                    and self._prefill_ewma is not None:
+                est = (now - req.t_submit) + self._prefill_ewma
+                if est > self._ttft_budget:
+                    self._queue.popleft()
+                    self._shed_locked(req, "slo")
+                    self._note_queue_depth_locked()
+                    self._work.notify_all()
+                    continue
+            try:
+                lane = self._slots.index(None)
+            except ValueError:
+                return                      # batch full
+            blocks = self._pool.alloc(
+                self._blocks_needed(req.prompt.shape[0],
+                                    req.max_new_tokens))
+            if blocks is None:
+                return                      # pool full: FCFS head waits
+            # admit BEFORE popping: if the prefill (or a fault hook)
+            # raises, the request is still queued and the scheduler's
+            # failure path finishes it — no handle ever hangs
+            self._admit_one_locked(lane, req, blocks)
+            self._queue.popleft()
+            self._note_queue_depth_locked()
+            self._work.notify_all()         # queue space freed
+
+    def _admit_one_locked(self, lane: int, req: Request,
+                          blocks: list) -> None:
+        P = req.prompt.shape[0]
+        Pb = self._bucket(P)
+        nbp = -(-Pb // self._bs)
+        row = np.full((self._nbps,), SCRATCH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        key = np.array([(req.seed >> 32) & 0xFFFFFFFF,
+                        req.seed & 0xFFFFFFFF], np.uint32)
+        padded = np.zeros((1, Pb), np.int32)
+        padded[0, :P] = req.prompt
+        hook = self._fault_hook
+        if hook is not None:
+            hook("prefill")
+        fn = self._programs.prefill(Pb)
+        t0 = time.perf_counter()
+        self._pool_k, self._pool_v, first = G._timed_decode(
+            f"serving_prefill_{self._path}", f"serving_{self._path}", 1,
+            fn, self._pool_k, self._pool_v, row[:nbp], padded,
+            np.int32(P), key, self._params)
+        tok = int(np.asarray(first)[0])
+        dt = time.perf_counter() - t0
+        self._prefill_ewma = dt if self._prefill_ewma is None \
+            else 0.8 * self._prefill_ewma + 0.2 * dt
+        now = time.monotonic()
+        self._slots[lane] = _Slot(req, blocks)
+        req.block_ids = tuple(blocks)
+        req.status = "running"
+        req._deliver(tok, now)
+        self._stats["admitted"] += 1
+        if telemetry.enabled():
+            telemetry.counter("serving_admitted_total").inc()
+            telemetry.histogram(
+                "serving_ttft_seconds",
+                labels={"path": self._path}).observe(now - req.t_submit)
+            telemetry.gauge("serving_kv_blocks_in_use") \
+                .set(self._pool.num_allocated)
+        if tok == self._eos or len(req.tokens) >= req.max_new_tokens:
+            self._retire_locked(lane)
+            return
+        self._tables[lane, :] = row
+        self._toks[lane] = tok
+        self._pos[lane] = P
+        self._active[lane] = True
+        self._keys[lane, :] = key
+
+    def _retire_locked(self, lane: int) -> None:
+        req = self._slots[lane].req
+        self._release_lane_locked(lane)
+        req._finish("done")
+        self._stats["done"] += 1
+        self._work.notify_all()             # drain()ers and submitters
+
+    def _decode_step(self, snap, live, hook) -> None:
+        """One batched decode step — device call OUTSIDE the lock, so
+        submit()/cancel() never block on compute (a fault hook's
+        injected sleep included)."""
+        if hook is not None:
+            hook("step")
+        tables, toks, pos, active, keys = snap
+        t0 = time.perf_counter()
+        self._pool_k, self._pool_v, nxt = G._timed_decode(
+            f"serving_step_{self._path}", f"serving_{self._path}",
+            len(live), self._programs.step, self._pool_k, self._pool_v,
+            tables, toks, pos, active, keys, self._params)
+        nxt = np.asarray(nxt)               # sync: tokens are consumed now
+        dt = time.perf_counter() - t0
+        now = time.monotonic()
+        with self._work:
+            self._stats["steps"] += 1
+            for lane, req in live:
+                slot = self._slots[lane]
+                if slot is None or slot.req is not req:
+                    continue                # evicted while stepping
+                tok = int(nxt[lane])
+                req._deliver(tok, now)
+                self._pos[lane] += 1
+                self._toks[lane] = tok
+                if tok == self._eos \
+                        or len(req.tokens) >= req.max_new_tokens:
+                    self._retire_locked(lane)
+            if telemetry.enabled():
+                telemetry.histogram("serving_tpot_seconds",
+                                    labels={"path": self._path}) \
+                    .observe(dt)
+                telemetry.gauge("serving_batch_occupancy") \
+                    .set(len(live))
+
+
+def default_engine(net, **kw) -> ServingEngine:
+    """The net's shared serving engine, built on first use and cached
+    on the net (``net._serving_engine``).  Passing config kwargs that
+    differ from the cached engine's closes it and builds a fresh one;
+    equal (or no) kwargs reuse it — so `lm_stream` callers share one
+    warm engine and one compiled program set."""
+    eng = getattr(net, "_serving_engine", None)
+    if eng is not None and not eng.closed:
+        if not kw or kw == eng._ctor_kw:
+            return eng
+    if eng is not None and not eng.closed:
+        try:
+            eng.close()
+        except ServingError:
+            pass
+    eng = ServingEngine(net, **kw)
+    eng._ctor_kw = dict(kw)
+    net._serving_engine = eng
+    return eng

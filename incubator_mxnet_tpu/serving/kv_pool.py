@@ -1,0 +1,84 @@
+"""Paged KV-cache block accounting for the serving engine.
+
+The pool itself is a pair of per-layer device arrays of shape
+``(num_blocks, H, block_size, D)`` owned by the engine; THIS module is
+only the host-side allocator that decides which block ids a sequence
+may write.  Splitting the accounting from the arrays keeps the device
+side static-shaped (admitting or evicting a sequence never changes an
+array shape, so it never recompiles a program) while the host side
+stays trivially testable.
+
+Design rules:
+
+* **Block 0 is the scratch block** (`SCRATCH_BLOCK`): every
+  unallocated block-table entry points at it, and inactive batch lanes
+  write their garbage K/V there.  Its content is always *finite*
+  (it only ever receives real activations or its zero initialization),
+  which is what makes masked attention over it contribute exactly 0 —
+  the bit-identity argument in docs/serving.md leans on this.
+* **Deterministic allocation**: `alloc` always hands out the
+  lowest-numbered free blocks.  Two runs that admit the same requests
+  in the same order produce identical block tables — eviction-parity
+  tests (and production triage) depend on replayable layouts.
+* **Fail-fast accounting**: freeing a block twice, or freeing the
+  scratch block, raises — a double-free here would silently corrupt a
+  neighbour sequence's cache, the exact class of bug the serving
+  robustness envelope exists to exclude.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Sequence
+
+__all__ = ["SCRATCH_BLOCK", "BlockPool"]
+
+SCRATCH_BLOCK = 0
+
+
+class BlockPool:
+    """Free-list allocator over ``num_blocks`` KV blocks.
+
+    Block ids run ``0 .. num_blocks-1``; id 0 (`SCRATCH_BLOCK`) is
+    reserved and never handed out, so a pool of ``num_blocks`` serves
+    ``num_blocks - 1`` allocatable blocks.  Not thread-safe by itself —
+    the engine serializes access under its own lock.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (scratch + 1 usable), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(1, self.num_blocks))
+        heapq.heapify(self._free)
+        self._allocated: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Lowest ``n`` free block ids, or None (caller backs off) when
+        fewer than ``n`` are free — all-or-nothing, so a half-admitted
+        sequence can never exist."""
+        if n < 0:
+            raise ValueError(f"block count must be >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        ids = [heapq.heappop(self._free) for _ in range(n)]
+        self._allocated.update(ids)
+        return ids
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Return blocks to the pool (eviction/retirement path)."""
+        for b in ids:
+            if b == SCRATCH_BLOCK:
+                raise ValueError("cannot free the scratch block")
+            if b not in self._allocated:
+                raise ValueError(f"double free of block {b}")
+            self._allocated.discard(b)
+            heapq.heappush(self._free, b)

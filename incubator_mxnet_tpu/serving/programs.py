@@ -1,0 +1,240 @@
+"""Compiled programs for paged continuous-batching decode.
+
+Two program families, both STATIC-shaped so the serving engine never
+recompiles after warmup (RetraceGuard-pinned in ci/serving_smoke.py):
+
+* ``serving_step`` — ONE decode step for the whole fixed-width batch
+  (``max_batch`` lanes).  Each lane carries its own block table row,
+  position, token and PRNG key; inactive lanes write their K/V into
+  the scratch block and their outputs are ignored host-side.  Compiled
+  exactly once per engine: admission/eviction only change *argument
+  values* (tables, masks), never shapes.
+* ``serving_prefill`` — one prompt prefill at batch 1, padded to the
+  prompt's power-of-two length bucket (`generation.bucket_length`)
+  with the true length riding in as a traced scalar — one program per
+  BUCKET, LRU-capped, reusing r7's program-cache idiom.
+
+Both donate the pool arrays (``donate_argnums=(0, 1)``): the K/V pool
+is a ring the engine threads through every call, and an un-donated
+pool would copy the whole cache per token.  Donation coverage is
+CI-pinned via `.hlolint_contracts.json` (serving_* entries).
+
+Numerics: scores and softmax in fp32 with an iota position mask,
+exactly `generation._cached_self_attn`'s recipe — greedy tokens agree
+with `lm_generate` and co-batched lanes are INDEPENDENT (batched
+matmuls never mix lanes; masked key slots contribute exactly 0.0), the
+two facts the eviction bit-identity contract rests on (docs/serving.md
+§"Why eviction is exact").
+
+Everything a program closes over is a plain int/float/str/tuple
+(tpulint TPU008: no device arrays, no ``self`` captured); weights,
+pools and per-lane state enter as arguments.
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from ..models import generation as G
+
+__all__ = ["PagedPrograms"]
+
+# LRU cap for the net-level serving program cache (override per net via
+# `net._serving_program_cache_cap`): one step program per engine config
+# plus one prefill per (config, bucket)
+_PROGRAM_CACHE_CAP = 16
+
+
+def _net_program_cache(net):
+    """Net-level cache of JITTED serving programs keyed by the full
+    static config, so a rebuilt engine with the same config (serving
+    restarts, tests) reuses compiled programs instead of recompiling —
+    the step/prefill analogue of generation's per-net program cache."""
+    cache = getattr(net, "_serving_programs", None)
+    if cache is None:
+        cache = net._serving_programs = OrderedDict()
+    return cache
+
+
+def _row_pick(temperature, top_k):
+    """Single-lane token pick: logits (V,), position t, per-request key
+    (2,) uint32 — greedy argmax at temperature<=0, else top-k-truncated
+    sampling with a counter-based `fold_in(key, t)` so a request's
+    sample stream depends only on (its seed, its positions), never on
+    who it was co-batched with."""
+    def pick(logits, t, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits / jnp.float32(temperature)
+        if top_k > 0:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
+        return jax.random.categorical(
+            jax.random.fold_in(key, t), lg, axis=-1).astype(jnp.int32)
+
+    return pick
+
+
+def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k):
+    """The batched one-token decode program over the paged pool.
+
+    Arguments (all traced):
+      pool_k/pool_v  per-layer tuples, each (num_blocks, H, bs, D)
+      tables         (B, blocks_per_seq) int32 block ids per lane
+      toks           (B,) int32 — token emitted by the previous step
+      pos            (B,) int32 — position this step writes/attends to
+      active         (B,) bool  — lanes with a live sequence
+      keys           (B, 2) uint32 — per-lane PRNG keys
+      params         generation._gather_params pytree
+    Returns (new_pool_k, new_pool_v, next_tokens (B,) int32).
+    """
+    bs = int(block_size)
+    W = int(blocks_per_seq) * bs  # attention width = max_seq_len
+    pick = _row_pick(temperature, top_k)
+
+    def serving_step(pool_k, pool_v, tables, toks, pos, active, keys,
+                     params):
+        dt = params["embed"].dtype
+        B = toks.shape[0]
+        C = params["embed"].shape[1]
+        h = (params["embed"][toks].astype(dt) * math.sqrt(C)
+             + params["pe"][pos].astype(dt))                    # (B, C)
+        blk_idx = pos // bs
+        off = pos % bs
+        # the block this step writes: the lane's table entry for its
+        # current position — inactive lanes are pointed at scratch
+        wblk = jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0]
+        wblk = jnp.where(active, wblk, jnp.int32(0))
+        new_k, new_v = [], []
+        for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+            x = G._ln(h, *lp["ln1"])
+            q, k, v = G._qkv_heads(G._dense(x, *lp["qkv"]), H)  # (B, H, D)
+            D = q.shape[-1]
+            # write-then-read, the _cached_self_attn order: position
+            # `pos` is valid by the time the mask admits it
+            pk = pool_k[li].at[wblk, :, off].set(k)
+            pv = pool_v[li].at[wblk, :, off].set(v)
+            # gather the lane's pages and flatten to a dense cache view
+            # (B, H, W, D); entry j of W is block j//bs, offset j%bs —
+            # i.e. absolute position j
+            gk = pk[tables].transpose(0, 2, 1, 3, 4).reshape(B, H, W, D)
+            gv = pv[tables].transpose(0, 2, 1, 3, 4).reshape(B, H, W, D)
+            s = jnp.einsum("bhd,bhkd->bhk", q, gk,
+                           preferred_element_type=jnp.float32) \
+                / math.sqrt(D)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(kpos <= pos[:, None, None], s,
+                          jnp.finfo(jnp.float32).min)
+            p = jax.nn.softmax(s, axis=-1)
+            a = jnp.einsum("bhk,bhkd->bhd", p, gv,
+                           preferred_element_type=jnp.float32).astype(dt)
+            h = h + G._dense(a.reshape(B, C), *lp["proj"])
+            h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
+            new_k.append(pk)
+            new_v.append(pv)
+        logits = G._logits_of(params, h)                        # (B, V)
+        nxt = jax.vmap(pick)(logits, pos, keys)
+        return tuple(new_k), tuple(new_v), nxt
+
+    return serving_step
+
+
+def _build_prefill(H, acts, block_size, bucket, temperature, top_k):
+    """Prompt prefill for one length bucket: runs the training-numerics
+    prefill (`generation._prefill`, right-padded prompt + traced
+    valid_len), scatters the resulting per-layer caches into the
+    sequence's pool blocks, and picks the FIRST generated token from
+    h_last — so TTFT is one program call after admission.
+
+    table_row is the (nbp,) int32 ids of the blocks covering the
+    bucket; positions >= valid_len hold pad garbage that decode
+    overwrites before ever attending to it (write-before-read).
+    """
+    bs = int(block_size)
+    Pb = int(bucket)
+    nbp = -(-Pb // bs)          # blocks covering the bucket
+    pad_to = nbp * bs
+    pick = _row_pick(temperature, top_k)
+
+    def serving_prefill(pool_k, pool_v, table_row, prompt, valid_len, key,
+                        params):
+        h_last, kcs, vcs = G._prefill(params, prompt, acts, H, pad_to,
+                                      valid_len=valid_len)
+        new_k, new_v = [], []
+        for li in range(len(acts)):
+            # (1, H, pad_to, D) -> (nbp, H, bs, D): page the cache
+            kc = kcs[li][0].reshape(-1, nbp, bs, kcs[li].shape[-1])
+            vc = vcs[li][0].reshape(-1, nbp, bs, vcs[li].shape[-1])
+            new_k.append(pool_k[li].at[table_row].set(
+                kc.transpose(1, 0, 2, 3)))
+            new_v.append(pool_v[li].at[table_row].set(
+                vc.transpose(1, 0, 2, 3)))
+        first = pick(G._logits_of(params, h_last), valid_len - 1, key)
+        return tuple(new_k), tuple(new_v), first
+
+    return serving_prefill
+
+
+class PagedPrograms:
+    """The engine's compiled-program surface: one jitted step program
+    plus per-bucket prefill programs, all resolved through a net-level
+    LRU keyed by the full static config — rebuilding an engine with
+    the same config reuses the compiled programs.  Holds only static
+    config — the engine owns the pool arrays and the weights pytree."""
+
+    def __init__(self, net, *, max_batch, block_size, blocks_per_seq,
+                 temperature, top_k, quantized):
+        self._net = net
+        self._H = net._layers[0].attn._num_heads
+        self._acts = tuple(lyr.ffn._act for lyr in net._layers)
+        self._bs = int(block_size)
+        self._nbps = int(blocks_per_seq)
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._qc = G._quant_config(net, quantized)
+        self._key = (self._H, self._acts, self._bs, self._nbps,
+                     self._temperature, self._top_k, self.path)
+        cache = _net_program_cache(net)
+        step = G._lru_touch(cache, ("step",) + self._key)
+        if step is None:
+            step = jax.jit(
+                _build_step(self._H, self._acts, self._bs, self._nbps,
+                            self._temperature, self._top_k),
+                donate_argnums=(0, 1))
+            G._lru_put(net, cache, ("step",) + self._key, step,
+                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        self._step = step
+
+    @property
+    def path(self) -> str:
+        """Telemetry label of the weight path ("float" / "int8")."""
+        return G._decode_path(self._qc)
+
+    def gather_params(self, pe_width):
+        """The live weight pytree the programs consume (the serving
+        engine gathers once per admission batch, not per token)."""
+        return G._gather_params(self._net, pe_width, self._qc)
+
+    @property
+    def step(self):
+        return self._step
+
+    def prefill(self, bucket):
+        """The jitted prefill program for prompt bucket ``bucket``
+        (net-level LRU; cap via `net._serving_program_cache_cap`)."""
+        cache = _net_program_cache(self._net)
+        key = ("prefill", bucket) + self._key
+        fn = G._lru_touch(cache, key)
+        if fn is None:
+            fn = jax.jit(
+                _build_prefill(self._H, self._acts, self._bs, bucket,
+                               self._temperature, self._top_k),
+                donate_argnums=(0, 1))
+            G._lru_put(self._net, cache, key, fn,
+                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        return fn
