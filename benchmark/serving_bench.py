@@ -16,10 +16,12 @@ the engine itself (Request.t_submit / t_first / t_done), so TTFT
 includes queueing delay and TPOT is pure decode cadence.
 
 Emits ONE BENCH-style JSON row (the repo convention, see bench.py /
-BENCH_r05.json): {"metric", "value", "unit", "detail"} where value is
-goodput (decoded tok/s of requests that COMPLETED — shed and evicted
-work counts as zero) and detail carries offered load, shed fraction,
-and TTFT/TPOT p50/p95/p99.
+BENCH_r06.json): {"metric", "value", "unit", "detail"} where value is
+GOODPUT UNDER SLO — decoded tok/s of requests that completed AND met
+both latency targets (``--ttft-slo-ms``, ``--tpot-slo-ms``; shed,
+evicted and SLO-violating work all count as zero, the number a
+capacity planner actually provisions against) — and detail carries raw
+goodput, offered load, shed fraction and TTFT/TPOT p50/p95/p99.
 """
 import argparse
 import json
@@ -61,6 +63,12 @@ def main():
     ap.add_argument("--cancel-frac", type=float, default=0.0,
                     help="fault injection: cancel this fraction of "
                          "requests ~one step after submission")
+    ap.add_argument("--ttft-slo-ms", type=float, default=2000.0,
+                    help="TTFT target a request must meet to count "
+                         "toward goodput-under-SLO")
+    ap.add_argument("--tpot-slo-ms", type=float, default=500.0,
+                    help="TPOT target a request must meet to count "
+                         "toward goodput-under-SLO")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", help="also write the JSON row here")
     args = ap.parse_args()
@@ -79,7 +87,9 @@ def main():
     net.cast("bfloat16")
 
     eng = ServingEngine(net, max_batch=args.max_batch, block_size=16,
-                        max_seq_len=msl, max_queue=args.max_queue)
+                        max_seq_len=msl, max_queue=args.max_queue,
+                        slo_ttft=args.ttft_slo_ms / 1e3,
+                        slo_tpot=args.tpot_slo_ms / 1e3)
 
     rng = np.random.RandomState(args.seed)
     prompts = [rng.randint(0, V, size=args.prompt_len).astype(np.int32)
@@ -118,15 +128,25 @@ def main():
                   for r in done if len(r.tokens) > 1)
     good_tokens = sum(len(r.tokens) for r in done)
     goodput = good_tokens / wall
+    # goodput UNDER SLO: only requests meeting both latency targets
+    # (the engine derives r.ttft / r.tpot at finish time)
+    slo_ok = [r for r in done
+              if (r.ttft is None or r.ttft <= args.ttft_slo_ms / 1e3)
+              and (r.tpot is None or r.tpot <= args.tpot_slo_ms / 1e3)]
+    slo_tokens = sum(len(r.tokens) for r in slo_ok)
 
     row = {
-        "metric": "serving_goodput",
-        "value": round(goodput, 1),
+        "metric": "serving_goodput_under_slo",
+        "value": round(slo_tokens / wall, 1),
         "unit": "tok/s",
         "detail": {
             "offered_load_hz": args.rate,
             "requests": args.requests,
             "served": len(done),
+            "served_under_slo": len(slo_ok),
+            "goodput_raw": round(goodput, 1),
+            "ttft_slo_ms": args.ttft_slo_ms,
+            "tpot_slo_ms": args.tpot_slo_ms,
             "shed": shed,
             "shed_fraction": round(shed / args.requests, 4),
             "evicted": evicted,

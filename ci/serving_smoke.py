@@ -18,13 +18,22 @@ asserts the overload contract:
    scheduler thread joins, blocks all return to the pool.
 5. **Metrics present** — the serving counters/histograms documented in
    docs/observability.md actually populated.
+6. **Ops plane live** (ISSUE 13) — /metrics scraped over HTTP DURING
+   the overloaded run returns scrape-conformant Prometheus text with
+   the right content type, including ``serving_slo_fraction``;
+   /healthz answers; after the run /requestz shows a complete span
+   timeline for at least one shed AND one evicted request; every
+   terminal request has a complete trace; close() joins the HTTP
+   acceptor thread along with the scheduler.
 
 Budget: well under 30 s on the CPU smoke host.
 Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/serving_smoke.py
 """
+import json
 import os
 import sys
 import time
+import urllib.request
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
@@ -51,6 +60,41 @@ ARRIVAL_RATE_HZ = 60.0        # >> capacity with the slow step below
 SLOW_STEP_S = 0.02
 MAX_QUEUE = 3
 SEED = 0
+TERMINAL_EVENTS = ("done", "shed", "evicted", "cancelled", "failed")
+
+
+def _fetch(base: str, path: str):
+    """(status, content_type, body) for one GET against the ops plane."""
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), \
+            resp.read().decode("utf-8")
+
+
+def _check_prom_conformance(body: str) -> None:
+    """Scrape conformance: prefer the real parser when the host has
+    prometheus_client; always check the histogram grammar by hand
+    (cumulative le buckets ending at +Inf, _sum/_count present)."""
+    try:
+        from prometheus_client.parser import text_string_to_metric_families
+        assert list(text_string_to_metric_families(body))
+    except ImportError:
+        pass
+    hists = {}
+    for line in body.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        if "_bucket{" in line and 'le="' in line:
+            series = line.split('le="', 1)[0]   # name + labels before le
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            cum = float(line.rsplit(" ", 1)[1])
+            hists.setdefault(series, []).append((le, cum))
+    assert hists, "no histograms in the scrape"
+    for series, buckets in hists.items():
+        name = series.split("_bucket", 1)[0]
+        assert buckets[-1][0] == "+Inf", f"{series}: no +Inf bucket"
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), f"{series}: buckets not cumulative"
+        assert f"{name}_sum" in body and f"{name}_count" in body, series
 
 
 def main() -> int:
@@ -62,8 +106,11 @@ def main() -> int:
     net.initialize()
     net(NDArray(jnp.ones((1, 4), jnp.int32)))
 
+    telemetry.requestlog.clear()
     eng = ServingEngine(net, max_batch=2, block_size=8, max_queue=MAX_QUEUE,
-                        poll_interval=0.001)
+                        poll_interval=0.001, http_port=0)
+    assert eng.http_port, "ops endpoint did not come up on port 0"
+    base = f"http://127.0.0.1:{eng.http_port}"
 
     # -- warmup: compile the step program and both prompt buckets ------ #
     for p in ((3, 7, 11), (2, 9, 4, 1, 5, 8, 6, 3, 2)):   # buckets 8, 16
@@ -80,19 +127,36 @@ def main() -> int:
     reqs = []
     with RetraceGuard(budget=0,
                       watch={"serving_step", "serving_prefill"}) as guard:
+        # one request whose deadline expires mid-decode: admitted first
+        # (empty queue), then evicted — /requestz must explain it
+        doomed = eng.submit(prompts[0], 48, deadline=0.5)
+        reqs.append(doomed)
         for gap, prompt in zip(gaps, prompts):
             time.sleep(gap)
             reqs.append(eng.submit(prompt, 6))    # open loop: never blocks
+        # scrape the ops plane WHILE the engine is loaded
+        code, ctype, metrics_body = _fetch(base, "/metrics")
+        assert code == 200 and ctype.startswith("text/plain; version=0.0.4"), \
+            (code, ctype)
+        hcode, _, hbody = _fetch(base, "/healthz")
+        assert hcode == 200, (hcode, hbody)   # degraded is still 200
+        assert json.loads(hbody)["status"] in ("healthy", "degraded")
         assert eng.drain(timeout=60), "engine failed to drain under load"
         guard.check()     # zero serving-program compiles after warmup
+    assert "serving_slo_fraction" in metrics_body, "SLO gauge not scraped"
+    assert "serving_slo_burn_rate" in metrics_body
+    _check_prom_conformance(metrics_body)
 
     # -- overload contract --------------------------------------------- #
     stats = eng.stats()
     shed = sum(stats["shed"].values())
+    evicted = sum(stats["evicted"].values())
     done = [r for r in reqs if r.status == "done"]
     assert shed >= 1, f"no sheds at {ARRIVAL_RATE_HZ} Hz offered: {stats}"
     assert done, f"nothing admitted: {stats}"
-    assert len(done) + shed == len(reqs), stats
+    assert doomed.status == "evicted", \
+        f"deadline request not evicted: {doomed.status}"
+    assert len(done) + shed + evicted == len(reqs), stats
     assert stats["blocks_free"] == stats["blocks_total"], stats
     ttfts = sorted(r.t_first - r.t_submit for r in done)
     p50 = ttfts[len(ttfts) // 2]
@@ -111,16 +175,40 @@ def main() -> int:
     assert reg.get("serving_shed_total",
                    {"reason": "queue_full"}).value >= 1
 
+    # -- request traces: every terminal request is fully explained ----- #
+    for r in reqs:
+        evs = [e["name"] for e in r.trace.snapshot()]
+        assert evs[0] == "submit" and evs[-1] in TERMINAL_EVENTS, \
+            f"incomplete trace for rid={r.rid}: {evs}"
+    rcode, _, rbody = _fetch(base, "/requestz")
+    assert rcode == 200
+    requestz = json.loads(rbody)
+    by_status = {}
+    for t in requestz["recent"]:
+        by_status.setdefault(t["status"], []).append(t)
+    for status in ("shed", "evicted"):
+        assert by_status.get(status), \
+            f"/requestz shows no {status} trace: {sorted(by_status)}"
+        names = [e["name"] for e in by_status[status][0]["events"]]
+        assert names[0] == "submit" and names[-1] == status, names
+    # the evicted one was admitted first — its timeline proves it ran
+    ev_names = [e["name"] for e in by_status["evicted"][0]["events"]]
+    assert "admitted" in ev_names and "prefill" in ev_names, ev_names
+
     # -- graceful shutdown --------------------------------------------- #
     thread = eng._thread
+    http_thread = eng.http._thread
     eng.close()
     assert not thread.is_alive(), "scheduler thread not joined"
+    assert not http_thread.is_alive(), "HTTP acceptor thread not joined"
+    assert eng.http.closed
 
     telemetry.disable()
     dt = time.perf_counter() - t_start
     print(f"serving smoke: OK — {len(done)}/{len(reqs)} served, "
-          f"{shed} shed, TTFT p50 {p50 * 1e3:.1f} ms, "
+          f"{shed} shed, {evicted} evicted, TTFT p50 {p50 * 1e3:.1f} ms, "
           f"{stats['steps']} steps, 0 recompiles after warmup, "
+          f"/metrics+/healthz+/requestz scraped live, "
           f"{dt:.1f}s total on {jax.devices()[0].platform}")
     return 0
 

@@ -33,6 +33,20 @@ that stalls or corrupts neighbours under overload is worse than none):
   re-raised on the caller (TPU011, the checkpoint-worker idiom), and a
   failed engine refuses new work instead of hanging it.
 
+The observability plane (ISSUE 13) rides every state transition above:
+each request carries a `telemetry.requestlog.RequestTrace` span
+timeline (submit → queued → admitted → prefill → per-N-decode-step
+marks → terminal, block/occupancy annotations included; requests shed
+BEFORE admission get a complete submit → shed trace too), completed
+traces land in the process-wide bounded ring `/requestz` serves; an
+`SloTracker` feeds ``serving_slo_fraction{window=}`` /
+``serving_slo_burn_rate{window=}`` from TTFT/TPOT targets; `health()`
+reports scheduler liveness + queue/KV headroom + SLO burn with
+healthy/degraded/unhealthy semantics; the env-gated
+(``MXTPU_TELEMETRY_PORT``) `telemetry.http.TelemetryServer` is started
+at construction and JOINED by `close()`; and a flight-recorder section
+hook puts the in-flight table + recent traces into SIGTERM bundles.
+
 Thread-safety: ONE lock (`self._lock`, shared by the `self._work`
 condition and every request's condition) guards the queue, slots,
 stats and pool accounting.  The scheduler thread is the only toucher
@@ -41,6 +55,7 @@ bookkeeping holds the lock.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -61,6 +76,16 @@ __all__ = ["ServingError", "RequestShed", "RequestTimedOut",
 
 _POLL_S = float(os.environ.get("MXTPU_SERVING_POLL", "0.002"))
 _MAX_QUEUE = int(os.environ.get("MXTPU_SERVING_QUEUE", "16"))
+# one trace mark per N decode steps per request (0 disables the marks;
+# admission/terminal events always record)
+_TRACE_EVERY = int(os.environ.get("MXTPU_SERVING_TRACE_EVERY", "8"))
+# default TTFT SLO target (seconds) for the burn-rate tracker when
+# neither slo_ttft nor ttft_budget is given
+_SLO_TTFT_S = float(os.environ.get("MXTPU_SERVING_SLO_TTFT", "1.0"))
+_SLO_TPOT_S = os.environ.get("MXTPU_SERVING_SLO_TPOT", "")
+
+# engine names for the HTTP/flight-recorder provider registries
+_engine_ids = itertools.count(1)
 
 # terminal request statuses (everything else is live)
 _TERMINAL = ("done", "shed", "evicted", "cancelled", "failed")
@@ -98,7 +123,14 @@ class Request:
     excluded); `result()` blocks for completion, `stream()` iterates
     tokens as they land and CANCELS on early exit.  Timing fields
     (``t_submit``/``t_first``/``t_done``, ``time.monotonic`` seconds)
-    feed the load harness's TTFT/TPOT percentiles.
+    feed the load harness's TTFT/TPOT percentiles and are recorded for
+    EVERY terminal status — a request shed before admission still gets
+    ``t_done``, a ``finish_reason`` and a complete ``trace``, so
+    rejected traffic is explainable, not just served traffic.
+
+    ``trace`` is the request's `telemetry.requestlog.RequestTrace`
+    lifecycle timeline; it is pushed into the process-wide recent-trace
+    ring (``/requestz``) when the request reaches a terminal status.
     """
 
     def __init__(self, engine: "ServingEngine", prompt: np.ndarray,
@@ -117,7 +149,22 @@ class Request:
         self.t_submit = time.monotonic()
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+        self.ttft: Optional[float] = None   # derived at _finish
+        self.tpot: Optional[float] = None   # mean s/token past the first
         self._cancel = False
+        self.trace = telemetry.requestlog.RequestTrace(
+            meta={"prompt_len": int(prompt.shape[0]),
+                  "max_new_tokens": self.max_new_tokens,
+                  "engine": engine._name})
+        self.trace.event("submit", t=self.t_submit,
+                         deadline_in=None if deadline is None
+                         else round(deadline - self.t_submit, 6))
+
+    @property
+    def rid(self) -> int:
+        """Process-unique request id (the trace ring's key)."""
+        return self.trace.rid
 
     # -- engine side (engine lock held) ------------------------------- #
     def _deliver(self, tok: int, now: float) -> None:
@@ -130,6 +177,26 @@ class Request:
         self.status = status
         self.error = error
         self.t_done = time.monotonic()
+        if isinstance(error, RequestShed):
+            self.finish_reason = error.reason
+        elif isinstance(error, RequestTimedOut):
+            self.finish_reason = "timeout"
+        elif error is not None:
+            self.finish_reason = status
+        if self.t_first is not None:
+            self.ttft = self.t_first - self.t_submit
+            if len(self.tokens) > 1:
+                self.tpot = (self.t_done - self.t_first) \
+                    / (len(self.tokens) - 1)
+        attrs = {"tokens": len(self.tokens)}
+        if self.finish_reason is not None:
+            attrs["reason"] = self.finish_reason
+        if self.ttft is not None:
+            attrs["ttft_s"] = round(self.ttft, 6)
+        if self.tpot is not None:
+            attrs["tpot_s"] = round(self.tpot, 6)
+        self.trace.event(status, t=self.t_done, **attrs)
+        telemetry.requestlog.push(self.trace)
         self._cond.notify_all()
 
     # -- caller side --------------------------------------------------- #
@@ -225,6 +292,19 @@ class ServingEngine:
                     "prefill"/"step" device call — the fault-injection
                     seam the load harness and tests use (sleep = slow
                     step, raise = scheduler failure).
+    slo_ttft        TTFT target (s) for the burn-rate tracker (default
+                    ``MXTPU_SERVING_SLO_TTFT``, else ``ttft_budget``,
+                    else 1.0 — the tracker is always on so
+                    ``serving_slo_fraction{window=}`` always exists).
+    slo_tpot        mean-TPOT target (s); default
+                    ``MXTPU_SERVING_SLO_TPOT`` else None (off).
+    slo_windows     burn-rate window lengths in seconds (default
+                    (60, 600)); slo_objective the good-fraction target
+                    (default 0.99, i.e. a 1% error budget).
+    http_port       serve /metrics /healthz /varz /requestz on this
+                    port (0 = ephemeral; read ``engine.http_port``
+                    back).  Default: ``MXTPU_TELEMETRY_PORT`` if set,
+                    else no server.  close() joins the server.
     """
 
     def __init__(self, net, *, max_batch: int = 4, block_size: int = 16,
@@ -235,7 +315,11 @@ class ServingEngine:
                  eos_id: int = -1, ttft_budget: Optional[float] = None,
                  default_deadline: Optional[float] = None,
                  quantized=None, poll_interval: Optional[float] = None,
-                 fault_hook=None):
+                 fault_hook=None, slo_ttft: Optional[float] = None,
+                 slo_tpot: Optional[float] = None,
+                 slo_windows=None, slo_objective: float = 0.99,
+                 http_port: Optional[int] = None):
+        self._name = f"serving-{next(_engine_ids)}"
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if block_size < 1 or (block_size & (block_size - 1)):
@@ -311,6 +395,41 @@ class ServingEngine:
         self._prefill_ewma: Optional[float] = None
         self._stats = {"admitted": 0, "done": 0, "steps": 0,
                        "shed": OrderedDict(), "evicted": OrderedDict()}
+        self._last_tick = time.monotonic()   # scheduler liveness heartbeat
+
+        # SLO burn-rate tracker: always on (host-side booleans; the
+        # gauges it feeds still honour the telemetry disabled path)
+        if slo_ttft is None:
+            slo_ttft = float(os.environ.get("MXTPU_SERVING_SLO_TTFT", "")
+                             or (ttft_budget if ttft_budget is not None
+                                 else _SLO_TTFT_S))
+        if slo_tpot is None and _SLO_TPOT_S:
+            slo_tpot = float(_SLO_TPOT_S)
+        self._slo = telemetry.slo.SloTracker(
+            ttft_target=slo_ttft, tpot_target=slo_tpot,
+            windows=slo_windows if slo_windows is not None
+            else telemetry.slo.DEFAULT_WINDOWS,
+            objective=slo_objective)
+
+        # ops endpoint: explicit port wins, else MXTPU_TELEMETRY_PORT,
+        # else no server.  Best-effort — a taken port degrades to None
+        # (a second engine in the process) instead of killing serving.
+        self._http: Optional[telemetry.http.TelemetryServer] = None
+        if http_port is None:
+            self._http = telemetry.http.start_from_env()
+        else:
+            try:
+                self._http = telemetry.http.TelemetryServer(
+                    port=int(http_port))
+            except OSError:
+                self._http = None
+        if self._http is not None:
+            self._http.register_health(self._name, self.health)
+            self._http.register_requestz(self._name, self.requestz)
+        # SIGTERM/crash bundles carry the in-flight table + trace ring
+        telemetry.flight_recorder.register_section(
+            self._name, self._flight_section)
+
         self._thread = threading.Thread(
             target=self._scheduler, daemon=True,
             name="mxtpu-serving-scheduler")
@@ -326,6 +445,132 @@ class ServingEngine:
     @property
     def max_seq_len(self) -> int:
         return self._msl
+
+    @property
+    def http(self) -> Optional["telemetry.http.TelemetryServer"]:
+        """The engine's ops endpoint server, or None (not configured /
+        port taken)."""
+        return self._http
+
+    @property
+    def http_port(self) -> Optional[int]:
+        """Bound port of the ops endpoint (useful with port 0)."""
+        return self._http.port if self._http is not None else None
+
+    @property
+    def slo(self) -> "telemetry.slo.SloTracker":
+        return self._slo
+
+    def health(self) -> dict:
+        """Liveness + headroom + SLO burn, the `/healthz` payload.
+
+        status semantics (worst check wins):
+
+        * ``unhealthy`` — stop routing traffic here: the engine is
+          closed, the scheduler thread died, or a scheduler error is
+          parked (every submit will raise).
+        * ``degraded``  — serving but at the edge: admission queue at
+          capacity, zero free KV blocks, the scheduler heartbeat is
+          stale, or the fast SLO window is burning error budget
+          (burn rate > 1).
+        * ``healthy``   — everything above holds headroom.
+        """
+        now = time.monotonic()
+        with self._work:        # same lock the scheduler's tick writes under
+            qd = len(self._queue)
+            active = int(self._active.sum())
+            free = self._pool.num_free
+            tick_age = now - self._last_tick
+        alive = self._thread.is_alive()
+        parked = self._has_pending_err()
+        burning = any(r > 1.0 for r in self._slo.burn_rates(now).values())
+        checks = {
+            "scheduler": {
+                "status": "unhealthy" if (parked or not alive) else
+                          ("degraded" if tick_age > max(2.0, 500 * self._poll)
+                           else "healthy"),
+                "alive": alive, "parked_error": parked,
+                "tick_age_s": round(tick_age, 4)},
+            "queue": {
+                "status": "degraded" if qd >= self._max_queue else "healthy",
+                "depth": qd, "max": self._max_queue},
+            "kv_blocks": {
+                "status": "degraded" if free == 0 else "healthy",
+                "free": free, "total": self._num_blocks - 1,
+                "active_lanes": active, "max_batch": self._B},
+            "slo": {
+                "status": "degraded" if burning else "healthy",
+                **self._slo.snapshot(now)},
+        }
+        if self._closed:
+            checks["scheduler"]["status"] = "unhealthy"
+            checks["scheduler"]["closed"] = True
+        order = {"healthy": 0, "degraded": 1, "unhealthy": 2}
+        status = max((c["status"] for c in checks.values()),
+                     key=lambda s: order[s])
+        return {"status": status, "engine": self._name,
+                "path": self._path, "checks": checks}
+
+    def requestz(self) -> dict:
+        """Currently queued + running requests (the `/requestz`
+        in-flight table; completed traces live in the requestlog ring)."""
+        now = time.monotonic()
+        rows = []
+        with self._lock:
+            for req in self._queue:
+                rows.append(self._request_row(req, now, lane=None))
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    rows.append(self._request_row(slot.req, now, lane=i))
+            stats = {"admitted": self._stats["admitted"],
+                     "done": self._stats["done"],
+                     "steps": self._stats["steps"],
+                     "queue_depth": len(self._queue),
+                     "blocks_free": self._pool.num_free}
+        return {"engine": self._name, "path": self._path,
+                "in_flight": rows, "stats": stats,
+                "slo": self._slo.snapshot(now)}
+
+    @staticmethod
+    def _request_row(req: Request, now: float, lane) -> dict:
+        row = {"rid": req.rid, "status": req.status,
+               "age_s": round(now - req.t_submit, 4),
+               "prompt_len": int(req.prompt.shape[0]),
+               "max_new_tokens": req.max_new_tokens,
+               "tokens": len(req.tokens)}
+        if lane is not None:
+            row["lane"] = lane
+            row["blocks"] = list(req.block_ids)
+        if req.deadline is not None:
+            row["deadline_in_s"] = round(req.deadline - now, 4)
+        if req.t_first is not None:
+            row["ttft_s"] = round(req.t_first - req.t_submit, 6)
+        return row
+
+    def _flight_section(self) -> dict:
+        """Flight-recorder dump hook.  Runs inside a signal handler on
+        whatever thread holds whatever locks — so it TRIES the engine
+        lock instead of deadlocking when the signal lands inside a
+        locked region of this very thread."""
+        if not self._lock.acquire(timeout=0.5):
+            return {"engine": self._name,
+                    "error": "engine lock busy at dump time"}
+        try:
+            now = time.monotonic()
+            rows = [self._request_row(r, now, lane=None)
+                    for r in self._queue]
+            rows += [self._request_row(s.req, now, lane=i)
+                     for i, s in enumerate(self._slots) if s is not None]
+            stats = {"admitted": self._stats["admitted"],
+                     "done": self._stats["done"],
+                     "steps": self._stats["steps"],
+                     "shed": dict(self._stats["shed"]),
+                     "evicted": dict(self._stats["evicted"])}
+        finally:
+            self._lock.release()
+        return {"engine": self._name, "in_flight": rows, "stats": stats,
+                "slo": self._slo.snapshot(now),
+                "recent_traces": telemetry.requestlog.recent(32)}
 
     def set_fault_hook(self, hook) -> None:
         with self._lock:
@@ -383,6 +628,7 @@ class ServingEngine:
                 self._check_alive()
             req.status = "queued"
             self._queue.append(req)
+            req.trace.event("queued", queue_depth=len(self._queue))
             self._note_queue_depth_locked()
             self._work.notify_all()
         return req
@@ -404,9 +650,10 @@ class ServingEngine:
             return True
 
     def close(self, timeout: Optional[float] = 10.0) -> None:
-        """Stop and JOIN the scheduler thread, abort any unfinished
-        requests (their handles see `RequestCancelled`), release all
-        blocks, and re-raise a parked scheduler error (idempotent)."""
+        """Stop and JOIN the scheduler thread (and the ops HTTP
+        server), abort any unfinished requests (their handles see
+        `RequestCancelled`), release all blocks, and re-raise a parked
+        scheduler error (idempotent)."""
         with self._work:
             already = self._closed
             self._closed = True
@@ -418,6 +665,10 @@ class ServingEngine:
                 self._abort_all_locked(
                     RequestCancelled("serving engine closed"))
                 self._work.notify_all()
+            telemetry.flight_recorder.unregister_section(self._name)
+            if self._http is not None:
+                self._http.unregister(self._name)
+                self._http.close(timeout)
         with self._err_lock:
             err, self._pending_err = self._pending_err, None
         if err is not None:
@@ -490,6 +741,8 @@ class ServingEngine:
     def _shed_locked(self, req: Request, reason: str) -> None:
         req._finish("shed", RequestShed(reason))
         self._count(self._stats["shed"], reason)
+        self._slo.note_bad()
+        self._slo.observe()
         if telemetry.enabled():
             telemetry.counter("serving_shed_total",
                               labels={"reason": reason}).inc()
@@ -523,6 +776,9 @@ class ServingEngine:
         req._finish("cancelled" if reason == "cancel" else "evicted",
                     error)
         self._count(self._stats["evicted"], reason)
+        if reason != "cancel":              # user cancels are SLO-neutral
+            self._slo.note_bad()
+            self._slo.observe()
         if telemetry.enabled():
             telemetry.counter("serving_evicted_total",
                               labels={"reason": reason}).inc()
@@ -552,6 +808,7 @@ class ServingEngine:
                 if self._stop.is_set():
                     return
                 now = time.monotonic()
+                self._last_tick = now       # health(): liveness heartbeat
                 self._reap_locked(now)
                 self._admit_locked(now)
                 live = [(i, s.req) for i, s in enumerate(self._slots)
@@ -639,6 +896,10 @@ class ServingEngine:
                         req.seed & 0xFFFFFFFF], np.uint32)
         padded = np.zeros((1, Pb), np.int32)
         padded[0, :P] = req.prompt
+        req.trace.event("admitted", lane=lane, bucket=Pb,
+                        blocks=[int(b) for b in blocks],
+                        queue_wait_s=round(
+                            time.monotonic() - req.t_submit, 6))
         hook = self._fault_hook
         if hook is not None:
             hook("prefill")
@@ -656,6 +917,7 @@ class ServingEngine:
         self._slots[lane] = _Slot(req, blocks)
         req.block_ids = tuple(blocks)
         req.status = "running"
+        req.trace.event("prefill", t=now, dur_s=round(dt, 6), token=tok)
         req._deliver(tok, now)
         self._stats["admitted"] += 1
         if telemetry.enabled():
@@ -678,6 +940,8 @@ class ServingEngine:
         req = self._slots[lane].req
         self._release_lane_locked(lane)
         req._finish("done")
+        self._slo.note_done(req.ttft, req.tpot)
+        self._slo.observe()
         self._stats["done"] += 1
         self._work.notify_all()             # drain()ers and submitters
 
@@ -698,6 +962,8 @@ class ServingEngine:
         now = time.monotonic()
         with self._work:
             self._stats["steps"] += 1
+            mark = _TRACE_EVERY > 0 \
+                and self._stats["steps"] % _TRACE_EVERY == 0
             for lane, req in live:
                 slot = self._slots[lane]
                 if slot is None or slot.req is not req:
@@ -706,6 +972,11 @@ class ServingEngine:
                 req._deliver(tok, now)
                 self._pos[lane] += 1
                 self._toks[lane] = tok
+                if mark:                    # every Nth step: cheap marks
+                    req.trace.event("decode", t=now,
+                                    pos=int(self._pos[lane]),
+                                    tokens=len(req.tokens),
+                                    occupancy=len(live))
                 if tok == self._eos \
                         or len(req.tokens) >= req.max_new_tokens:
                     self._retire_locked(lane)

@@ -38,6 +38,7 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..models import generation as G
 
 __all__ = ["PagedPrograms"]
@@ -57,6 +58,14 @@ def _net_program_cache(net):
     if cache is None:
         cache = net._serving_programs = OrderedDict()
     return cache
+
+
+def _note_build(kind: str) -> None:
+    """Count a program-cache MISS (a fresh jit closure; the compile
+    itself still happens lazily on first call)."""
+    if telemetry.enabled():
+        telemetry.counter("serving_program_builds_total",
+                          labels={"kind": kind}).inc()
 
 
 def _row_pick(temperature, top_k):
@@ -200,6 +209,7 @@ class PagedPrograms:
         cache = _net_program_cache(net)
         step = G._lru_touch(cache, ("step",) + self._key)
         if step is None:
+            _note_build("step")
             step = jax.jit(
                 _build_step(self._H, self._acts, self._bs, self._nbps,
                             self._temperature, self._top_k),
@@ -230,6 +240,7 @@ class PagedPrograms:
         key = ("prefill", bucket) + self._key
         fn = G._lru_touch(cache, key)
         if fn is None:
+            _note_build("prefill")
             fn = jax.jit(
                 _build_prefill(self._H, self._acts, self._bs, bucket,
                                self._temperature, self._top_k),

@@ -25,6 +25,11 @@ env:
 * ``MXTPU_FLIGHT_DIR=path``      enable + install the crash/preemption
   flight recorder (telemetry.flight_recorder); bundles land in `path`
 * ``MXTPU_FLIGHT_STEPS=N``       flight-recorder ring size (default 16)
+* ``MXTPU_TELEMETRY_PORT=N``     serve /metrics /healthz /varz /requestz
+  over HTTP (telemetry.http; the serving engine starts/joins it —
+  0 = ephemeral port)
+* ``MXTPU_REQUESTLOG_RING=N``    recent-request trace ring size
+  (telemetry.requestlog, default 256)
 
 The ISSUE 8 performance layer lives in two submodules: ``perf``
 (roofline/MFU program attribution + device-memory watermarks) and
@@ -52,7 +57,7 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "get_registry", "Counter", "Gauge", "Histogram", "Registry",
            "SpanRecord", "DEFAULT_BUCKETS", "log_buckets", "nbytes_of",
            "record_collective_overlap", "exporters", "tracer", "perf",
-           "flight_recorder"]
+           "flight_recorder", "requestlog", "slo", "http"]
 
 _default_registry = Registry()
 _dump_interval = 0
@@ -62,6 +67,10 @@ _atexit_registered = False
 # resolve it lazily, but the ordering keeps partial-init states out of
 # any interpreter that imports the submodules directly)
 from . import flight_recorder, perf  # noqa: E402
+# the ISSUE 13 observability plane: request traces, SLO burn rates and
+# the live HTTP endpoint (also after the registry, same reasoning —
+# `http` here is the package submodule, not the stdlib package)
+from . import http, requestlog, slo  # noqa: E402
 
 
 def get_registry() -> Registry:

@@ -29,13 +29,28 @@ from typing import Dict, List, Optional
 from . import tracer as _tracer
 from .registry import Counter, Gauge, Histogram, Registry
 
-__all__ = ["prometheus_text", "jsonl_lines", "chrome_trace", "dump"]
+__all__ = ["prometheus_text", "jsonl_lines", "chrome_trace", "dump",
+           "PROM_CONTENT_TYPE"]
+
+# the content type a Prometheus scraper negotiates for the text
+# exposition format — telemetry/http.py serves /metrics with it
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+# label names are STRICTER than metric names: the exposition grammar
+# allows ":" in metric names (recording rules) but not in label names
+_LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def _prom_name(name: str) -> str:
     name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_label_name(name: str) -> str:
+    name = _LABEL_RE.sub("_", name)
     if name and name[0].isdigit():
         name = "_" + name
     return name
@@ -51,7 +66,7 @@ def _prom_escape(value) -> str:
 
 
 def _prom_labels(labels: Dict[str, str], extra: Optional[str] = None) -> str:
-    parts = [f'{_NAME_RE.sub("_", k)}="{_prom_escape(v)}"'
+    parts = [f'{_prom_label_name(k)}="{_prom_escape(v)}"'
              for k, v in sorted(labels.items())]
     if extra:
         parts.append(extra)

@@ -17,9 +17,12 @@ not installed, riding the registry's near-zero disabled path):
 
 Bundle layout (``flight.jsonl``): line 1 is a ``flight_meta`` object
 (reason, pid, wall time, last step, record count); each further line is
-one step record, oldest first, the LAST line being the in-flight step
-at dump time.  ``flight_trace.json`` is the standard merged chrome
-trace (telemetry spans + profiler events) over the same window.
+one step record, oldest first, the last step record being the in-flight
+step at dump time; after the step records come the registered
+subsystem sections (``{"section": name, "data": ...}`` — e.g. the
+serving engine's in-flight requests + recent trace ring, ISSUE 13).
+``flight_trace.json`` is the standard merged chrome trace (telemetry
+spans + profiler events) over the same window.
 
 Step records are appended by the `mark_step` callback chain
 (telemetry.__init__._on_step) — a deque append plus an unlocked metric
@@ -40,9 +43,27 @@ from typing import Dict, List, Optional
 from . import registry as _registry_mod, tracer as _tracer
 
 __all__ = ["install", "uninstall", "installed", "record_step", "records",
-           "dump", "DEFAULT_STEPS"]
+           "dump", "DEFAULT_STEPS", "register_section",
+           "unregister_section"]
 
 DEFAULT_STEPS = 16
+
+# subsystem dump hooks: name -> callable() -> JSON-able object.  Each
+# contributes one {"section": name, "data": ...} line to flight.jsonl
+# (the serving engine registers its in-flight request table + recent
+# trace ring here, so a SIGTERM bundle explains what was being served)
+_sections: Dict[str, object] = {}
+
+
+def register_section(name: str, fn) -> None:
+    """Register a dump contributor (idempotent per name; callbacks run
+    inside the signal-time dump and MUST be cheap, lock briefly and
+    never touch the device)."""
+    _sections[name] = fn
+
+
+def unregister_section(name: str) -> None:
+    _sections.pop(name, None)
 
 _lock = threading.Lock()   # guards install/uninstall/dump, not appends
 _ring: Optional[deque] = None
@@ -158,6 +179,13 @@ def dump(reason: str = "manual", dirpath: Optional[str] = None) -> Optional[dict
             f.write(json.dumps(meta) + "\n")
             for r in recs:
                 f.write(json.dumps(r) + "\n")
+            for name, fn in sorted(_sections.items()):
+                try:
+                    sec = {"section": name, "data": fn()}
+                except Exception as e:  # a broken hook must not lose the rest
+                    sec = {"section": name,
+                           "error": f"{type(e).__name__}: {e}"}
+                f.write(json.dumps(sec, default=str) + "\n")
         from . import exporters
 
         trace_path = os.path.join(out_dir, "flight_trace.json")

@@ -1,0 +1,431 @@
+"""Serving observability plane (ISSUE 13): request lifecycle traces,
+the recent-trace ring, SLO burn-rate accounting, and the live HTTP ops
+endpoint (/metrics /healthz /varz /requestz).
+
+Unit tests (no engine, no jax compute) pin the SLO window math with
+injected clocks, ring bounding, chrome-trace/JSONL export shapes,
+Prometheus scrape conformance (cumulative le buckets, +Inf, _sum/_count,
+label-name sanitization) and the HTTP server's provider aggregation +
+join-on-close.  Engine tests share ONE module-scope engine (tier-1
+budget: compiles are the cost, see test_serving.py) and cover the four
+terminal trace shapes (done/shed/evicted/cancelled), /healthz
+transitions and the flight-recorder section.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as onp
+import pytest
+
+from incubator_mxnet_tpu import telemetry
+from incubator_mxnet_tpu.telemetry import exporters, requestlog, slo
+from incubator_mxnet_tpu.telemetry.http import (HEALTH_ORDER,
+                                                TelemetryServer, _worst)
+from incubator_mxnet_tpu.telemetry.registry import Registry
+
+_POLL = 0.001
+
+
+@pytest.fixture
+def telemetry_on():
+    """Metric updates ride the module-wide enabled flag even on private
+    registries — flip it for tests that assert on recorded values."""
+    telemetry.enable()
+    yield
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------- #
+# SloTracker (pure host math, injected clocks)
+# ---------------------------------------------------------------------- #
+def test_slo_idle_and_burn_math():
+    t = slo.SloTracker(ttft_target=1.0, windows=(60.0, 600.0),
+                       objective=0.99)
+    # idle: no traffic violates no objective
+    assert t.fractions(now=100.0) == {"1m": 1.0, "10m": 1.0}
+    assert t.burn_rates(now=100.0) == {"1m": 0.0, "10m": 0.0}
+    t.note_done(ttft=0.5, tpot=None, now=100.0)     # good
+    t.note_bad(now=101.0)                           # shed
+    fr = t.fractions(now=102.0)
+    assert fr["1m"] == pytest.approx(0.5)
+    # burn = (1 - 0.5) / (1 - 0.99) = 50x the sustainable rate
+    assert t.burn_rates(now=102.0)["1m"] == pytest.approx(50.0)
+    snap = t.snapshot(now=102.0)
+    assert snap["windows"]["1m"] == {"good": 1, "total": 2,
+                                     "fraction": 0.5, "burn_rate": 50.0}
+    assert snap["lifetime"] == {"good": 1, "total": 2}
+
+
+def test_slo_window_expiry():
+    t = slo.SloTracker(windows=(60.0, 600.0))
+    t.note_bad(now=10.0)
+    # at t=100 the bad event left the 1m window but not the 10m one
+    assert t.counts(now=100.0)["1m"] == (0, 0)
+    assert t.counts(now=100.0)["10m"] == (0, 1)
+    assert t.fractions(now=100.0)["1m"] == 1.0
+
+
+def test_slo_is_good_targets():
+    t = slo.SloTracker(ttft_target=1.0, tpot_target=0.1)
+    assert t.is_good(0.9, 0.05)
+    assert not t.is_good(1.1, 0.05)          # TTFT blown
+    assert not t.is_good(0.9, 0.2)           # TPOT blown
+    assert not t.is_good(None, 0.05)         # never got a first token
+    assert t.is_good(0.9, None)              # 1-token reply: no TPOT
+    # no targets: completion itself is the SLO
+    free = slo.SloTracker()
+    assert free.is_good(None, None)
+
+
+def test_slo_validation_and_labels():
+    with pytest.raises(ValueError):
+        slo.SloTracker(windows=())
+    with pytest.raises(ValueError):
+        slo.SloTracker(objective=1.0)
+    t = slo.SloTracker(windows=(5.0, 120.0, 3600.0))
+    assert sorted(t.fractions(now=0.0)) == ["1h", "2m", "5s"]
+
+
+def test_slo_observe_sets_gauges(telemetry_on):
+    t = slo.SloTracker(windows=(60.0,))
+    t.note_bad(now=50.0)
+    t.observe(prefix="slotest", now=51.0)
+    reg = telemetry.get_registry()
+    assert reg.get("slotest_slo_fraction",
+                   {"window": "1m"}).value == 0.0
+    assert reg.get("slotest_slo_burn_rate",
+                   {"window": "1m"}).value == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------- #
+# RequestTrace + ring + exports
+# ---------------------------------------------------------------------- #
+def test_trace_terminal_and_as_dict():
+    tr = requestlog.RequestTrace(meta={"prompt_len": 3})
+    tr.event("submit", t=1.0)
+    tr.event("queued", t=1.1, queue_depth=2)
+    assert tr.terminal is None
+    tr.event("shed", t=1.2, reason="queue_full")
+    assert tr.terminal == "shed"
+    d = tr.as_dict()
+    assert d["status"] == "shed" and d["t_start"] == 1.0 \
+        and d["t_end"] == 1.2 and d["meta"] == {"prompt_len": 3}
+    assert [e["name"] for e in d["events"]] == ["submit", "queued", "shed"]
+
+
+def test_ring_bounds_and_counts():
+    r = requestlog.TraceRing(cap=4)
+    for i in range(10):
+        tr = requestlog.RequestTrace(rid=i)
+        tr.event("submit", t=float(i))
+        tr.event("done", t=float(i) + 0.5)
+        r.push(tr)
+    assert len(r) == 4 and r.pushed == 10
+    assert [t["rid"] for t in r.recent()] == [6, 7, 8, 9]
+    assert [t["rid"] for t in r.recent(2)] == [8, 9]
+    r.clear()
+    assert len(r) == 0 and r.pushed == 0
+
+
+def test_chrome_trace_and_jsonl_export(tmp_path):
+    tr = requestlog.RequestTrace(rid=7)
+    tr.event("submit", t=1.0)
+    tr.event("admitted", t=2.0, lane=0)
+    tr.event("done", t=3.0, tokens=5)
+    traces = [tr.as_dict()]
+    ct = requestlog.chrome_trace(traces)
+    slices = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    marks = [e for e in ct["traceEvents"] if e["ph"] == "i"]
+    # one X slice per phase segment, named after the OPENING event,
+    # plus one instant mark for the terminal event — all on tid=rid
+    assert [s["name"] for s in slices] == ["submit", "admitted"]
+    assert slices[0]["dur"] == pytest.approx(1e6)
+    assert marks[0]["name"] == "done" and marks[0]["args"]["tokens"] == 5
+    assert all(e["tid"] == 7 for e in ct["traceEvents"])
+    lines = requestlog.jsonl_lines(traces)
+    assert json.loads(lines[0])["rid"] == 7
+    paths = requestlog.dump(str(tmp_path))
+    assert json.load(open(paths["trace"]))["displayTimeUnit"] == "ms"
+
+
+# ---------------------------------------------------------------------- #
+# Prometheus scrape conformance
+# ---------------------------------------------------------------------- #
+def test_prometheus_histogram_conformance(telemetry_on):
+    reg = Registry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = exporters.prometheus_text(reg)
+    lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    buckets = [ln for ln in lines if "_bucket{" in ln]
+    # cumulative counts ending at +Inf, then _sum/_count
+    assert [b.rsplit(" ", 1)[1] for b in buckets] == ["1", "2", "3"]
+    assert 'le="+Inf"' in buckets[-1]
+    assert any(ln.startswith("lat_seconds_sum") for ln in lines)
+    assert any(ln.startswith("lat_seconds_count 3") for ln in lines)
+    try:
+        from prometheus_client.parser import text_string_to_metric_families
+    except ImportError:
+        return
+    fams = {f.name: f for f in text_string_to_metric_families(text)}
+    assert fams["lat_seconds"].type == "histogram"
+
+
+def test_prometheus_label_name_sanitized(telemetry_on):
+    # ":" is legal in METRIC names (recording rules) but not LABEL
+    # names — the exporter must sanitize the latter, keep the former
+    reg = Registry()
+    reg.counter("ns:requests", labels={"shard:id": "a", "ok": "b"}).inc()
+    text = exporters.prometheus_text(reg)
+    assert 'ns:requests{ok="b",shard_id="a"} 1' in text
+    assert "shard:id" not in text
+
+
+def test_prom_content_type_constant():
+    assert exporters.PROM_CONTENT_TYPE.startswith("text/plain; version=0.0.4")
+
+
+# ---------------------------------------------------------------------- #
+# TelemetryServer (private registry; no engine)
+# ---------------------------------------------------------------------- #
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_worst_wins_order():
+    assert HEALTH_ORDER == ("healthy", "degraded", "unhealthy")
+    assert _worst([]) == "healthy"
+    assert _worst(["healthy", "degraded"]) == "degraded"
+    assert _worst(["degraded", "unhealthy", "healthy"]) == "unhealthy"
+    assert _worst(["healthy", "garbage"]) == "unhealthy"
+
+
+def test_http_server_endpoints_and_close(telemetry_on):
+    reg = Registry()
+    reg.counter("hits").inc(3)
+    state = {"status": "healthy"}
+    srv = TelemetryServer(port=0, registry=reg)
+    try:
+        base = srv.url
+        srv.register_health("eng", lambda: dict(state))
+        srv.register_requestz("eng", lambda: {"in_flight": []})
+        code, body = _get(base, "/metrics")
+        assert code == 200 and "hits 3" in body
+        code, body = _get(base, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "healthy"
+        state["status"] = "degraded"      # degraded keeps 200 (body-level)
+        code, body = _get(base, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "degraded"
+        state["status"] = "unhealthy"     # unhealthy -> 503 for the LB
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base, "/healthz")
+        assert ei.value.code == 503
+        code, body = _get(base, "/requestz")
+        assert code == 200 and "eng" in json.loads(body)["engines"]
+        code, body = _get(base, "/varz")
+        assert json.loads(body)["hits"]["value"] == 3
+        code, body = _get(base, "/")
+        assert "/metrics" in json.loads(body)["endpoints"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base, "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+    assert srv.closed and not srv._thread.is_alive()
+    srv.close()                           # idempotent
+
+
+def test_http_raising_provider_is_unhealthy_not_500():
+    srv = TelemetryServer(port=0, registry=Registry())
+    try:
+        srv.register_health("bad", lambda: 1 / 0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url, "/healthz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read().decode())
+        assert "ZeroDivisionError" in body["checks"]["bad"]["error"]
+    finally:
+        srv.close()
+
+
+def test_start_from_env_gating(monkeypatch):
+    monkeypatch.delenv("MXTPU_TELEMETRY_PORT", raising=False)
+    assert telemetry.http.start_from_env() is None
+    monkeypatch.setenv("MXTPU_TELEMETRY_PORT", "0")
+    srv = telemetry.http.start_from_env(registry=Registry())
+    assert srv is not None and srv.port > 0
+    srv.close()
+
+
+# ---------------------------------------------------------------------- #
+# Engine integration: trace lifecycle, /healthz transitions, flight hook
+# ---------------------------------------------------------------------- #
+V, C, DFF, L, H, MAXLEN = 61, 16, 32, 1, 2, 64
+PROMPT = onp.array([3, 7, 11, 2, 9], onp.int32)
+
+
+@pytest.fixture(scope="module")
+def net():
+    import jax.numpy as jnp
+
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.models.transformer import TransformerLM
+    from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+
+    mx.random.seed(0)
+    n = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
+                      num_heads=H, max_len=MAXLEN, dropout=0.0)
+    n.initialize()
+    n(NDArray(jnp.ones((1, 4), jnp.int32)))
+    return n
+
+
+@pytest.fixture(scope="module")
+def engine(net):
+    """One shared single-lane engine: lane occupancy and queue depth
+    are exactly controllable, and the whole module costs one step
+    compile + one prefill bucket."""
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    eng = ServingEngine(net, max_batch=1, block_size=8, max_queue=2,
+                        poll_interval=_POLL, http_port=0,
+                        slo_ttft=30.0, slo_windows=(60.0,))
+    eng.submit(PROMPT, 2).result(timeout=120)      # warm the compiles
+    assert eng.drain(timeout=30)
+    yield eng
+    eng.set_fault_hook(None)
+    eng.close()
+
+
+def test_trace_lifecycle_served(engine):
+    requestlog.clear()
+    r = engine.submit(PROMPT, 4)
+    r.result(timeout=60)
+    names = [e["name"] for e in r.trace.snapshot()]
+    assert names[0] == "submit" and names[1] == "queued" \
+        and "admitted" in names and "prefill" in names \
+        and names[-1] == "done"
+    assert r.finish_reason is None and r.ttft is not None
+    adm = next(e for e in r.trace.snapshot() if e["name"] == "admitted")
+    assert adm["lane"] == 0 and adm["blocks"]
+    ring = requestlog.recent()
+    assert ring and ring[-1]["rid"] == r.rid \
+        and ring[-1]["status"] == "done"
+
+
+def test_trace_shed_evicted_cancelled(engine):
+    from incubator_mxnet_tpu.serving import (RequestCancelled, RequestShed,
+                                             RequestTimedOut)
+
+    requestlog.clear()
+    engine.set_fault_hook(
+        lambda ph: time.sleep(0.01) if ph == "step" else None)
+    try:
+        # the single lane: evicted mid-decode by its deadline
+        doomed = engine.submit(PROMPT, 40, deadline=0.3)
+        deadline = time.monotonic() + 10
+        while doomed.status == "queued" and time.monotonic() < deadline:
+            time.sleep(_POLL)               # admitted before queue fills
+        assert doomed.status == "running", doomed.status
+        # fill the queue (2), then one more is shed before admission
+        queued = [engine.submit(PROMPT, 2) for _ in range(2)]
+        shed_req = engine.submit(PROMPT, 2)
+        with pytest.raises(RequestShed):
+            shed_req.result(timeout=30)
+        # cancel one queued request before it is admitted
+        queued[1].cancel()
+        with pytest.raises(RequestTimedOut):
+            doomed.result(timeout=30)
+        with pytest.raises(RequestCancelled):
+            queued[1].result(timeout=30)
+        queued[0].result(timeout=60)
+    finally:
+        engine.set_fault_hook(None)
+    assert shed_req.finish_reason == "queue_full" \
+        and shed_req.t_done is not None        # rejected traffic is timed
+    assert doomed.finish_reason == "timeout"
+    for r, status in ((shed_req, "shed"), (doomed, "evicted"),
+                      (queued[1], "cancelled"), (queued[0], "done")):
+        assert r.status == status
+        assert r.trace.terminal == status
+    statuses = {t["status"] for t in requestlog.recent()}
+    assert {"shed", "evicted", "cancelled", "done"} <= statuses
+    # the evicted trace proves the request RAN before dying
+    ev = next(t for t in requestlog.recent() if t["status"] == "evicted")
+    names = [e["name"] for e in ev["events"]]
+    assert "admitted" in names and "prefill" in names
+
+
+def test_healthz_transitions(engine):
+    h = engine.health()
+    assert h["status"] in ("healthy", "degraded")   # SLO may carry history
+    assert h["checks"]["scheduler"]["status"] == "healthy"
+    assert h["checks"]["queue"]["status"] == "healthy"
+    engine.set_fault_hook(
+        lambda ph: time.sleep(0.01) if ph == "step" else None)
+    try:
+        hog = engine.submit(PROMPT, 40)
+        deadline = time.monotonic() + 10
+        while hog.status == "queued" and time.monotonic() < deadline:
+            time.sleep(_POLL)               # lane occupied, queue empty
+        assert hog.status == "running", hog.status
+        queued = [engine.submit(PROMPT, 2) for _ in range(2)]
+        h = engine.health()                         # queue at capacity
+        assert h["checks"]["queue"]["status"] == "degraded"
+        assert h["status"] == "degraded"
+        hog.cancel()
+        for r in queued:
+            r.result(timeout=60)
+    finally:
+        engine.set_fault_hook(None)
+
+
+def test_http_endpoint_serves_engine(engine, telemetry_on):
+    # metric registration happens at instrumentation sites, which are
+    # no-ops while telemetry is off — serve one request with it ON
+    engine.submit(PROMPT, 2).result(timeout=60)
+    base = f"http://127.0.0.1:{engine.http_port}"
+    code, body = _get(base, "/metrics")
+    assert code == 200 and "serving_slo_fraction" in body
+    code, body = _get(base, "/healthz")
+    payload = json.loads(body)
+    assert engine._name in payload["checks"]
+    code, body = _get(base, "/requestz")
+    assert engine._name in json.loads(body)["engines"]
+
+
+def test_flight_section(engine, tmp_path):
+    from incubator_mxnet_tpu.telemetry import flight_recorder
+
+    sec = engine._flight_section()
+    assert sec["engine"] == engine._name
+    assert "in_flight" in sec and "slo" in sec and "recent_traces" in sec
+    flight_recorder.install(str(tmp_path), steps=4)
+    try:
+        paths = flight_recorder.dump(reason="test")
+        lines = [json.loads(ln) for ln in open(paths["jsonl"])]
+        secs = [ln for ln in lines if ln.get("section") == engine._name]
+        assert secs and "stats" in secs[0]["data"]
+    finally:
+        flight_recorder.uninstall()
+
+
+def test_slo_neutral_cancel(engine):
+    """User cancels must not burn SLO error budget."""
+    engine.drain(timeout=30)
+    before = engine.slo.snapshot()["lifetime"]["total"]
+    engine.set_fault_hook(
+        lambda ph: time.sleep(0.01) if ph == "step" else None)
+    try:
+        r = engine.submit(PROMPT, 40)
+        time.sleep(0.03)
+        r.cancel()
+        with pytest.raises(Exception):
+            r.result(timeout=30)
+    finally:
+        engine.set_fault_hook(None)
+    assert engine.slo.snapshot()["lifetime"]["total"] == before
