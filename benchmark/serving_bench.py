@@ -7,7 +7,8 @@ reports the latency/goodput envelope:
 
     python benchmark/serving_bench.py [--rate HZ] [--requests N]
         [--max-batch B] [--max-queue Q] [--prompt-len P] [--new-tokens T]
-        [--slow-step-ms MS] [--cancel-frac F] [--seed S] [--out FILE]
+        [--slow-step-ms MS] [--cancel-frac F] [--kv-dtype model|int8]
+        [--sweep-prompt-lens P1,P2,...] [--seed S] [--out FILE]
 
 Open loop: arrival gaps are pre-sampled exponentials and submit() never
 blocks on the engine — requests the bounded queue cannot hold are shed,
@@ -22,6 +23,14 @@ both latency targets (``--ttft-slo-ms``, ``--tpot-slo-ms``; shed,
 evicted and SLO-violating work all count as zero, the number a
 capacity planner actually provisions against) — and detail carries raw
 goodput, offered load, shed fraction and TTFT/TPOT p50/p95/p99.
+
+``--kv-dtype int8`` runs the same harness against an int8-KV-pool
+engine (ISSUE 15): pages quantize at write time, the attention
+dequantizes in-kernel, and ``detail.kv_bytes_per_token`` records the
+capacity win.  ``--sweep-prompt-lens 24,96,192`` appends compact
+secondary rows under ``detail.prompt_sweep`` — the longer-prompt
+regime where dense-gather attention traffic grows with ``max_seq_len``
+while the paged kernel's page walk stays length-bounded.
 """
 import argparse
 import json
@@ -69,6 +78,15 @@ def main():
     ap.add_argument("--tpot-slo-ms", type=float, default=500.0,
                     help="TPOT target a request must meet to count "
                          "toward goodput-under-SLO")
+    ap.add_argument("--kv-dtype", choices=("model", "int8"),
+                    default="model",
+                    help="KV pool dtype: 'int8' quantizes pages at "
+                         "write time (fp32 per-vector scales ride "
+                         "alongside, dequant happens in the attention)")
+    ap.add_argument("--sweep-prompt-lens",
+                    help="comma-separated extra prompt lengths; each "
+                         "runs the same open loop and lands a compact "
+                         "row under detail.prompt_sweep")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", help="also write the JSON row here")
     args = ap.parse_args()
@@ -76,23 +94,45 @@ def main():
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu.models.transformer import TransformerLM
     from incubator_mxnet_tpu.ndarray.ndarray import NDArray
-    from incubator_mxnet_tpu.serving import ServingEngine
+
+    sweep_lens = [int(s) for s in args.sweep_prompt_lens.split(",")] \
+        if args.sweep_prompt_lens else []
 
     mx.random.seed(args.seed)
-    msl = args.prompt_len + args.new_tokens + 8
+    max_prompt = max([args.prompt_len] + sweep_lens)
     net = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
-                        num_heads=H, max_len=msl + 32, dropout=0.0)
+                        num_heads=H,
+                        max_len=max_prompt + args.new_tokens + 40,
+                        dropout=0.0)
     net.initialize()
     net(NDArray(jnp.ones((1, 4), jnp.int32)))
     net.cast("bfloat16")
 
+    run = _run_once(args, net, args.prompt_len)
+    row = _render_row(args, run)
+    if sweep_lens:
+        row["detail"]["prompt_sweep"] = [
+            _sweep_summary(args, net, plen) for plen in sweep_lens]
+    line = json.dumps(row)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+
+
+def _run_once(args, net, prompt_len):
+    """One open-loop measured run; returns the raw observations."""
+    from incubator_mxnet_tpu.serving import ServingEngine
+
+    msl = prompt_len + args.new_tokens + 8
     eng = ServingEngine(net, max_batch=args.max_batch, block_size=16,
                         max_seq_len=msl, max_queue=args.max_queue,
+                        kv_dtype="int8" if args.kv_dtype == "int8" else None,
                         slo_ttft=args.ttft_slo_ms / 1e3,
                         slo_tpot=args.tpot_slo_ms / 1e3)
 
     rng = np.random.RandomState(args.seed)
-    prompts = [rng.randint(0, V, size=args.prompt_len).astype(np.int32)
+    prompts = [rng.randint(0, V, size=prompt_len).astype(np.int32)
                for _ in range(args.requests)]
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
     cancel = rng.random_sample(args.requests) < args.cancel_frac
@@ -116,8 +156,32 @@ def main():
     assert eng.drain(timeout=600), "engine failed to drain"
     wall = time.monotonic() - t0
     stats = eng.stats()
+    info = {"kv_bytes_per_token": eng.kv_bytes_per_token,
+            "attn_impl": eng.attn_impl}
     eng.close()
+    return reqs, stats, wall, info
 
+
+def _sweep_summary(args, net, prompt_len):
+    """Compact secondary row for one sweep length."""
+    reqs, stats, wall, info = _run_once(args, net, prompt_len)
+    done = [r for r in reqs if r.status == "done"]
+    slo_ok = [r for r in done
+              if (r.ttft is None or r.ttft <= args.ttft_slo_ms / 1e3)
+              and (r.tpot is None or r.tpot <= args.tpot_slo_ms / 1e3)]
+    tpot = sorted((r.t_done - r.t_first) / (len(r.tokens) - 1)
+                  for r in done if len(r.tokens) > 1)
+    p50 = _pct(tpot, 50)
+    return {"prompt_len": prompt_len,
+            "goodput_under_slo": round(
+                sum(len(r.tokens) for r in slo_ok) / wall, 1),
+            "served_under_slo": len(slo_ok),
+            "tpot_p50_ms": None if p50 is None else round(p50 * 1e3, 2),
+            "wall_s": round(wall, 2)}
+
+
+def _render_row(args, run):
+    reqs, stats, wall, info = run
     done = [r for r in reqs if r.status == "done"]
     shed = sum(stats["shed"].values())
     evicted = sum(stats["evicted"].values())
@@ -163,6 +227,9 @@ def main():
             "new_tokens": args.new_tokens,
             "slow_step_ms": args.slow_step_ms,
             "cancel_frac": args.cancel_frac,
+            "kv_dtype": args.kv_dtype,
+            "attn_impl": info["attn_impl"],
+            "kv_bytes_per_token": info["kv_bytes_per_token"],
             "model": f"TransformerLM {L}L/{C}D V={V} bf16",
             "device": jax.devices()[0].device_kind,
         },
@@ -170,11 +237,7 @@ def main():
     for d in (row["detail"]["ttft_ms"], row["detail"]["tpot_ms"]):
         for k, v in d.items():
             d[k] = None if v is None else round(v * 1e3, 2)
-    line = json.dumps(row)
-    print(line)
-    if args.out:
-        with open(args.out, "w", encoding="utf-8") as fh:
-            fh.write(line + "\n")
+    return row
 
 
 if __name__ == "__main__":

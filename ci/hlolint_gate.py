@@ -18,6 +18,12 @@ evaluates the committed `.hlolint_contracts.json`:
   ``_int8`` twins — the continuous-batching engine's paged-KV
   programs (donation must hold so eviction never doubles the pool;
   the int8 path must not materialize bf16 weight copies)
+* ``serving_*_float_kv8`` — the int8-KV-pool family (``kv_dtype=
+  "int8"``): the pool must actually carry s8 pages and keep donation
+* ``serving_*_float_pallas`` — the forced paged-attention-kernel
+  family: the decode step must NOT materialize the fp32
+  ``(B, H, max_seq_len)`` attention-probs buffer the dense-gather
+  path streams (that buffer is the whole point of the kernel)
 
 Contract context (``ctx``) carries the run's ground truth: the mesh
 size ``D``, the bucket count ``n_buckets``, the global gradient bytes
@@ -144,9 +150,12 @@ def _decode_programs():
 
 
 def _serving_programs():
-    """Compile the continuous-batching engine's four programs
-    (float/int8 x prefill/step) by running one request through a float
-    engine and one through an int8 engine on a fresh tiny net."""
+    """Compile the continuous-batching engine's program families
+    (float / int8-KV / forced-pallas / int8-weight, x prefill/step) by
+    running one request through each engine flavour on a fresh tiny
+    net.  Returns the decode-step attention-probs shape
+    ``(max_batch, H, max_seq_len)`` — the fp32 buffer the paged kernel
+    must NOT materialize."""
     from incubator_mxnet_tpu.serving import ServingEngine
 
     mx.random.seed(0)
@@ -156,17 +165,21 @@ def _serving_programs():
     net(NDArray(jnp.ones((1, 4), jnp.int32)))
     net.cast("bfloat16")
     prompt = np.zeros((P,), dtype="int32")
-    with ServingEngine(net, max_batch=1, block_size=4,
-                       poll_interval=0.001) as eng:
+    kws = dict(max_batch=1, block_size=4, poll_interval=0.001)
+    with ServingEngine(net, **kws) as eng:
         eng.submit(prompt, N).result(timeout=60)   # serving_*_float
+    with ServingEngine(net, kv_dtype="int8", **kws) as eng:
+        eng.submit(prompt, N).result(timeout=60)   # serving_*_float_kv8
+    with ServingEngine(net, attn_impl="pallas", **kws) as eng:
+        eng.submit(prompt, N).result(timeout=60)   # serving_*_float_pallas
     net.quantize_for_decode(act_quant="none")
-    with ServingEngine(net, max_batch=1, block_size=4,
-                       poll_interval=0.001) as eng:
+    with ServingEngine(net, **kws) as eng:
         eng.submit(prompt, N).result(timeout=60)   # serving_*_int8
+    return (1, H, MAXLEN)
 
 
 def collect_facts():
-    """Compile the nine programs and return (facts_by_program, ctx)."""
+    """Compile the thirteen programs and return (facts_by_program, ctx)."""
     telemetry.enable()
     telemetry.perf.set_hlo_text_capture(True)
     _, _ = _train_program(zero=False)
@@ -176,13 +189,15 @@ def collect_facts():
     assert n_buckets and n_buckets >= 2, \
         f"bucket cap did not split the grads: {n_buckets}"
     weight_shapes = _decode_programs()
-    _serving_programs()
+    probs_shape = _serving_programs()
 
     D = len(jax.devices())
     texts = telemetry.perf.hlo_texts()
     want = ("trainer_full_step", "trainer_full_step_zero_bucketed",
             "decode_float", "decode_int8", "checkpoint_snapshot",
             "serving_prefill_float", "serving_step_float",
+            "serving_prefill_float_kv8", "serving_step_float_kv8",
+            "serving_prefill_float_pallas", "serving_step_float_pallas",
             "serving_prefill_int8", "serving_step_int8")
     missing = [p for p in want if p not in texts]
     assert not missing, \
@@ -200,9 +215,16 @@ def collect_facts():
             kw = dict(axis_order=["data"], axis_sizes={"data": D})
         if name.endswith("int8"):
             kw = dict(weight_shapes=weight_shapes)
+        if name in ("serving_step_float", "serving_step_float_pallas"):
+            # "weight" census repurposed as a probs census: any f32
+            # buffer shaped (B, H, max_seq_len) is the dense-gather
+            # score/softmax materialization the kernel path eliminates
+            kw = dict(weight_shapes=[probs_shape],
+                      weight_float_dtypes=("f32",))
         facts[name] = hlolint.fact_summary(module, stablehlo=smod, **kw)
     ctx = {"D": D, "n_buckets": n_buckets, "grad_bytes": grad_bytes,
-           "weight_shapes": [list(w) for w in weight_shapes]}
+           "weight_shapes": [list(w) for w in weight_shapes],
+           "probs_shape": list(probs_shape)}
     return facts, ctx
 
 

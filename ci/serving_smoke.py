@@ -25,6 +25,11 @@ asserts the overload contract:
    timeline for at least one shed AND one evicted request; every
    terminal request has a complete trace; close() joins the HTTP
    acceptor thread along with the scheduler.
+7. **int8-KV engine holds the same line** (ISSUE 15) — a second
+   overloaded run against a ``kv_dtype="int8"`` engine: greedy tokens
+   match the float-KV engine >= 95%, zero recompiles after warmup
+   under its own budget-0 guard (``serving_step_kv8`` /
+   ``serving_prefill_kv8``), and every block returns to the pool.
 
 Budget: well under 30 s on the CPU smoke host.
 Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/serving_smoke.py
@@ -195,6 +200,44 @@ def main() -> int:
     ev_names = [e["name"] for e in by_status["evicted"][0]["events"]]
     assert "admitted" in ev_names and "prefill" in ev_names, ev_names
 
+    # -- int8-KV engine: greedy parity + same overload contract -------- #
+    eng.set_fault_hook(None)
+    eval_prompts = [np.array((3, 7, 11), np.int32),
+                    np.array((2, 9, 4, 1, 5, 8, 6, 3, 2), np.int32)]
+    ref_toks = [eng.submit(p, 8).result(timeout=60) for p in eval_prompts]
+    assert eng.drain(timeout=30)
+
+    q8 = ServingEngine(net, max_batch=2, block_size=8, max_queue=MAX_QUEUE,
+                       kv_dtype="int8", poll_interval=0.001)
+    assert q8.kv_dtype == "int8"
+    assert q8.kv_bytes_per_token < eng.kv_bytes_per_token, \
+        (q8.kv_bytes_per_token, eng.kv_bytes_per_token)
+    # warmup doubles as the parity probe: both prompt buckets compile
+    q8_toks = [q8.submit(p, 8).result(timeout=60) for p in eval_prompts]
+    assert q8.drain(timeout=30)
+    par_tot = sum(len(t) for t in ref_toks)
+    par_hit = sum(a == b for ta, tb in zip(ref_toks, q8_toks)
+                  for a, b in zip(ta, tb))
+    assert par_hit / par_tot >= 0.95, \
+        f"int8-KV greedy parity {par_hit}/{par_tot} vs float engine"
+
+    q8.set_fault_hook(lambda ph: time.sleep(SLOW_STEP_S)
+                      if ph == "step" else None)
+    q8_reqs = []
+    with RetraceGuard(budget=0, watch={"serving_step_kv8",
+                                       "serving_prefill_kv8"}) as q8_guard:
+        for gap, prompt in zip(gaps, prompts):
+            time.sleep(gap)
+            q8_reqs.append(q8.submit(prompt, 6))
+        assert q8.drain(timeout=60), \
+            "int8-KV engine failed to drain under load"
+        q8_guard.check()   # zero kv8-program compiles after warmup
+    q8_stats = q8.stats()
+    q8_done = [r for r in q8_reqs if r.status == "done"]
+    assert q8_done, f"int8-KV run admitted nothing: {q8_stats}"
+    assert q8_stats["blocks_free"] == q8_stats["blocks_total"], q8_stats
+    q8.close()
+
     # -- graceful shutdown --------------------------------------------- #
     thread = eng._thread
     http_thread = eng.http._thread
@@ -208,8 +251,10 @@ def main() -> int:
     print(f"serving smoke: OK — {len(done)}/{len(reqs)} served, "
           f"{shed} shed, {evicted} evicted, TTFT p50 {p50 * 1e3:.1f} ms, "
           f"{stats['steps']} steps, 0 recompiles after warmup, "
-          f"/metrics+/healthz+/requestz scraped live, "
-          f"{dt:.1f}s total on {jax.devices()[0].platform}")
+          f"/metrics+/healthz+/requestz scraped live, int8-KV parity "
+          f"{par_hit}/{par_tot} at {q8.kv_bytes_per_token} B/token "
+          f"(float {eng.kv_bytes_per_token}), {len(q8_done)}/{len(q8_reqs)} "
+          f"served kv8, {dt:.1f}s total on {jax.devices()[0].platform}")
     return 0
 
 

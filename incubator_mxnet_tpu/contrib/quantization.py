@@ -22,9 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as onp
 
-__all__ = ["quantize_weight", "calibrate", "QuantizedDense", "QuantizedConv",
-           "quantize_net", "DecodeQuantConfig", "quantize_for_decode",
-           "dequantize_decode"]
+__all__ = ["quantize_weight", "quantize_kv", "calibrate", "QuantizedDense",
+           "QuantizedConv", "quantize_net", "DecodeQuantConfig",
+           "quantize_for_decode", "dequantize_decode"]
 
 
 def quantize_weight(w, axis: int = 0):
@@ -35,6 +35,20 @@ def quantize_weight(w, axis: int = 0):
                    keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def quantize_kv(x):
+    """`quantize_weight`'s symmetric-int8 recipe applied to KV cache
+    entries: one fp32 scale per (..., head/slot) vector over the
+    feature dim (axis -1).  Returns (int8 values shaped like ``x``,
+    fp32 scales shaped ``x.shape[:-1]``) — the serving int8 KV pool's
+    page-write quantizer (dequant happens inside the paged-attention
+    kernel)."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
 
 

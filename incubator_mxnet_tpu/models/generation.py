@@ -236,6 +236,31 @@ def _gather_params(net, pe_width, qc=None):
     }
 
 
+def _params_fingerprint(net):
+    """Identity key over the raw buffers `_gather_params` gathers.
+    Training / `set_data` REPLACE parameter buffers, so a changed id
+    means any gathered pytree (and lazy int8 copies) built from the old
+    buffers is stale.  Sound as a cache key as long as the cached
+    pytree is alive: it keeps the fingerprinted buffers referenced, so
+    a fresh buffer can never recycle one of their ids.  Cost: a few
+    id() calls per layer, no device work."""
+    def wid(layer):
+        return (id(layer.weight.data()._data),
+                0 if layer.bias is None else id(layer.bias.data()._data))
+
+    ids = [id(net.embed.weight.data()._data),
+           id(net.ln.gamma.data()._data), id(net.ln.beta.data()._data),
+           *wid(net.head)]
+    for lyr in net._layers:
+        ids.extend((id(lyr.ln1.gamma.data()._data),
+                    id(lyr.ln1.beta.data()._data),
+                    *wid(lyr.attn.qkv), *wid(lyr.attn.proj),
+                    id(lyr.ln2.gamma.data()._data),
+                    id(lyr.ln2.beta.data()._data),
+                    *wid(lyr.ffn.ffn_dense1), *wid(lyr.ffn.ffn_dense2)))
+    return tuple(ids)
+
+
 def _ffn_fwd(x, lp, act):
     h = _dense(x, *lp["ffn1"])
     h = jax.nn.gelu(h.astype(jnp.float32),

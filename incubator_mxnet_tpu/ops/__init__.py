@@ -5,9 +5,12 @@ attention in contrib/transformer.cu, multi-tensor optimizer ops,
 pointwise fusion) become Pallas kernels here; anything XLA already
 fuses well stays in plain jnp.
 """
-from .flash_attention import flash_attention, attention_reference
+from .flash_attention import (flash_attention, attention_reference,
+                              attention_small_t)
+from .paged_attention import paged_attention
 
-__all__ = ["flash_attention", "attention_reference"]
+__all__ = ["flash_attention", "attention_reference", "attention_small_t",
+           "paged_attention"]
 
 
 def __getattr__(name):

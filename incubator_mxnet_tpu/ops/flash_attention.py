@@ -27,7 +27,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "flash_attention_with_lse", "attention_reference"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "attention_reference", "attention_small_t"]
 
 
 def _safe_softmax(s):
@@ -440,6 +441,45 @@ def _flash_bwd_core(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
 # long context.  Below this the XLA reference runs (identical numerics).
 _PALLAS_FWD_MIN_SCORES = 512 * 512
 
+# floor of the sub-crossover FUSED path (probs-in-bf16 XLA attention):
+# between this and the Pallas crossover, bf16 TPU forwards keep Q/K/V
+# bf16 into the MXU and cast the probs to bf16 for the PV matmul —
+# halving the (B,H,T,T) probs HBM traffic that caps transformer-big
+# T=256 (the weakest flagship row, 42.6% MFU).  Below the floor the
+# score matrix fits cache and the fp32 reference costs nothing extra.
+_SMALL_T_FUSED_MIN_SCORES = 128 * 128
+
+
+def attention_small_t(q, k, v, causal: bool = False,
+                      scale: Optional[float] = None):
+    """Sub-crossover fused XLA attention for bf16 inputs: scores and
+    softmax in fp32 (bf16 operands straight into the MXU — no fp32
+    materialization of K), probs CAST TO THE INPUT DTYPE for the PV
+    matmul with fp32 accumulation.  vs `attention_reference` this
+    halves probs HBM traffic and skips two fp32 upcasts; numerics
+    differ from the reference only by the bf16 rounding of the probs
+    (|Δp| ≤ 2⁻⁸·p, tolerance-pinned in tests/test_paged_attention.py).
+    """
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = _safe_softmax(s).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _use_small_t(platform, tq, tk, dtype) -> bool:
+    """TPU-only and bf16-only: CPU keeps the fp32 reference (the exact
+    oracle the parity/eviction tests pin), fp32 inputs gain nothing
+    from a bf16 probs cast."""
+    return (platform == "tpu" and jnp.dtype(dtype) == jnp.bfloat16
+            and _SMALL_T_FUSED_MIN_SCORES <= tq * tk
+            < _PALLAS_FWD_MIN_SCORES)
+
 
 def kernel_active(tq, tk, force_reference=False) -> bool:
     """Would flash_attention take the Pallas kernel at these sizes?
@@ -497,6 +537,10 @@ def _dispatch_fwd(q, k, v, causal, scale, block_q, block_k,
         bq = min(block_q, 64) if interp else block_q
         bk = min(block_k, 64) if interp else block_k
         return _flash_core(q, k, v, causal, scale, bq, bk, interp)
+    if not force_reference and _use_small_t(platform, q.shape[2],
+                                            k.shape[2], q.dtype):
+        # sub-crossover fused path (lse=None → exact reference backward)
+        return attention_small_t(q, k, v, causal, scale), None
     return attention_reference(q, k, v, causal, scale), None
 
 

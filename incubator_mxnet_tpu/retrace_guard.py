@@ -65,10 +65,15 @@ PROGRAM_NAMES: Set[str] = {
                                                 # programs and legitimately
                                                 # compiles this once
     "_flash_core",                              # flash-attention kernel jit
+    "_paged_core", "_paged_core_q8",            # paged-attention kernel jits
+                                                # (direct calls outside the
+                                                # step program, e.g. tests)
     "serving_step", "serving_prefill",          # continuous-batching decode:
                                                 # ONE step program per engine
                                                 # + one prefill per prompt
                                                 # bucket (LRU-capped)
+    "serving_step_kv8", "serving_prefill_kv8",  # the int8-KV-pool program
+                                                # family (kv_dtype="int8")
 }
 
 

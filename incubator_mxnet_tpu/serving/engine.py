@@ -286,6 +286,14 @@ class ServingEngine:
     default_deadline   per-request deadline seconds (overridable per
                     submit).
     quantized       weight path selector, as in `lm_generate`.
+    kv_dtype        KV pool dtype: None = model dtype, "int8" =
+                    per-head symmetric int8 pages with fp32 scale
+                    pools (quantized at page-write, dequantized inside
+                    the paged-attention kernel) — ~2× the resident
+                    sequences per HBM byte.
+    attn_impl       paged-attention impl: None = auto (Pallas kernel
+                    on TPU, PR 12's dense gather on CPU), or force
+                    "pallas"/"dense" (tests, hlolint gate).
     poll_interval   scheduler idle/wait tick (default env
                     ``MXTPU_SERVING_POLL`` = 2 ms).
     fault_hook      callable(phase: str) invoked before each
@@ -314,7 +322,9 @@ class ServingEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  eos_id: int = -1, ttft_budget: Optional[float] = None,
                  default_deadline: Optional[float] = None,
-                 quantized=None, poll_interval: Optional[float] = None,
+                 quantized=None, kv_dtype: Optional[str] = None,
+                 attn_impl: Optional[str] = None,
+                 poll_interval: Optional[float] = None,
                  fault_hook=None, slo_ttft: Optional[float] = None,
                  slo_tpot: Optional[float] = None,
                  slo_windows=None, slo_objective: float = 0.99,
@@ -353,19 +363,23 @@ class ServingEngine:
         self._programs = PagedPrograms(
             net, max_batch=self._B, block_size=self._bs,
             blocks_per_seq=self._nbps, temperature=temperature,
-            top_k=top_k, quantized=quantized)
+            top_k=top_k, quantized=quantized, kv_dtype=kv_dtype,
+            attn_impl=attn_impl)
         self._path = self._programs.path          # "float" / "int8"
-        self._params = self._programs.gather_params(self._msl)
-        G._record_decode_weight_bytes(self._params,
-                                      self._programs._qc)
+        self._label = self._programs.prog_label   # + _kv8/_pallas
+        self._kv_dtype = self._programs.kv_dtype
+        params = self._programs.gather_params(self._msl)
+        G._record_decode_weight_bytes(params, self._programs._qc)
 
         # device pool: per-layer (num_blocks, H, bs, D); the engine
         # holds the ONLY reference and replaces it after every donated
-        # call (the buffers really are deleted on XLA:CPU too)
-        emb = self._params["embed"]
+        # call (the buffers really are deleted on XLA:CPU too).  With
+        # kv_dtype="int8" the pages are s8 and fp32 scale pools
+        # (num_blocks, H, bs) ride alongside — also donated.
+        emb = params["embed"]
         H = net._layers[0].attn._num_heads
         D = net._units // H
-        dt = emb.dtype
+        dt = jnp.int8 if self._kv_dtype == "int8" else emb.dtype
         L = len(net._layers)
         self._pool_k = tuple(
             jnp.zeros((self._num_blocks, H, self._bs, D), dt)
@@ -373,7 +387,32 @@ class ServingEngine:
         self._pool_v = tuple(
             jnp.zeros((self._num_blocks, H, self._bs, D), dt)
             for _ in range(L))
+        if self._kv_dtype == "int8":
+            self._scale_k = tuple(
+                jnp.ones((self._num_blocks, H, self._bs), jnp.float32)
+                for _ in range(L))
+            self._scale_v = tuple(
+                jnp.ones((self._num_blocks, H, self._bs), jnp.float32)
+                for _ in range(L))
+        else:
+            self._scale_k = self._scale_v = ()
+        # pool byte footprint is STATIC (donation replaces arrays, never
+        # shapes) — freeze it here so ops-side readers never touch the
+        # live pool tuples the scheduler thread is rewriting
+        self._kv_pool_bytes = sum(
+            int(a.size) * a.dtype.itemsize
+            for a in (*self._pool_k, *self._pool_v,
+                      *self._scale_k, *self._scale_v))
         self._pool = BlockPool(self._num_blocks)
+        if telemetry.enabled():
+            telemetry.gauge("serving_kv_bytes_per_token",
+                            labels={"engine": self._name}) \
+                .set(self.kv_bytes_per_token)
+            impl = self._programs.attn_impl
+            for path in ("pallas", "dense"):
+                telemetry.gauge("paged_attn_kernel",
+                                labels={"path": path}) \
+                    .set(1.0 if path == impl else 0.0)
 
         # per-lane step inputs (scheduler thread only; snapshots are
         # passed to the program, so the jit never closes over state)
@@ -447,6 +486,44 @@ class ServingEngine:
         return self._msl
 
     @property
+    def kv_dtype(self) -> Optional[str]:
+        """None (model dtype) or "int8"."""
+        return self._kv_dtype
+
+    @property
+    def attn_impl(self) -> str:
+        """Resolved paged-attention impl ("pallas" / "dense")."""
+        return self._programs.attn_impl
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """Device bytes of the whole KV pool (pages + int8 scales,
+        all layers) — the denominator of the int8 capacity win.
+        Frozen at construction: donation swaps the pool arrays every
+        step but never their shapes."""
+        return self._kv_pool_bytes
+
+    @property
+    def kv_block_bytes(self) -> int:
+        """Pool bytes one block costs across all layers (K + V +
+        scales); `kv_pool_bytes == num_blocks * kv_block_bytes`."""
+        return self.kv_pool_bytes // self._num_blocks
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """Pool bytes one token position costs across all layers —
+        the `serving_kv_bytes_per_token` gauge's value."""
+        return self.kv_block_bytes // self._bs
+
+    def _live_params(self):
+        """The weight pytree for the next program call — delegated to
+        `PagedPrograms.gather_params`, which caches on the
+        weight-buffer fingerprint: weight swaps (training, set_data)
+        are picked up at the next call, while the steady state costs
+        id() checks only (no per-token gather or requantize)."""
+        return self._programs.gather_params(self._msl)
+
+    @property
     def http(self) -> Optional["telemetry.http.TelemetryServer"]:
         """The engine's ops endpoint server, or None (not configured /
         port taken)."""
@@ -509,7 +586,10 @@ class ServingEngine:
         status = max((c["status"] for c in checks.values()),
                      key=lambda s: order[s])
         return {"status": status, "engine": self._name,
-                "path": self._path, "checks": checks}
+                "path": self._path,
+                "kv_dtype": self._kv_dtype or "model",
+                "attn_impl": self._programs.attn_impl,
+                "checks": checks}
 
     def requestz(self) -> dict:
         """Currently queued + running requests (the `/requestz`
@@ -905,10 +985,11 @@ class ServingEngine:
             hook("prefill")
         fn = self._programs.prefill(Pb)
         t0 = time.perf_counter()
-        self._pool_k, self._pool_v, first = G._timed_decode(
-            f"serving_prefill_{self._path}", f"serving_{self._path}", 1,
-            fn, self._pool_k, self._pool_v, row[:nbp], padded,
-            np.int32(P), key, self._params)
+        (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
+         first) = G._timed_decode(
+            f"serving_prefill_{self._label}", f"serving_{self._label}", 1,
+            fn, self._pool_k, self._pool_v, self._scale_k, self._scale_v,
+            row[:nbp], padded, np.int32(P), key, self._live_params())
         tok = int(np.asarray(first)[0])
         dt = time.perf_counter() - t0
         self._prefill_ewma = dt if self._prefill_ewma is None \
@@ -953,10 +1034,12 @@ class ServingEngine:
             hook("step")
         tables, toks, pos, active, keys = snap
         t0 = time.perf_counter()
-        self._pool_k, self._pool_v, nxt = G._timed_decode(
-            f"serving_step_{self._path}", f"serving_{self._path}",
+        (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
+         nxt) = G._timed_decode(
+            f"serving_step_{self._label}", f"serving_{self._label}",
             len(live), self._programs.step, self._pool_k, self._pool_v,
-            tables, toks, pos, active, keys, self._params)
+            self._scale_k, self._scale_v, tables, toks, pos, active, keys,
+            self._live_params())
         nxt = np.asarray(nxt)               # sync: tokens are consumed now
         dt = time.perf_counter() - t0
         now = time.monotonic()

@@ -14,17 +14,30 @@ recompiles after warmup (RetraceGuard-pinned in ci/serving_smoke.py):
   with the true length riding in as a traced scalar — one program per
   BUCKET, LRU-capped, reusing r7's program-cache idiom.
 
-Both donate the pool arrays (``donate_argnums=(0, 1)``): the K/V pool
+Both donate the pool arrays and their scale pools
+(``donate_argnums=(0, 1, 2, 3)``): the K/V pool
 is a ring the engine threads through every call, and an un-donated
 pool would copy the whole cache per token.  Donation coverage is
 CI-pinned via `.hlolint_contracts.json` (serving_* entries).
 
-Numerics: scores and softmax in fp32 with an iota position mask,
-exactly `generation._cached_self_attn`'s recipe — greedy tokens agree
-with `lm_generate` and co-batched lanes are INDEPENDENT (batched
-matmuls never mix lanes; masked key slots contribute exactly 0.0), the
-two facts the eviction bit-identity contract rests on (docs/serving.md
-§"Why eviction is exact").
+Numerics: the step attention dispatches through
+`ops.paged_attention` — on CPU (and whenever ``attn_impl="dense"``)
+that is byte-for-byte the dense-gather recipe (scores and softmax in
+fp32 with an iota position mask, exactly
+`generation._cached_self_attn`'s math), so greedy tokens agree with
+`lm_generate` and co-batched lanes are INDEPENDENT (batched matmuls
+never mix lanes; masked key slots contribute exactly 0.0) — the two
+facts the eviction bit-identity contract rests on (docs/serving.md
+§"Why eviction is exact").  On TPU (or ``attn_impl="pallas"``) the
+single-query Pallas kernel walks the block table directly — no dense
+gather, nothing (B, H, max_seq_len)-shaped materialized — and the same
+guarantees hold within the kernel path (deterministic, lane-local).
+
+``kv_dtype="int8"`` keys a second program family
+(``serving_step_kv8``/``serving_prefill_kv8``): K/V are quantized
+per-head at page-write time (`contrib.quantization.quantize_kv`) with
+fp32 scale pools riding alongside, and dequantized inside the
+attention — s8 pages in HBM, CI-pinned via `.hlolint_contracts.json`.
 
 Everything a program closes over is a plain int/float/str/tuple
 (tpulint TPU008: no device arrays, no ``self`` captured); weights,
@@ -39,7 +52,9 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from ..contrib.quantization import quantize_kv
 from ..models import generation as G
+from ..ops.paged_attention import default_impl, paged_attention
 
 __all__ = ["PagedPrograms"]
 
@@ -87,25 +102,33 @@ def _row_pick(temperature, top_k):
     return pick
 
 
-def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k):
+def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k,
+                kv_dtype, attn_impl, name):
     """The batched one-token decode program over the paged pool.
 
     Arguments (all traced):
-      pool_k/pool_v  per-layer tuples, each (num_blocks, H, bs, D)
-      tables         (B, blocks_per_seq) int32 block ids per lane
-      toks           (B,) int32 — token emitted by the previous step
-      pos            (B,) int32 — position this step writes/attends to
-      active         (B,) bool  — lanes with a live sequence
-      keys           (B, 2) uint32 — per-lane PRNG keys
-      params         generation._gather_params pytree
-    Returns (new_pool_k, new_pool_v, next_tokens (B,) int32).
+      pool_k/pool_v    per-layer tuples, each (num_blocks, H, bs, D) —
+                       s8 when ``kv_dtype="int8"``, model dtype else
+      scale_k/scale_v  per-layer fp32 scale pools (num_blocks, H, bs)
+                       for the int8 pool; EMPTY tuples on the float path
+      tables           (B, blocks_per_seq) int32 block ids per lane
+      toks             (B,) int32 — token emitted by the previous step
+      pos              (B,) int32 — position this step writes/attends to
+      active           (B,) bool  — lanes with a live sequence
+      keys             (B, 2) uint32 — per-lane PRNG keys
+      params           generation._gather_params pytree
+    Returns (new_k, new_v, new_scale_k, new_scale_v, next_tokens).
+
+    ``attn_impl`` ("pallas"|"dense") picks the `ops.paged_attention`
+    path; ``name`` becomes the jitted function's __name__ so
+    RetraceGuard can budget the program family by name.
     """
     bs = int(block_size)
-    W = int(blocks_per_seq) * bs  # attention width = max_seq_len
     pick = _row_pick(temperature, top_k)
+    kv8 = kv_dtype == "int8"
 
-    def serving_step(pool_k, pool_v, tables, toks, pos, active, keys,
-                     params):
+    def serving_step(pool_k, pool_v, scale_k, scale_v, tables, toks, pos,
+                     active, keys, params):
         dt = params["embed"].dtype
         B = toks.shape[0]
         C = params["embed"].shape[1]
@@ -117,41 +140,40 @@ def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k):
         # current position — inactive lanes are pointed at scratch
         wblk = jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0]
         wblk = jnp.where(active, wblk, jnp.int32(0))
-        new_k, new_v = [], []
+        new_k, new_v, new_sk, new_sv = [], [], [], []
         for li, (lp, act) in enumerate(zip(params["layers"], acts)):
             x = G._ln(h, *lp["ln1"])
             q, k, v = G._qkv_heads(G._dense(x, *lp["qkv"]), H)  # (B, H, D)
-            D = q.shape[-1]
             # write-then-read, the _cached_self_attn order: position
             # `pos` is valid by the time the mask admits it
+            if kv8:
+                k, ks = quantize_kv(k)        # (B, H, D) s8 / (B, H) f32
+                v, vs = quantize_kv(v)
+                sk = scale_k[li].at[wblk, :, off].set(ks)
+                sv = scale_v[li].at[wblk, :, off].set(vs)
+                new_sk.append(sk)
+                new_sv.append(sv)
+            else:
+                sk = sv = None
             pk = pool_k[li].at[wblk, :, off].set(k)
             pv = pool_v[li].at[wblk, :, off].set(v)
-            # gather the lane's pages and flatten to a dense cache view
-            # (B, H, W, D); entry j of W is block j//bs, offset j%bs —
-            # i.e. absolute position j
-            gk = pk[tables].transpose(0, 2, 1, 3, 4).reshape(B, H, W, D)
-            gv = pv[tables].transpose(0, 2, 1, 3, 4).reshape(B, H, W, D)
-            s = jnp.einsum("bhd,bhkd->bhk", q, gk,
-                           preferred_element_type=jnp.float32) \
-                / math.sqrt(D)
-            kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-            s = jnp.where(kpos <= pos[:, None, None], s,
-                          jnp.finfo(jnp.float32).min)
-            p = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("bhk,bhkd->bhd", p, gv,
-                           preferred_element_type=jnp.float32).astype(dt)
+            a = paged_attention(q, pk, pv, tables, pos,
+                                scale_k=sk, scale_v=sv,
+                                impl=attn_impl)           # (B, H, D)
             h = h + G._dense(a.reshape(B, C), *lp["proj"])
             h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
             new_k.append(pk)
             new_v.append(pv)
         logits = G._logits_of(params, h)                        # (B, V)
         nxt = jax.vmap(pick)(logits, pos, keys)
-        return tuple(new_k), tuple(new_v), nxt
+        return tuple(new_k), tuple(new_v), tuple(new_sk), tuple(new_sv), nxt
 
+    serving_step.__name__ = name
     return serving_step
 
 
-def _build_prefill(H, acts, block_size, bucket, temperature, top_k):
+def _build_prefill(H, acts, block_size, bucket, temperature, top_k,
+                   kv_dtype, name):
     """Prompt prefill for one length bucket: runs the training-numerics
     prefill (`generation._prefill`, right-padded prompt + traced
     valid_len), scatters the resulting per-layer caches into the
@@ -160,30 +182,42 @@ def _build_prefill(H, acts, block_size, bucket, temperature, top_k):
 
     table_row is the (nbp,) int32 ids of the blocks covering the
     bucket; positions >= valid_len hold pad garbage that decode
-    overwrites before ever attending to it (write-before-read).
+    overwrites before ever attending to it (write-before-read).  With
+    ``kv_dtype="int8"`` the paged caches are quantized per-head before
+    the scatter and their fp32 scales land in the scale pools.
     """
     bs = int(block_size)
     Pb = int(bucket)
     nbp = -(-Pb // bs)          # blocks covering the bucket
     pad_to = nbp * bs
     pick = _row_pick(temperature, top_k)
+    kv8 = kv_dtype == "int8"
 
-    def serving_prefill(pool_k, pool_v, table_row, prompt, valid_len, key,
-                        params):
+    def serving_prefill(pool_k, pool_v, scale_k, scale_v, table_row,
+                        prompt, valid_len, key, params):
         h_last, kcs, vcs = G._prefill(params, prompt, acts, H, pad_to,
                                       valid_len=valid_len)
-        new_k, new_v = [], []
+        new_k, new_v, new_sk, new_sv = [], [], [], []
         for li in range(len(acts)):
+            kc, vc = kcs[li], vcs[li]           # (1, H, pad_to, D)
+            if kv8:
+                kc, ksc = quantize_kv(kc)       # scales (1, H, pad_to)
+                vc, vsc = quantize_kv(vc)
+                new_sk.append(scale_k[li].at[table_row].set(
+                    ksc[0].reshape(-1, nbp, bs).transpose(1, 0, 2)))
+                new_sv.append(scale_v[li].at[table_row].set(
+                    vsc[0].reshape(-1, nbp, bs).transpose(1, 0, 2)))
             # (1, H, pad_to, D) -> (nbp, H, bs, D): page the cache
-            kc = kcs[li][0].reshape(-1, nbp, bs, kcs[li].shape[-1])
-            vc = vcs[li][0].reshape(-1, nbp, bs, vcs[li].shape[-1])
+            kcp = kc[0].reshape(-1, nbp, bs, kc.shape[-1])
+            vcp = vc[0].reshape(-1, nbp, bs, vc.shape[-1])
             new_k.append(pool_k[li].at[table_row].set(
-                kc.transpose(1, 0, 2, 3)))
+                kcp.transpose(1, 0, 2, 3)))
             new_v.append(pool_v[li].at[table_row].set(
-                vc.transpose(1, 0, 2, 3)))
+                vcp.transpose(1, 0, 2, 3)))
         first = pick(G._logits_of(params, h_last), valid_len - 1, key)
-        return tuple(new_k), tuple(new_v), first
+        return tuple(new_k), tuple(new_v), tuple(new_sk), tuple(new_sv), first
 
+    serving_prefill.__name__ = name
     return serving_prefill
 
 
@@ -195,7 +229,16 @@ class PagedPrograms:
     config — the engine owns the pool arrays and the weights pytree."""
 
     def __init__(self, net, *, max_batch, block_size, blocks_per_seq,
-                 temperature, top_k, quantized):
+                 temperature, top_k, quantized, kv_dtype=None,
+                 attn_impl=None):
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8', "
+                f"got {kv_dtype!r}")
+        if attn_impl not in (None, "pallas", "dense"):
+            raise ValueError(
+                f"attn_impl must be None (auto), 'pallas' or 'dense', "
+                f"got {attn_impl!r}")
         self._net = net
         self._H = net._layers[0].attn._num_heads
         self._acts = tuple(lyr.ffn._act for lyr in net._layers)
@@ -204,16 +247,29 @@ class PagedPrograms:
         self._temperature = float(temperature)
         self._top_k = int(top_k)
         self._qc = G._quant_config(net, quantized)
+        self._kv_dtype = kv_dtype
+        self._impl_forced = attn_impl is not None
+        self._impl = attn_impl or default_impl()
+        # distinct def names per KV family: RetraceGuard budgets
+        # compiles BY NAME, so the int8-KV programs must not count
+        # against (or hide behind) the float-KV budget
+        sfx = "_kv8" if kv_dtype == "int8" else ""
+        self._step_name = "serving_step" + sfx
+        self._prefill_name = "serving_prefill" + sfx
         self._key = (self._H, self._acts, self._bs, self._nbps,
-                     self._temperature, self._top_k, self.path)
+                     self._temperature, self._top_k, self.path,
+                     self._kv_dtype, self._impl)
+        self._params = None
+        self._params_key = None
         cache = _net_program_cache(net)
         step = G._lru_touch(cache, ("step",) + self._key)
         if step is None:
             _note_build("step")
             step = jax.jit(
                 _build_step(self._H, self._acts, self._bs, self._nbps,
-                            self._temperature, self._top_k),
-                donate_argnums=(0, 1))
+                            self._temperature, self._top_k,
+                            self._kv_dtype, self._impl, self._step_name),
+                donate_argnums=(0, 1, 2, 3))
             G._lru_put(net, cache, ("step",) + self._key, step,
                        "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
                        gauge="serving_program_cache_size")
@@ -224,10 +280,39 @@ class PagedPrograms:
         """Telemetry label of the weight path ("float" / "int8")."""
         return G._decode_path(self._qc)
 
+    @property
+    def kv_dtype(self):
+        return self._kv_dtype
+
+    @property
+    def attn_impl(self) -> str:
+        """Resolved paged-attention impl ("pallas" / "dense")."""
+        return self._impl
+
+    @property
+    def prog_label(self) -> str:
+        """Telemetry/program label: weight path, plus ``_kv8`` for the
+        int8 KV pool and ``_pallas`` when the kernel was forced off its
+        home platform (the hlolint gate compiles that variant on CPU to
+        pin the no-dense-probs census)."""
+        label = self.path
+        if self._kv_dtype == "int8":
+            label += "_kv8"
+        if self._impl_forced and self._impl == "pallas":
+            label += "_pallas"
+        return label
+
     def gather_params(self, pe_width):
-        """The live weight pytree the programs consume (the serving
-        engine gathers once per admission batch, not per token)."""
-        return G._gather_params(self._net, pe_width, self._qc)
+        """The live weight pytree the programs consume, cached on the
+        weight-buffer identity fingerprint (PR 7 idiom): the engine may
+        call this every step — training/`set_data` swaps are picked up,
+        but an unchanged net costs ~a dozen id() calls and the int8
+        requantize never runs per-token."""
+        key = (G._params_fingerprint(self._net), int(pe_width))
+        if self._params_key != key:
+            self._params = G._gather_params(self._net, pe_width, self._qc)
+            self._params_key = key
+        return self._params
 
     @property
     def step(self):
@@ -243,8 +328,9 @@ class PagedPrograms:
             _note_build("prefill")
             fn = jax.jit(
                 _build_prefill(self._H, self._acts, self._bs, bucket,
-                               self._temperature, self._top_k),
-                donate_argnums=(0, 1))
+                               self._temperature, self._top_k,
+                               self._kv_dtype, self._prefill_name),
+                donate_argnums=(0, 1, 2, 3))
             G._lru_put(self._net, cache, key, fn,
                        "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
                        gauge="serving_program_cache_size")
