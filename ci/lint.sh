@@ -17,6 +17,26 @@ echo "tpulint: analyzing incubator_mxnet_tpu/ tools/ ci/ (baseline gate)"
 python -m tools.tpulint incubator_mxnet_tpu tools ci \
     --strict --baseline .tpulint_baseline.json --stats
 
+echo "tpulint: rule modules must ship covering fixtures"
+for mod in tools/tpulint/*_rules.py; do
+    for code in $(grep -o 'TPU[0-9]\{3\}' "$mod" | sort -u); do
+        fix="tests/fixtures/tpulint/$(echo "$code" | tr '[:upper:]' '[:lower:]')_case.py"
+        if [[ ! -f "$fix" ]]; then
+            echo "FAIL: $mod implements $code but $fix is missing" >&2
+            exit 1
+        fi
+        if ! grep -q "$(basename "$fix")" tests/test_tpulint.py; then
+            echo "FAIL: $fix exists but tests/test_tpulint.py never loads it" >&2
+            exit 1
+        fi
+    done
+done
+
+echo "tpulint: lock-order graph dump (--format dot)"
+lock_dot=$(python -m tools.tpulint incubator_mxnet_tpu --format dot)
+grep -q '^digraph lock_order' <<<"$lock_dot"
+echo "$lock_dot"
+
 echo "compileall: incubator_mxnet_tpu/ tools/ tests/ ci/"
 python -m compileall -q incubator_mxnet_tpu/ tools/ tests/ ci/
 

@@ -25,7 +25,13 @@ asserts the overload contract:
    timeline for at least one shed AND one evicted request; every
    terminal request has a complete trace; close() joins the HTTP
    acceptor thread along with the scheduler.
-7. **int8-KV engine holds the same line** (ISSUE 15) — a second
+7. **Lock discipline, observed** (ISSUE 16) — the runtime lock witness
+   records every held-while-acquiring edge across both overloaded runs
+   (scheduler threads, HTTP acceptor, signal-era telemetry locks) and
+   asserts the observed graph is acyclic AND a subset of tpulint's
+   static lock-order graph, exporting ``lock_witness_edges_total`` /
+   ``lock_contention_seconds`` gauges.
+8. **int8-KV engine holds the same line** (ISSUE 15) — a second
    overloaded run against a ``kv_dtype="int8"`` engine: greedy tokens
    match the float-KV engine >= 95%, zero recompiles after warmup
    under its own budget-0 guard (``serving_step_kv8`` /
@@ -45,6 +51,21 @@ sys.path.insert(0, _ROOT)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("MXTPU_TELEMETRY_DUMP", None)
+
+# Lock witness (always on for the smoke): installed BEFORE the package
+# import so module-level locks (telemetry registries, flight recorder)
+# are created through the patched factories.  Loaded by file path and
+# pre-registered in sys.modules — a normal import would run the package
+# __init__ first, creating those locks un-witnessed.
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "incubator_mxnet_tpu.lock_witness",
+    os.path.join(_ROOT, "incubator_mxnet_tpu", "lock_witness.py"))
+lock_witness = importlib.util.module_from_spec(_spec)
+sys.modules["incubator_mxnet_tpu.lock_witness"] = lock_witness
+_spec.loader.exec_module(lock_witness)
+lock_witness.install(force=True)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -246,6 +267,13 @@ def main() -> int:
     assert not http_thread.is_alive(), "HTTP acceptor thread not joined"
     assert eng.http.closed
 
+    # -- lock witness: observed order acyclic and within the static map  #
+    lock_witness.snapshot()
+    assert reg.get("lock_witness_edges_total") is not None, \
+        "witness gauges not exported"
+    wstats = lock_witness.assert_clean()
+    assert wstats["tracked_locks"] > 0, "witness tracked no package locks"
+
     telemetry.disable()
     dt = time.perf_counter() - t_start
     print(f"serving smoke: OK — {len(done)}/{len(reqs)} served, "
@@ -254,7 +282,9 @@ def main() -> int:
           f"/metrics+/healthz+/requestz scraped live, int8-KV parity "
           f"{par_hit}/{par_tot} at {q8.kv_bytes_per_token} B/token "
           f"(float {eng.kv_bytes_per_token}), {len(q8_done)}/{len(q8_reqs)} "
-          f"served kv8, {dt:.1f}s total on {jax.devices()[0].platform}")
+          f"served kv8, lock witness {wstats['edges']} edge(s) over "
+          f"{wstats['tracked_locks']} locks acyclic+static-covered, "
+          f"{dt:.1f}s total on {jax.devices()[0].platform}")
     return 0
 
 

@@ -51,7 +51,11 @@ Thread-safety: ONE lock (`self._lock`, shared by the `self._work`
 condition and every request's condition) guards the queue, slots,
 stats and pool accounting.  The scheduler thread is the only toucher
 of the device-side pool arrays, so device calls run lock-free; only
-bookkeeping holds the lock.
+bookkeeping holds the lock.  That includes prefill (tpulint TPU015):
+admission is reserve (lane + blocks claimed under the lock) →
+prefill (device call, unlocked) → commit (re-lock, slot-identity
+check, first-token delivery), mirroring `_decode_step`'s
+snapshot/step/commit shape.
 """
 from __future__ import annotations
 
@@ -265,6 +269,27 @@ class _Slot:
     def __init__(self, req: Request, blocks: list):
         self.req = req
         self.blocks = blocks
+
+
+class _Admission:
+    """A reserved admission: lane + blocks claimed and host inputs
+    staged under the lock, prefill still to run OUTSIDE it."""
+
+    __slots__ = ("lane", "req", "blocks", "row", "key", "padded",
+                 "prompt_len", "bucket", "nbp", "hook")
+
+    def __init__(self, lane, req, blocks, row, key, padded,
+                 prompt_len, bucket, nbp, hook):
+        self.lane = lane
+        self.req = req
+        self.blocks = blocks
+        self.row = row
+        self.key = key
+        self.padded = padded
+        self.prompt_len = prompt_len
+        self.bucket = bucket
+        self.nbp = nbp
+        self.hook = hook
 
 
 class ServingEngine:
@@ -890,17 +915,23 @@ class ServingEngine:
                 now = time.monotonic()
                 self._last_tick = now       # health(): liveness heartbeat
                 self._reap_locked(now)
-                self._admit_locked(now)
-                live = [(i, s.req) for i, s in enumerate(self._slots)
-                        if s is not None and self._active[i]]
-                if not live:
-                    if not self._queue:
-                        self._work.wait(self._poll)
-                    continue
-                snap = (self._tables.copy(), self._toks.copy(),
-                        self._pos.copy(), self._active.copy(),
-                        self._keys.copy())
-                hook = self._fault_hook
+                adm = self._reserve_admission_locked(now)
+                if adm is None:
+                    live = [(i, s.req) for i, s in enumerate(self._slots)
+                            if s is not None and self._active[i]]
+                    if not live:
+                        if not self._queue:
+                            self._work.wait(self._poll)
+                        continue
+                    snap = (self._tables.copy(), self._toks.copy(),
+                            self._pos.copy(), self._active.copy(),
+                            self._keys.copy())
+                    hook = self._fault_hook
+            if adm is not None:
+                # prefill OUTSIDE the lock — then loop back to admit
+                # the next queued request (or start decoding)
+                self._prefill_one(adm)
+                continue
             self._decode_step(snap, live, hook)
 
     def _reap_locked(self, now: float) -> None:
@@ -936,7 +967,11 @@ class ServingEngine:
                     RequestTimedOut(f"deadline exceeded after "
                                     f"{len(slot.req.tokens)} token(s)"))
 
-    def _admit_locked(self, now: float) -> None:
+    def _reserve_admission_locked(self, now: float) -> Optional[_Admission]:
+        """Claim a lane + blocks for the queue head and stage its host
+        inputs, all under the lock; the prefill itself runs OUTSIDE the
+        lock (`_prefill_one`).  Returns None when nothing is admissible
+        (empty queue, batch full, pool full)."""
         while self._queue:
             req = self._queue[0]
             if self._ttft_budget is not None \
@@ -951,71 +986,84 @@ class ServingEngine:
             try:
                 lane = self._slots.index(None)
             except ValueError:
-                return                      # batch full
+                return None                 # batch full
             blocks = self._pool.alloc(
                 self._blocks_needed(req.prompt.shape[0],
                                     req.max_new_tokens))
             if blocks is None:
-                return                      # pool full: FCFS head waits
-            # admit BEFORE popping: if the prefill (or a fault hook)
-            # raises, the request is still queued and the scheduler's
-            # failure path finishes it — no handle ever hangs
-            self._admit_one_locked(lane, req, blocks)
+                return None                 # pool full: FCFS head waits
+            # register the lane BEFORE the (unlocked) prefill runs: if
+            # the prefill or a fault hook raises, the scheduler failure
+            # path finds the request in its slot and finishes it — no
+            # handle ever hangs
             self._queue.popleft()
+            self._slots[lane] = _Slot(req, blocks)
+            req.block_ids = tuple(blocks)
+            P = req.prompt.shape[0]
+            Pb = self._bucket(P)
+            row = np.full((self._nbps,), SCRATCH_BLOCK, np.int32)
+            row[:len(blocks)] = blocks
+            key = np.array([(req.seed >> 32) & 0xFFFFFFFF,
+                            req.seed & 0xFFFFFFFF], np.uint32)
+            padded = np.zeros((1, Pb), np.int32)
+            padded[0, :P] = req.prompt
+            req.trace.event("admitted", lane=lane, bucket=Pb,
+                            blocks=[int(b) for b in blocks],
+                            queue_wait_s=round(
+                                time.monotonic() - req.t_submit, 6))
             self._note_queue_depth_locked()
             self._work.notify_all()         # queue space freed
+            return _Admission(lane, req, blocks, row, key, padded,
+                              P, Pb, -(-Pb // self._bs),
+                              self._fault_hook)
+        return None
 
-    def _admit_one_locked(self, lane: int, req: Request,
-                          blocks: list) -> None:
-        P = req.prompt.shape[0]
-        Pb = self._bucket(P)
-        nbp = -(-Pb // self._bs)
-        row = np.full((self._nbps,), SCRATCH_BLOCK, np.int32)
-        row[:len(blocks)] = blocks
-        key = np.array([(req.seed >> 32) & 0xFFFFFFFF,
-                        req.seed & 0xFFFFFFFF], np.uint32)
-        padded = np.zeros((1, Pb), np.int32)
-        padded[0, :P] = req.prompt
-        req.trace.event("admitted", lane=lane, bucket=Pb,
-                        blocks=[int(b) for b in blocks],
-                        queue_wait_s=round(
-                            time.monotonic() - req.t_submit, 6))
-        hook = self._fault_hook
-        if hook is not None:
-            hook("prefill")
-        fn = self._programs.prefill(Pb)
+    def _prefill_one(self, adm: _Admission) -> None:
+        """Prefill for a reserved admission — device call OUTSIDE the
+        lock (mirroring `_decode_step`), so submit()/cancel()/stats()
+        never stall behind prefill compute (fault-hook injected sleeps
+        included).  Re-locks to commit the first token, with a slot
+        identity check in case the request was evicted meanwhile."""
+        req = adm.req
+        if adm.hook is not None:
+            adm.hook("prefill")
+        fn = self._programs.prefill(adm.bucket)
         t0 = time.perf_counter()
         (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
          first) = G._timed_decode(
             f"serving_prefill_{self._label}", f"serving_{self._label}", 1,
             fn, self._pool_k, self._pool_v, self._scale_k, self._scale_v,
-            row[:nbp], padded, np.int32(P), key, self._live_params())
+            adm.row[:adm.nbp], adm.padded, np.int32(adm.prompt_len),
+            adm.key, self._live_params())
         tok = int(np.asarray(first)[0])
         dt = time.perf_counter() - t0
-        self._prefill_ewma = dt if self._prefill_ewma is None \
-            else 0.8 * self._prefill_ewma + 0.2 * dt
         now = time.monotonic()
-        self._slots[lane] = _Slot(req, blocks)
-        req.block_ids = tuple(blocks)
-        req.status = "running"
-        req.trace.event("prefill", t=now, dur_s=round(dt, 6), token=tok)
-        req._deliver(tok, now)
-        self._stats["admitted"] += 1
-        if telemetry.enabled():
-            telemetry.counter("serving_admitted_total").inc()
-            telemetry.histogram(
-                "serving_ttft_seconds",
-                labels={"path": self._path}).observe(now - req.t_submit)
-            telemetry.gauge("serving_kv_blocks_in_use") \
-                .set(self._pool.num_allocated)
-        if tok == self._eos or len(req.tokens) >= req.max_new_tokens:
-            self._retire_locked(lane)
-            return
-        self._tables[lane, :] = row
-        self._toks[lane] = tok
-        self._pos[lane] = P
-        self._active[lane] = True
-        self._keys[lane, :] = key
+        with self._work:
+            self._prefill_ewma = dt if self._prefill_ewma is None \
+                else 0.8 * self._prefill_ewma + 0.2 * dt
+            slot = self._slots[adm.lane]
+            if slot is None or slot.req is not req:
+                return                      # evicted while prefilling
+            req.status = "running"
+            req.trace.event("prefill", t=now, dur_s=round(dt, 6),
+                            token=tok)
+            req._deliver(tok, now)
+            self._stats["admitted"] += 1
+            if telemetry.enabled():
+                telemetry.counter("serving_admitted_total").inc()
+                telemetry.histogram(
+                    "serving_ttft_seconds",
+                    labels={"path": self._path}).observe(now - req.t_submit)
+                telemetry.gauge("serving_kv_blocks_in_use") \
+                    .set(self._pool.num_allocated)
+            if tok == self._eos or len(req.tokens) >= req.max_new_tokens:
+                self._retire_locked(adm.lane)
+                return
+            self._tables[adm.lane, :] = adm.row
+            self._toks[adm.lane] = tok
+            self._pos[adm.lane] = adm.prompt_len
+            self._active[adm.lane] = True
+            self._keys[adm.lane, :] = adm.key
 
     def _retire_locked(self, lane: int) -> None:
         req = self._slots[lane].req

@@ -74,7 +74,7 @@ _prev_excepthook = None
 _dumped = False
 
 
-def _reg():
+def _reg() -> _registry_mod.Registry:
     from . import get_registry
 
     return get_registry()
@@ -160,7 +160,13 @@ def dump(reason: str = "manual", dirpath: Optional[str] = None) -> Optional[dict
     None when not installed."""
     if _ring is None:
         return None
-    with _lock:
+    # Try-lock, not `with _lock:` — dump() runs from signal handlers
+    # (SIGTERM/SIGABRT), and the interrupted thread may already hold
+    # _lock (mid-record_step).  A blocking acquire would deadlock the
+    # process inside the handler; losing the dump is the lesser evil.
+    if not _lock.acquire(timeout=2.0):
+        return None
+    try:
         step = _tracer.current_step()
         record_step(step)
         recs = list(_ring)
@@ -191,6 +197,8 @@ def dump(reason: str = "manual", dirpath: Optional[str] = None) -> Optional[dict
         trace_path = os.path.join(out_dir, "flight_trace.json")
         with open(trace_path, "w") as f:
             json.dump(exporters.chrome_trace(), f)
+    finally:
+        _lock.release()
     return {"jsonl": jsonl_path, "trace": trace_path}
 
 

@@ -14,6 +14,26 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 
+# Lock witness (MXTPU_LOCK_WITNESS=1): must be installed BEFORE the
+# package is imported so module-level locks (telemetry registries,
+# flight recorder) are created through the patched factories.  The
+# module is loaded by file path and pre-registered in sys.modules —
+# a normal `from incubator_mxnet_tpu import lock_witness` would run
+# the package __init__ first, creating those locks un-witnessed.
+_LOCK_WITNESS = None
+if os.environ.get("MXTPU_LOCK_WITNESS") == "1":
+    import importlib.util
+    import sys
+
+    _spec = importlib.util.spec_from_file_location(
+        "incubator_mxnet_tpu.lock_witness",
+        os.path.join(os.path.dirname(__file__), "..",
+                     "incubator_mxnet_tpu", "lock_witness.py"))
+    _LOCK_WITNESS = importlib.util.module_from_spec(_spec)
+    sys.modules["incubator_mxnet_tpu.lock_witness"] = _LOCK_WITNESS
+    _spec.loader.exec_module(_LOCK_WITNESS)
+    _LOCK_WITNESS.install()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
@@ -42,6 +62,19 @@ def _retrace_guard(request):
 
     with RetraceGuard(watch=PROGRAM_NAMES) as guard:
         yield guard
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Witness contract at end of a MXTPU_LOCK_WITNESS=1 run: the
+    observed held-while-acquiring graph must be acyclic and a subset
+    of tpulint's static lock graph."""
+    if _LOCK_WITNESS is None or not _LOCK_WITNESS.installed():
+        return
+    stats = _LOCK_WITNESS.assert_clean()
+    print(f"\nlock witness: {stats['edges']} edge(s) over "
+          f"{stats['tracked_locks']} tracked lock(s), acyclic, "
+          f"all in the static graph "
+          f"(contention {stats['contention_seconds']:.3f}s)")
 
 
 @pytest.fixture

@@ -1,0 +1,116 @@
+"""TPU013 fixture: lock-order cycles across threads."""
+import threading
+
+
+class BadPair:
+    """POSITIVE: classic AB/BA inversion — deadlock when the two
+    methods race on different threads."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._thread = threading.Thread(target=self.backward, daemon=True)
+        self._thread.start()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                return 2
+
+    def close(self):
+        self._thread.join()
+
+
+class BadTriangle:
+    """POSITIVE: 3-lock cycle x -> y -> z -> x, no pair inverted."""
+
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+        self._z = threading.Lock()
+
+    def xy(self):
+        with self._x:
+            with self._y:
+                return 1
+
+    def yz(self):
+        with self._y:
+            with self._z:
+                return 2
+
+    def zx(self):
+        with self._z:
+            with self._x:
+                return 3
+
+
+class GoodPair:
+    """negative: both paths agree on the a-before-b order."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._thread = threading.Thread(target=self.also_forward,
+                                        daemon=True)
+        self._thread.start()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                return 2
+
+    def close(self):
+        self._thread.join()
+
+
+class GoodTryLock:
+    """negative: the reverse-order side try-acquires the second lock —
+    bounded, so it backs off instead of deadlocking (no b->a edge)."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def backward_try(self):
+        with self._b:
+            if self._a.acquire(timeout=0.1):
+                try:
+                    return 2
+                finally:
+                    self._a.release()
+            return None
+
+
+class SuppressedPair:
+    def __init__(self):
+        self._p = threading.Lock()
+        self._q = threading.Lock()
+
+    def forward(self):
+        with self._p:
+            # the finding anchors at the acquisition that closes the
+            # cycle's earliest edge (q taken while p held)
+            # tpulint: disable-next=TPU013 -- test-only pair, never runs concurrently
+            with self._q:
+                return 1
+
+    def backward(self):
+        with self._q:
+            with self._p:
+                return 2

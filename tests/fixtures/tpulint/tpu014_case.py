@@ -1,0 +1,65 @@
+"""TPU014 fixture: Condition.wait() outside a while-predicate loop."""
+import threading
+
+
+class BadWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            if not self._ready:
+                self._cv.wait()    # POSITIVE: if-recheck, lost wakeup
+            return self._ready
+
+
+class BadBareWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def wait_once(self):
+        with self._cv:
+            self._cv.wait()        # POSITIVE: no predicate at all
+            return True
+
+
+class GoodWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:  # negative: while re-check
+                self._cv.wait()
+            return self._ready
+
+    def wait_bounded(self):
+        with self._cv:
+            while not self._ready:  # negative: timed wait in a loop
+                self._cv.wait(0.5)
+            return self._ready
+
+
+class GoodPredicateWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            # negative: wait_for has the predicate loop built in
+            self._cv.wait_for(lambda: self._ready)
+            return self._ready
+
+
+class SuppressedWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def wait_pulse(self):
+        with self._cv:
+            # tpulint: disable-next=TPU014 -- single waiter, notify is the event itself
+            self._cv.wait()
+            return True

@@ -1,0 +1,124 @@
+"""TPU015 fixture: blocking calls under a hot (multi-context) lock."""
+import queue
+import threading
+import time
+
+
+class BadScheduler:
+    """The lock is hot: the worker thread and the main-thread callers
+    both take it.  Blocking under it stalls every submitter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        with self._lock:
+            time.sleep(0.5)        # POSITIVE: sleep under the hot lock
+
+    def submit(self, item):
+        with self._lock:
+            self._q.put(item)      # POSITIVE: un-timed queue.put
+
+    def drain(self):
+        with self._lock:
+            return self._q.get()   # POSITIVE: un-timed queue.get
+
+    def step(self, fn, x):
+        with self._lock:
+            return _timed_decode("step", fn, x)  # POSITIVE: device call
+
+    def slow_close(self):
+        with self._lock:
+            self._thread.join()    # POSITIVE: un-timed Thread.join
+
+    def close(self):
+        self._thread.join()
+
+
+def _timed_decode(name, fn, x):
+    return fn(x)
+
+
+class BadIndirect:
+    """POSITIVE at the call site: the helper blocks, the caller holds
+    the hot lock — the interprocedural may-block closure."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+        self._thread.start()
+
+    def _tick(self):
+        with self._lock:
+            self._slow()           # POSITIVE: callee sleeps
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+    def _slow(self):
+        time.sleep(0.2)
+
+    def close(self):
+        self._thread.join()
+
+
+class GoodScheduler:
+    """negatives: blocking work outside the lock, bounded ops under
+    it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        with self._lock:
+            item = self._q.get(timeout=1.0)   # negative: bounded get
+        time.sleep(0.5)                       # negative: outside lock
+        return item
+
+    def submit(self, item):
+        self._q.put(item, True, 0.5)          # negative: bounded put
+
+    def peek(self):
+        with self._lock:
+            return self._q.qsize()            # negative: non-blocking
+
+    def close(self):
+        self._thread.join()
+
+
+class ColdLock:
+    """negative: the lock is only ever taken from the main context —
+    one contending context, nobody to stall."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slow(self):
+        with self._lock:
+            time.sleep(0.1)
+
+
+class SuppressedScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        with self._lock:
+            # tpulint: disable-next=TPU015 -- startup-only path, lock uncontended
+            time.sleep(0.1)
+
+    def nudge(self):
+        with self._lock:
+            return 1
+
+    def close(self):
+        self._thread.join()

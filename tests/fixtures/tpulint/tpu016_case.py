@@ -1,0 +1,51 @@
+"""TPU016 fixture: signal-handler / section-callback lock safety."""
+import signal
+import threading
+
+_lock = threading.Lock()
+_state = {"dumps": 0}
+
+_sections = {}
+
+
+def register_section(name, fn):
+    _sections[name] = fn
+
+
+def _bad_handler(signum, frame):
+    with _lock:                    # POSITIVE: blocking acquire in handler
+        _state["dumps"] += 1
+
+
+def _good_handler(signum, frame):
+    # negative: the sanctioned try-lock idiom — bail out rather than
+    # deadlock on the interrupted thread's lock
+    if not _lock.acquire(timeout=0.5):
+        return
+    try:
+        _state["dumps"] += 1
+    finally:
+        _lock.release()
+
+
+def _bad_section():
+    with _lock:                    # POSITIVE: section callbacks run at
+        return dict(_state)        # signal time too
+
+
+def _suppressed_handler(signum, frame):
+    # tpulint: disable-next=TPU016 -- handler only installed in single-threaded tools
+    with _lock:
+        _state["dumps"] += 1
+
+
+def not_a_handler():
+    with _lock:                    # negative: ordinary function, never
+        _state["dumps"] += 1       # runs in signal context
+
+
+def install():
+    signal.signal(signal.SIGTERM, _bad_handler)
+    signal.signal(signal.SIGINT, _good_handler)
+    signal.signal(signal.SIGUSR1, _suppressed_handler)
+    register_section("state", _bad_section)

@@ -1,0 +1,186 @@
+"""Runtime lock-witness tests: factory patching and creation-site
+filtering, per-thread edge recording, cycle detection, the static-graph
+subset cross-check, and the disabled path's zero overhead.
+
+The witness may already be live for the whole session
+(MXTPU_LOCK_WITNESS=1 runs install it from conftest before the package
+import); the `isolated` fixture snapshots and restores the global
+recorder state so these tests neither lose the session's edges nor leak
+their synthetic ones into the end-of-session assert_clean()."""
+import os
+import threading
+
+import pytest
+
+from incubator_mxnet_tpu import lock_witness as lw
+
+SRC_ORDERED = """\
+import threading
+a = threading.Lock()
+b = threading.Lock()
+
+def ab():
+    with a:
+        with b:
+            pass
+"""
+
+SRC_CYCLE = SRC_ORDERED + """\
+
+def ba():
+    with b:
+        with a:
+            pass
+"""
+
+SRC_LOCKS_ONLY = """\
+import threading
+c = threading.Lock()
+d = threading.Lock()
+"""
+
+
+@pytest.fixture
+def isolated(tmp_path):
+    """Witness tracking scoped to tmp_path, session state restored."""
+    was_installed = lw.installed()
+    saved_roots = lw._track_roots
+    saved_edges = dict(lw._edges)
+    saved_contention = lw._contention_total
+    lw.uninstall()
+    lw._edges.clear()
+    lw._contention_total = 0.0
+    lw.install(force=True, track_roots=[str(tmp_path)])
+    try:
+        yield lw
+    finally:
+        lw.uninstall()
+        lw._edges.clear()
+        lw._edges.update(saved_edges)
+        lw._contention_total = saved_contention
+        if was_installed:
+            lw.install(force=True,
+                       track_roots=[r.rstrip(os.sep) for r in saved_roots])
+
+
+def _load(tmp_path, name, src):
+    """Exec fixture source with creation frames pointing at a real file
+    under the tracked root — the witness keys locks by creation site."""
+    path = tmp_path / name
+    path.write_text(src)
+    ns = {}
+    exec(compile(src, str(path), "exec"), ns)
+    return path, ns
+
+
+def _run_in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def test_creation_site_filtering(isolated, tmp_path):
+    _, ns = _load(tmp_path, "wit_tracked.py", SRC_LOCKS_ONLY)
+    assert isinstance(ns["c"], lw._WitnessLock)
+    # locks created OUTSIDE the tracked roots come back raw
+    foreign = threading.Lock()
+    assert not isinstance(foreign, lw._WitnessLock)
+    # and the stdlib's own internals (Condition's waiter locks etc.)
+    # are never wrapped: Condition over a tracked lock still works
+    cond = threading.Condition(ns["c"])
+    with cond:
+        assert not cond.wait(timeout=0.01)
+
+
+def test_edges_recorded_per_thread(isolated, tmp_path):
+    _, ns = _load(tmp_path, "wit_ab.py", SRC_ORDERED)
+    _run_in_thread(ns["ab"])
+    obs = lw.edges()
+    assert len(obs) == 1
+    ((src, dst), meta), = obs.items()
+    assert src[1] == 2 and dst[1] == 3      # creation lines of a, b
+    assert meta["count"] == 1
+    assert meta["stack"]
+    # same order again: count bumps, no new edge
+    _run_in_thread(ns["ab"])
+    assert lw.edges()[(src, dst)]["count"] == 2
+    assert lw.check_acyclic() == []
+
+
+def test_try_acquire_is_not_an_edge(isolated, tmp_path):
+    _, ns = _load(tmp_path, "wit_try.py", SRC_LOCKS_ONLY)
+    with ns["c"]:
+        assert ns["d"].acquire(timeout=0.5)  # bounded: no c->d edge
+        ns["d"].release()
+    assert lw.edges() == {}
+
+
+def test_cycle_detection(isolated, tmp_path):
+    _, ns = _load(tmp_path, "wit_cycle.py", SRC_CYCLE)
+    _run_in_thread(ns["ab"])
+    _run_in_thread(ns["ba"])                 # opposite order
+    cycles = lw.check_acyclic()
+    assert cycles, "AB + BA must form an observed cycle"
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        lw.assert_clean()
+
+
+def test_static_subset_check(isolated, tmp_path):
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.tpulint.analyzer import Project
+    from tools.tpulint import lock_rules
+
+    path, ns = _load(tmp_path, "wit_sub.py", SRC_ORDERED)
+    _run_in_thread(ns["ab"])
+    graph = lock_rules.build_lock_graph(Project([str(path)]))
+    # the analyzer saw `ab`, so the observed edge is in the static graph
+    assert lw.check_static_subset(graph=graph) == []
+    assert lw.assert_clean(graph=graph)["edges"] == 1
+
+    # now an acquisition order the analyzer has never seen: locks from
+    # a file with NO acquiring functions, ordered by the test itself
+    lw.reset()
+    path2, ns2 = _load(tmp_path, "wit_sub2.py", SRC_LOCKS_ONLY)
+    with ns2["c"]:
+        with ns2["d"]:
+            pass
+    graph2 = lock_rules.build_lock_graph(Project([str(path2)]))
+    problems = lw.check_static_subset(graph=graph2)
+    assert problems and "missing from the static graph" in problems[0]
+    with pytest.raises(AssertionError, match="missing from"):
+        lw.assert_clean(graph=graph2)
+
+
+def test_contention_is_accumulated(isolated, tmp_path):
+    _, ns = _load(tmp_path, "wit_cont.py", SRC_LOCKS_ONLY)
+    c = ns["c"]
+    c.acquire()
+    t = threading.Thread(target=lambda: (c.acquire(), c.release()))
+    t.start()
+    import time
+    time.sleep(0.05)
+    c.release()
+    t.join()
+    assert lw.stats()["contention_seconds"] > 0.0
+    lw.snapshot()       # telemetry disabled: must be a silent no-op
+
+
+def test_disabled_path_zero_overhead(monkeypatch):
+    """Without the env gate nothing is patched: threading.Lock stays
+    the raw factory and install() declines."""
+    was_installed = lw.installed()
+    saved_roots = lw._track_roots
+    lw.uninstall()
+    monkeypatch.delenv("MXTPU_LOCK_WITNESS", raising=False)
+    try:
+        assert lw.install() is False         # env gate holds
+        assert threading.Lock is lw._orig_lock
+        assert threading.RLock is lw._orig_rlock
+        assert not isinstance(threading.Lock(), lw._WitnessLock)
+    finally:
+        if was_installed:
+            lw.install(force=True,
+                       track_roots=[r.rstrip(os.sep) for r in saved_roots])

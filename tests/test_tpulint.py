@@ -358,3 +358,106 @@ def test_checked_in_baseline_gate_is_green():
          "--no-cache"],
         cwd=REPO, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- TPU013-TPU016: lock-order / deadlock pass ---------------------------- #
+
+
+def test_tpu013_lock_order_cycles():
+    findings = lint("tpu013_case.py")
+    found = [f for f in findings if f.code == "TPU013"]
+    # one finding per cycle: the AB/BA pair and the 3-lock triangle;
+    # consistent-order, try-lock-backoff and suppressed pairs silent
+    assert len(found) == 2
+    cycles = {tuple(f.extra["cycle"]) for f in found}
+    assert any(len(c) == 2 for c in cycles)
+    assert any(len(c) == 3 for c in cycles)          # x -> y -> z -> x
+    for f in found:
+        assert f.extra["edges"], f
+        for e in f.extra["edges"]:
+            assert {"src", "dst", "via", "path", "line"} <= set(e)
+    assert not any("Good" in f.message or "Suppressed" in f.message
+                   for f in found)
+
+
+def test_tpu014_wait_outside_predicate_loop():
+    findings = lint("tpu014_case.py")
+    assert lines(findings, "TPU014") == [13, 23]
+    assert functions(findings) == {"BadWaiter.wait_ready",
+                                   "BadBareWaiter.wait_once"}
+
+
+def test_tpu015_blocking_under_hot_lock():
+    findings = lint("tpu015_case.py")
+    got = lines(findings, "TPU015")
+    # direct positives: sleep, un-timed put/get, device call, join
+    for line in (19, 23, 27, 31, 35):
+        assert line in got, (line, got)
+    # interprocedural: the call site into the sleeping helper
+    assert 56 in got
+    # negatives: bounded ops, blocking outside the lock, cold lock
+    silent = {"GoodScheduler", "ColdLock", "SuppressedScheduler"}
+    assert not any(any(s in f.function for s in silent)
+                   for f in findings if f.code == "TPU015")
+
+
+def test_tpu016_signal_handler_lock_safety():
+    findings = lint("tpu016_case.py")
+    assert lines(findings, "TPU016") == [16, 32]
+    # try-lock handler, unregistered function, suppressed handler silent
+    assert functions(findings) == {"_bad_handler", "_bad_section"}
+
+
+def test_lock_rules_silent_on_other_fixtures():
+    """The concurrency pass must not fire on the pre-existing rule
+    fixtures (they use locks/threads heavily)."""
+    for name in ("tpu011_case.py", "tpu012_case.py"):
+        findings = lint(name)
+        assert not [f for f in findings
+                    if f.code in ("TPU013", "TPU014", "TPU015", "TPU016")]
+
+
+def test_cli_json_carries_cycle_payload(tmp_path):
+    case = tmp_path / "case.py"
+    shutil.copy(os.path.join(FIXDIR, "tpu013_case.py"), case)
+    proc = _cli(["case.py", "--format", "json", "--select", "TPU013",
+                 "--no-cache"], tmp_path)
+    assert proc.returncode == 1
+    rows = [json.loads(line) for line in proc.stdout.splitlines() if line]
+    assert rows and all(r["rule"] == "TPU013" for r in rows)
+    for r in rows:
+        assert r["cycle"]
+        assert all(e["src"] and e["dst"] and e["via"] for e in r["edges"])
+
+
+def test_cli_dot_dumps_lock_graph(tmp_path):
+    case = tmp_path / "case.py"
+    shutil.copy(os.path.join(FIXDIR, "tpu013_case.py"), case)
+    proc = _cli(["case.py", "--format", "dot"], tmp_path)
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("digraph lock_order")
+    assert '"case.BadPair._a" -> "case.BadPair._b"' in proc.stdout
+    assert '"case.BadPair._b" -> "case.BadPair._a"' in proc.stdout
+
+
+def test_lock_graph_condition_aliases_to_underlying_lock(tmp_path):
+    case = tmp_path / "case.py"
+    case.write_text(
+        "import threading\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._work = threading.Condition(self._lock)\n"
+        "    def step(self):\n"
+        "        with self._work:\n"
+        "            return 1\n"
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return 2\n")
+    from tools.tpulint import lock_rules
+    project, findings = run([str(case)])
+    graph = lock_rules.build_lock_graph(project)
+    # the Condition is the SAME object as the lock: one canonical node
+    assert graph.canon("case.Engine._work") == "case.Engine._lock"
+    assert "case.Engine._work" not in graph.sites()
+    assert graph.sites()["case.Engine._lock"][1] == 4

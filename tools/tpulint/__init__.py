@@ -13,6 +13,17 @@ Rules (see docs/static_analysis.md for the full catalogue):
   TPU004  Python control flow on tracer-derived values under trace
   TPU005  side effect under jit (print / closure mutation / global write)
   TPU006  mutable default argument in a Block subclass signature
+  ...
+  TPU013  lock-order cycle across threads (deadlock)
+  TPU014  Condition.wait() outside a while-predicate loop (lost wakeup)
+  TPU015  blocking call (device dispatch / I/O / un-timed queue or
+          join) while holding a hot lock
+  TPU016  blocking lock acquisition in signal-handler context
+
+TPU013-TPU016 run as one project-wide pass over a per-object
+lock-acquisition graph (lock_rules.build_lock_graph); the runtime
+counterpart `incubator_mxnet_tpu.lock_witness` cross-checks observed
+acquisition order against that graph under MXTPU_LOCK_WITNESS=1.
 
 Trace-reachability is computed by a conservative call-graph walk seeded
 at jit entry points (`hybrid_forward`/`forward` of Block subclasses,

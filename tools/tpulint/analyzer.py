@@ -58,6 +58,11 @@ class Finding:
     line: int
     col: int
     function: str = ""
+    # structured payload for machine consumers (``--format json``):
+    # e.g. TPU013 carries {"cycle": [...], "edges": [...]}.  NOT part
+    # of key()/fingerprints — a cycle rendered from a different edge
+    # sample is still the same finding.
+    extra: Optional[dict] = None
 
     def key(self) -> Tuple[str, int, int, str]:
         return (self.path, self.line, self.col, self.code)
@@ -286,6 +291,7 @@ class Project:
         self._compute_shard_axes()
         self._compute_thread_reachable()
         self._compute_donations()
+        self._compute_registrations()
 
     # -- file discovery --------------------------------------------------- #
     @staticmethod
@@ -929,6 +935,83 @@ class Project:
                                     and tgt.value.id == "self":
                                 self.donating_attrs[
                                     (id(fn.cls), tgt.attr)] = nums
+
+    # -- handler registrations (TPU013/TPU015/TPU016) ------------------------ #
+    def _compute_registrations(self):
+        """Registration-based call facts the lock pass consumes:
+
+        * ``signal_handlers`` — functions installed via
+          ``signal.signal(sig, handler)``;
+        * ``section_callbacks`` — functions registered through a
+          ``register_section(name, fn)``-style hook (the flight
+          recorder's dump contributors);
+        * ``section_dispatchers`` — functions in the module DEFINING
+          ``register_section`` that read its registry dict and call an
+          element (``for name, fn in _sections.items(): fn()``) — the
+          statically-invisible indirect call the lock pass turns into
+          dispatcher→callback edges.
+
+        Kept OUT of the main call graph on purpose: registration edges
+        are lock-pass facts, and splicing them into ``callees()`` would
+        silently widen trace/thread reachability for every other rule.
+        """
+        self.signal_handlers: List[FunctionInfo] = []
+        self.section_callbacks: List[FunctionInfo] = []
+        self.section_dispatchers: List[FunctionInfo] = []
+
+        def resolve_fn_arg(fn: FunctionInfo, node: ast.AST
+                           ) -> Optional[FunctionInfo]:
+            d = dotted_name(node)
+            if d is None:
+                return None
+            return self._resolve_call_target(fn, d)
+
+        # the registry dict `register_section` stores into, per module
+        registry_names: Dict[str, str] = {}
+        for mod in self.modules.values():
+            reg = mod.functions.get("register_section")
+            if reg is None:
+                continue
+            for node in self.iter_own_nodes(reg):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.targets[0], ast.Subscript) \
+                        and isinstance(node.targets[0].value, ast.Name):
+                    registry_names[mod.name] = node.targets[0].value.id
+
+        for mod in self.modules.values():
+            for fn in mod.functions.values():
+                for call in self._iter_calls(fn):
+                    d = dotted_name(call.func)
+                    if d is None:
+                        continue
+                    resolved = self.resolve(mod, d)
+                    tail = resolved.rpartition(".")[2]
+                    if resolved == "signal.signal" and len(call.args) >= 2:
+                        target = resolve_fn_arg(fn, call.args[1])
+                        if target is not None \
+                                and target not in self.signal_handlers:
+                            self.signal_handlers.append(target)
+                    elif tail == "register_section" and len(call.args) >= 2:
+                        target = resolve_fn_arg(fn, call.args[1])
+                        if target is not None \
+                                and target not in self.section_callbacks:
+                            self.section_callbacks.append(target)
+        for modname, regname in registry_names.items():
+            mod = self.modules[modname]
+            for fn in mod.functions.values():
+                if fn.name == "register_section":
+                    continue
+                reads_registry = any(
+                    isinstance(n, ast.Name) and n.id == regname
+                    for n in self.iter_own_nodes(fn))
+                calls_bare = any(
+                    isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id not in mod.aliases
+                    and mod.functions.get(n.func.id) is None
+                    for n in self.iter_own_nodes(fn))
+                if reads_registry and calls_bare \
+                        and fn not in self.section_dispatchers:
+                    self.section_dispatchers.append(fn)
 
     # -- public ------------------------------------------------------------ #
     def iter_functions(self):

@@ -258,9 +258,10 @@ def findings_to_payload(pairs: Sequence[Tuple[Finding, str]],
         "n_reachable": n_reachable,
         "n_files": n_files,
         "findings": [
-            {"code": f.code, "message": f.message, "path": f.path,
-             "line": f.line, "col": f.col, "function": f.function,
-             "fingerprint": fp}
+            dict({"code": f.code, "message": f.message, "path": f.path,
+                  "line": f.line, "col": f.col, "function": f.function,
+                  "fingerprint": fp},
+                 **({"extra": f.extra} if f.extra else {}))
             for f, fp in pairs
         ],
     }
@@ -269,6 +270,6 @@ def findings_to_payload(pairs: Sequence[Tuple[Finding, str]],
 def payload_to_findings(payload: dict) -> List[Tuple[Finding, str]]:
     return [
         (Finding(e["code"], e["message"], e["path"], e["line"], e["col"],
-                 e.get("function", "")), e["fingerprint"])
+                 e.get("function", ""), e.get("extra")), e["fingerprint"])
         for e in payload.get("findings", [])
     ]

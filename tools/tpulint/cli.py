@@ -49,10 +49,15 @@ def _emit(pairs, fmt: str):
         # one finding per line (JSON-lines): trivially grep/jq-able,
         # diff-stable, and streamable — no enclosing array
         for f, fp in pairs:
-            print(json.dumps({"rule": f.code, "path": f.path,
-                              "line": f.line, "col": f.col,
-                              "function": f.function, "message": f.message,
-                              "fingerprint": fp}, sort_keys=True))
+            rec = {"rule": f.code, "path": f.path,
+                   "line": f.line, "col": f.col,
+                   "function": f.function, "message": f.message,
+                   "fingerprint": fp}
+            if f.extra:
+                # structured rule payload, e.g. TPU013's lock-order
+                # cycle and per-edge acquisition stacks
+                rec.update(f.extra)
+            print(json.dumps(rec, sort_keys=True))
     else:
         for f, _fp in pairs:
             print(f.format())
@@ -61,9 +66,9 @@ def _emit(pairs, fmt: str):
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpulint",
-        description="Static analyzer for JAX/TPU tracing, sharding and "
-                    "thread-safety hazards (TPU001-TPU012; see "
-                    "docs/static_analysis.md)")
+        description="Static analyzer for JAX/TPU tracing, sharding, "
+                    "thread-safety and lock-order hazards (TPU001-TPU016; "
+                    "see docs/static_analysis.md)")
     ap.add_argument("paths", nargs="+", help="files or directories to lint")
     ap.add_argument("--strict", action="store_true",
                     help="require a `-- reason` on every suppression")
@@ -71,9 +76,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="comma-separated rule codes to run (default: all)")
     ap.add_argument("--ignore", default=None,
                     help="comma-separated rule codes to skip")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
+    ap.add_argument("--format", choices=("text", "json", "dot"),
+                    default="text",
                     help="json = one finding per line with rule/path/line/"
-                         "fingerprint")
+                         "fingerprint; dot = Graphviz dump of the static "
+                         "lock-order graph (no findings)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="accepted-findings file: report and fail only on "
                          "findings NOT fingerprinted in it")
@@ -94,6 +101,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         if code not in ALL_RULES:
             print(f"tpulint: unknown rule code {code!r}", file=sys.stderr)
             return 2
+
+    if args.format == "dot":
+        # debugging view, not a lint: build the lock graph fresh
+        # (cache stores findings, not graphs) and dump it
+        from . import lock_rules
+        project = Project(args.paths)
+        if project.errors:
+            for e in project.errors:
+                print(f"tpulint: parse error: {e}", file=sys.stderr)
+            return 2
+        print(lock_rules.to_dot(lock_rules.build_lock_graph(project)),
+              end="")
+        return 0
 
     t0 = time.monotonic()
     files = Project._collect_files(args.paths)

@@ -1,0 +1,1095 @@
+"""Lock-order / deadlock rules TPU013–TPU016 (tpulint v3).
+
+The serving plane is multi-threaded (engine scheduler, HTTP acceptor,
+checkpoint worker, prefetchers, signal-time flight-recorder dumps) and
+the bug class that actually wedges a fleet is invisible to per-site
+rules: a lock-order inversion between two threads, a blocking device /
+queue / join call made while holding the scheduler lock, or a signal
+handler blocking on a lock the interrupted thread already holds.
+
+This pass builds a **per-object lock-acquisition graph**:
+
+1. *lock identities* — every ``threading.Lock``/``RLock``/``Condition``
+   construction is a node, keyed ``module.Class.attr`` (instance
+   attribute, canonicalized to the ancestor class that assigns it) or
+   ``module.var`` (module-level).  ``Condition(existing_lock)`` is an
+   **alias** of the underlying lock's node — ``self._work =
+   Condition(self._lock)`` and the engine lock are one object;
+2. *acquisition sites* — ``with lock:`` blocks, explicit
+   ``lock.acquire()`` (classified blocking vs try: a ``blocking=False``
+   or finite ``timeout=`` acquire cannot deadlock and never creates an
+   edge), and ``Condition.wait()`` re-acquisition;
+3. *held-while-acquiring edges* — propagated interprocedurally over
+   the analyzer call graph **plus** a lock-pass-local typed resolution
+   layer (constructor-assigned attribute types, annotated parameters,
+   return annotations — so ``telemetry.gauge(...).set()`` under the
+   engine lock resolves through ``Registry.gauge -> Gauge`` to the
+   metric lock) **plus** registration facts (``signal.signal`` handlers
+   and flight-recorder ``register_section`` callbacks, whose calls are
+   statically invisible ``fn()`` dispatches).
+
+Rules over the graph:
+
+* **TPU013** — lock-order cycle: a strongly connected component in the
+  edge graph means two threads can acquire the same pair of locks in
+  opposite order; the finding carries the cycle and both acquisition
+  stacks (``extra={"cycle": ..., "edges": ...}``, also emitted by
+  ``--format json``);
+* **TPU014** — ``Condition.wait()`` outside a ``while`` predicate loop
+  (a bare ``if``-recheck or none at all → lost wakeup on spurious
+  notify / multi-waiter races);
+* **TPU015** — blocking call under a *hot* lock: device dispatch or
+  host sync, un-timed ``queue.put/get/join``, ``Thread.join`` or
+  ``time.sleep`` reachable while holding a lock that more than one
+  thread context (scheduler/main/signal) also takes;
+* **TPU016** — signal-handler lock safety: functions reachable from a
+  ``signal.signal`` handler or a flight-recorder section callback
+  (within the handler's own module — cross-module library locks are
+  the callee's audit) may only use try-lock acquisition
+  (``acquire(timeout=...)`` / ``acquire(False)``), never a blocking
+  ``with lock:`` — the interrupted thread may already hold it, and a
+  signal handler that blocks on it self-deadlocks the process.
+
+The runtime counterpart (``incubator_mxnet_tpu/lock_witness.py``)
+records *actual* per-thread acquisition order and cross-checks every
+observed edge against :func:`build_lock_graph`'s static edges — the
+analyzer is validated against reality, not only fixtures.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analyzer import (Finding, FunctionInfo, ModuleInfo, Project,
+                       dotted_name)
+
+LOCK_RULES = ("TPU013", "TPU014", "TPU015", "TPU016")
+
+LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+
+# callables that park the calling thread unboundedly (TPU015)
+BLOCKING_FUNCS = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "urllib.request.urlopen", "socket.create_connection",
+}
+
+# device dispatch / host-sync entry points: these drain or feed the
+# device queue — tens of ms under a lock every submitter contends on.
+# `numpy.asarray` is included because materializing a device array
+# through it is the package's standard sync idiom.
+DEVICE_FUNCS = {"jax.device_get", "jax.block_until_ready",
+                "jax.device_put", "numpy.asarray"}
+DEVICE_TAILS = {"_timed_decode", "block_until_ready"}
+
+QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+
+
+# ---------------------------------------------------------------------------
+# typed resolution (lock-pass local — deliberately NOT part of the main
+# call graph: widening callees() would silently grow trace/thread
+# reachability for every other rule)
+# ---------------------------------------------------------------------------
+
+
+class _TypeEnv:
+    """Light nominal types: constructor-assigned attributes
+    (``self._slo = SloTracker(...)``), module globals
+    (``_default_registry = Registry()``), annotated parameters
+    (``engine: "ServingEngine"``) and return annotations
+    (``def gauge(...) -> Gauge``)."""
+
+    def __init__(self, project: Project):
+        self.p = project
+        # (class full_name, attr) -> type string (class full name or
+        # stdlib ctor like "queue.Queue")
+        self.attr_types: Dict[Tuple[str, str], str] = {}
+        self.global_types: Dict[Tuple[str, str], str] = {}
+        self._locals: Dict[int, Dict[str, str]] = {}
+        self._build()
+
+    # -- building --------------------------------------------------------- #
+    def _class_named(self, mod: ModuleInfo, name: str):
+        """lookup_class through import aliases AND module-local bare
+        names (``Request`` inside engine.py — resolve() only maps
+        aliases, so same-module classes need the module prefix)."""
+        cls = self.p.lookup_class(self.p.resolve(mod, name))
+        if cls is None and "." not in name:
+            cls = self.p.lookup_class(f"{mod.name}.{name}")
+        return cls
+
+    def _ann_type(self, mod: ModuleInfo, ann) -> Optional[str]:
+        name = None
+        if isinstance(ann, ast.Name):
+            name = ann.id
+        elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value
+        elif isinstance(ann, ast.Attribute):
+            name = dotted_name(ann)
+        elif isinstance(ann, ast.Subscript):        # Optional["X"] etc.
+            return self._ann_type(mod, ann.slice)
+        elif isinstance(ann, ast.Tuple):
+            # Dict[K, V] slice: prefer the value type — container
+            # types deliberately degrade to their ELEMENT type here
+            # (iteration/subscript then pass it through)
+            for elt in reversed(ann.elts):
+                t = self._ann_type(mod, elt)
+                if t:
+                    return t
+            return None
+        if not name:
+            return None
+        cls = self._class_named(mod, name)
+        return cls.full_name if cls is not None else None
+
+    def _return_type(self, fi: FunctionInfo) -> Optional[str]:
+        return self._ann_type(fi.module, fi.node.returns)
+
+    def _ctor_type(self, fn_or_mod, mod: ModuleInfo,
+                   value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted_name(value.func)
+        if d is None:
+            return None
+        resolved = self.p.resolve(mod, d)
+        cls = self._class_named(mod, d)
+        if cls is not None:
+            return cls.full_name
+        if resolved in QUEUE_CTORS or resolved in THREAD_CTORS \
+                or resolved in LOCK_CTORS:
+            return resolved
+        fi = self.p.lookup_function(resolved)
+        if fi is not None:
+            return self._return_type(fi)
+        return None
+
+    def _build(self) -> None:
+        for mod in self.p.modules.values():
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    t = self._ctor_type(None, mod, stmt.value)
+                    if t:
+                        self.global_types[(mod.name, stmt.targets[0].id)] = t
+            for fn in mod.functions.values():
+                if fn.cls is None:
+                    continue
+                ann_params = {
+                    a.arg: self._ann_type(mod, a.annotation)
+                    for a in (fn.node.args.posonlyargs + fn.node.args.args
+                              + fn.node.args.kwonlyargs)
+                    if a.annotation is not None}
+                for node in self.p.iter_own_nodes(fn):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                            or node.value is None:
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    t = self._ctor_type(fn, mod, node.value)
+                    if t is None and isinstance(node.value, ast.Name):
+                        t = ann_params.get(node.value.id)
+                    if t is None and isinstance(node, ast.AnnAssign):
+                        t = self._ann_type(mod, node.annotation)
+                    if t is None:
+                        continue
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            self.attr_types.setdefault(
+                                (fn.cls.full_name, tgt.attr), t)
+
+    # -- queries ---------------------------------------------------------- #
+    def class_attr(self, cls_full: str, attr: str) -> Optional[str]:
+        t = self.attr_types.get((cls_full, attr))
+        if t:
+            return t
+        cls = self.p.lookup_class(cls_full)
+        if cls is None:
+            return None
+        for anc in self.p._class_ancestry(cls):
+            t = self.attr_types.get((anc.full_name, attr))
+            if t:
+                return t
+        return None
+
+    def method(self, cls_full: str, name: str) -> Optional[FunctionInfo]:
+        cls = self.p.lookup_class(cls_full)
+        if cls is None:
+            return None
+        m = cls.methods.get(name)
+        if m is not None:
+            return m
+        for anc in self.p._class_ancestry(cls):
+            m = anc.methods.get(name)
+            if m is not None:
+                return m
+        return None
+
+    def fn_locals(self, fn: FunctionInfo) -> Dict[str, str]:
+        env = self._locals.get(id(fn))
+        if env is not None:
+            return env
+        env = {}
+        self._locals[id(fn)] = env      # registered first: cycle-safe
+        mod = fn.module
+        for a in (fn.node.args.posonlyargs + fn.node.args.args
+                  + fn.node.args.kwonlyargs):
+            t = self._ann_type(mod, a.annotation) if a.annotation else None
+            if t:
+                env[a.arg] = t
+        for node in self.p.iter_own_nodes(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self.infer(fn, node.value)
+                if t:
+                    env[node.targets[0].id] = t
+            elif isinstance(node, ast.For):
+                # container attr types degrade to their element type,
+                # so `for m in self._metrics.values():` (and bare
+                # iteration / `.items()` value slots) pass through
+                it = node.iter
+                if isinstance(it, ast.Call) \
+                        and isinstance(it.func, ast.Attribute) \
+                        and it.func.attr in ("values", "items"):
+                    it = it.func.value
+                t = self.infer(fn, it)
+                if not t:
+                    continue
+                tgt = node.target
+                if isinstance(tgt, ast.Tuple) and tgt.elts:
+                    tgt = tgt.elts[-1]      # items(): the value slot
+                if isinstance(tgt, ast.Name):
+                    env.setdefault(tgt.id, t)
+        return env
+
+    def infer(self, fn: FunctionInfo, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fn.cls is not None:
+                return fn.cls.full_name
+            t = self.fn_locals(fn).get(expr.id)
+            if t:
+                return t
+            return self.global_types.get((fn.module.name, expr.id))
+        if isinstance(expr, ast.Attribute):
+            base = self.infer(fn, expr.value)
+            if base is not None and "." in base:
+                t = self.class_attr(base, expr.attr)
+                if t:
+                    return t
+            d = dotted_name(expr)
+            if d is not None:
+                resolved = self.p.resolve(fn.module, d)
+                modname, _, var = resolved.rpartition(".")
+                if modname in self.p.modules:
+                    return self.global_types.get((modname, var))
+            return None
+        if isinstance(expr, ast.Subscript):
+            # element-type degradation: `self._slots[lane]` keeps the
+            # container attr's (element) type
+            return self.infer(fn, expr.value)
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func)
+            if d is not None:
+                resolved = self.p.resolve(fn.module, d)
+                cls = self._class_named(fn.module, d)
+                if cls is not None:
+                    return cls.full_name
+                if resolved in QUEUE_CTORS or resolved in THREAD_CTORS:
+                    return resolved
+                fi = self.p._resolve_call_target(fn, d) \
+                    or self.p.lookup_function(resolved)
+                if fi is not None:
+                    rt = self._return_type(fi)
+                    if rt:
+                        return rt
+            if isinstance(expr.func, ast.Attribute):
+                base = self.infer(fn, expr.func.value)
+                if base:
+                    m = self.method(base, expr.func.attr)
+                    if m is not None:
+                        return self._return_type(m)
+            return None
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the lock graph
+# ---------------------------------------------------------------------------
+
+
+class LockGraph:
+    """Static lock facts: identities, aliases, held-while-acquiring
+    edges, per-token acquisition contexts and hot-lock set."""
+
+    def __init__(self):
+        self.defs: Dict[str, dict] = {}     # token -> kind/path/line
+        self.alias: Dict[str, str] = {}     # condition token -> lock token
+        self.edges: Dict[Tuple[str, str], dict] = {}
+        self.contexts: Dict[str, Set[str]] = {}
+        self.hot: Set[str] = set()
+
+    def canon(self, token: str) -> str:
+        seen = set()
+        while token in self.alias and token not in seen:
+            seen.add(token)
+            token = self.alias[token]
+        return token
+
+    def sites(self) -> Dict[str, Tuple[str, int]]:
+        """Canonical token -> (path, line) of the lock construction —
+        the witness's join key (it attributes observed locks by
+        creation frame)."""
+        out: Dict[str, Tuple[str, int]] = {}
+        for token, d in self.defs.items():
+            if self.canon(token) == token:
+                out[token] = (d["path"], d["line"])
+        return out
+
+    def edge_list(self) -> List[dict]:
+        return [dict(sample, src=s, dst=t)
+                for (s, t), sample in sorted(self.edges.items())]
+
+    def add_edge(self, src: str, dst: str, sample: dict) -> None:
+        if src == dst:
+            return
+        self.edges.setdefault((src, dst), sample)
+
+
+def to_dot(graph: LockGraph) -> str:
+    """Graphviz dump of the lock-order graph (``--format dot``)."""
+
+    def short(token: str) -> str:
+        parts = token.split(".")
+        return ".".join(parts[-3:]) if len(parts) > 3 else token
+
+    lines = ["digraph lock_order {", "  rankdir=LR;",
+             '  node [shape=box, fontsize=10];']
+    tokens = sorted({t for e in graph.edges for t in e}
+                    | set(graph.sites()))
+    for t in tokens:
+        attrs = [f'label="{short(t)}"']
+        if t in graph.hot:
+            attrs.append('style=filled, fillcolor="#ffd9b3"')
+        ctx = graph.contexts.get(t)
+        if ctx:
+            attrs.append(f'tooltip="{",".join(sorted(ctx))}"')
+        lines.append(f'  "{t}" [{", ".join(attrs)}];')
+    for (s, t), sample in sorted(graph.edges.items()):
+        label = f"{sample.get('path', '?')}:{sample.get('line', 0)}"
+        lines.append(f'  "{s}" -> "{t}" [label="{label}", fontsize=8];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# per-function acquisition walker
+# ---------------------------------------------------------------------------
+
+
+class _FnLockInfo:
+    __slots__ = ("acqs", "waits", "blocks", "held_at_call")
+
+    def __init__(self):
+        # (token, node, blocking, held-frozenset) — token canonical
+        self.acqs: List[Tuple[str, ast.AST, bool, frozenset]] = []
+        # (token, node, in_loop, held) — Condition.wait sites
+        self.waits: List[Tuple[str, ast.AST, bool, frozenset]] = []
+        # (node, reason, held) — directly blocking operations
+        self.blocks: List[Tuple[ast.AST, str, frozenset]] = []
+        self.held_at_call: Dict[int, frozenset] = {}
+
+
+def _timeout_of(call: ast.Call):
+    """The acquire/put/get timeout expression, None when absent."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return kw.value
+    if len(call.args) >= 2:         # acquire(blocking, timeout) / put(x, block, t)
+        return call.args[-1]
+    return None
+
+
+def _is_try_acquire(call: ast.Call) -> bool:
+    """``acquire(False)`` / ``acquire(blocking=False)`` / finite
+    ``acquire(timeout=...)`` — bounded, cannot deadlock."""
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and not kw.value.value:
+            return True
+        if kw.arg == "timeout":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(
+                    v.value, (int, float)) and v.value < 0:
+                return False        # timeout=-1 blocks forever
+            return True
+    if call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and not a0.value:
+            return True
+        if len(call.args) >= 2:
+            return True             # acquire(blocking, timeout)
+    return False
+
+
+class _LockPass:
+    """The whole interprocedural pass; built once per project."""
+
+    def __init__(self, project: Project):
+        self.p = project
+        self.types = _TypeEnv(project)
+        self.graph = LockGraph()
+        self.info: Dict[int, _FnLockInfo] = {}
+        self._local_exprs: Dict[int, Dict[str, ast.AST]] = {}
+        self._collect_defs()
+        for fn in project.iter_functions():
+            self.info[id(fn)] = self._walk_fn(fn)
+        self._build_callees()
+        self._compute_entry_held()
+        self._compute_closures()
+        self._compute_signal_scope()
+        self._emit_edges_and_contexts()
+
+    # -- lock definitions -------------------------------------------------- #
+    def _lock_ctor(self, mod: ModuleInfo, value: ast.AST
+                   ) -> Optional[Tuple[str, ast.Call]]:
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted_name(value.func)
+        if d is None:
+            return None
+        kind = LOCK_CTORS.get(self.p.resolve(mod, d))
+        return (kind, value) if kind else None
+
+    def _collect_defs(self) -> None:
+        pending: List[Tuple[str, Optional[FunctionInfo], ModuleInfo,
+                            ast.AST]] = []
+        for mod in self.p.modules.values():
+            for stmt in mod.tree.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                got = self._lock_ctor(mod, stmt.value)
+                if got is None:
+                    continue
+                kind, call = got
+                token = f"{mod.name}.{stmt.targets[0].id}"
+                self.graph.defs.setdefault(token, {
+                    "kind": kind, "path": mod.path, "line": stmt.lineno})
+                if kind == "condition" and call.args:
+                    pending.append((token, None, mod, call.args[0]))
+            for fn in mod.functions.values():
+                if fn.cls is None:
+                    continue
+                for node in self.p.iter_own_nodes(fn):
+                    if not isinstance(node, (ast.Assign, ast.AnnAssign)) \
+                            or node.value is None:
+                        continue
+                    got = self._lock_ctor(mod, node.value)
+                    if got is None:
+                        continue
+                    kind, call = got
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            token = f"{fn.cls.full_name}.{tgt.attr}"
+                            self.graph.defs.setdefault(token, {
+                                "kind": kind, "path": mod.path,
+                                "line": node.lineno})
+                            if kind == "condition" and call.args:
+                                pending.append((token, fn, mod, call.args[0]))
+        for token, fn, mod, arg in pending:
+            target = self._token_of(fn, mod, arg, canon=False)
+            if target is not None and target != token:
+                self.graph.alias[token] = target
+
+    # -- token resolution -------------------------------------------------- #
+    def _class_lock(self, cls_full: str, attr: str) -> Optional[str]:
+        token = f"{cls_full}.{attr}"
+        if token in self.graph.defs:
+            return token
+        cls = self.p.lookup_class(cls_full)
+        if cls is None:
+            return None
+        for anc in self.p._class_ancestry(cls):
+            token = f"{anc.full_name}.{attr}"
+            if token in self.graph.defs:
+                return token
+        return None
+
+    def _local_expr_map(self, fn: FunctionInfo) -> Dict[str, ast.AST]:
+        got = self._local_exprs.get(id(fn))
+        if got is not None:
+            return got
+        out: Dict[str, ast.AST] = {}
+        for node in self.p.iter_own_nodes(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                out[node.targets[0].id] = node.value
+        self._local_exprs[id(fn)] = out
+        return out
+
+    def _token_of(self, fn: Optional[FunctionInfo], mod: ModuleInfo,
+                  expr: ast.AST, depth: int = 0,
+                  canon: bool = True) -> Optional[str]:
+        """Canonical lock token an expression refers to, or None."""
+        if depth > 2:
+            return None
+        token: Optional[str] = None
+        if isinstance(expr, ast.Name):
+            cand = f"{mod.name}.{expr.id}"
+            if cand in self.graph.defs:
+                token = cand
+            elif fn is not None:
+                v = self._local_expr_map(fn).get(expr.id)
+                if v is not None and v is not expr:
+                    token = self._token_of(fn, mod, v, depth + 1, canon=False)
+        elif isinstance(expr, ast.Attribute):
+            base = expr.value
+            base_t: Optional[str] = None
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and fn is not None and fn.cls is not None:
+                base_t = fn.cls.full_name
+            elif fn is not None:
+                base_t = self.types.infer(fn, base)
+            if base_t:
+                token = self._class_lock(base_t, expr.attr)
+            if token is None:
+                d = dotted_name(expr)
+                if d is not None:
+                    resolved = self.p.resolve(mod, d)
+                    modname, _, var = resolved.rpartition(".")
+                    if modname in self.p.modules \
+                            and f"{modname}.{var}" in self.graph.defs:
+                        token = f"{modname}.{var}"
+        if token is None:
+            return None
+        return self.graph.canon(token) if canon else token
+
+    def _token_kind(self, fn: FunctionInfo, expr: ast.AST) -> Optional[str]:
+        raw = self._token_of(fn, fn.module, expr, canon=False)
+        if raw is None:
+            return None
+        return self.graph.defs.get(raw, {}).get("kind")
+
+    # -- acquisition walker ------------------------------------------------ #
+    def _walk_fn(self, fn: FunctionInfo) -> _FnLockInfo:
+        info = _FnLockInfo()
+        mod = fn.module
+
+        def classify_blocking(call: ast.Call) -> Optional[str]:
+            d = dotted_name(call.func)
+            if d is not None:
+                resolved = self.p.resolve(mod, d)
+                tail = resolved.rpartition(".")[2]
+                if resolved in BLOCKING_FUNCS:
+                    return f"`{d}`"
+                if resolved in DEVICE_FUNCS or tail in DEVICE_TAILS:
+                    return f"device dispatch/sync `{d}`"
+            if isinstance(call.func, ast.Attribute):
+                attr = call.func.attr
+                if attr == "block_until_ready":
+                    return f"device sync `.{attr}()`"
+                if attr in ("put", "get", "join"):
+                    recv_t = self.types.infer(fn, call.func.value)
+                    if recv_t in QUEUE_CTORS and _timeout_of(call) is None:
+                        return f"un-timed `queue.{attr}()`"
+                    if recv_t in THREAD_CTORS and attr == "join" \
+                            and not call.args and _timeout_of(call) is None:
+                        return "`Thread.join()` without a timeout"
+            return None
+
+        def scan_stmt_calls(stmt: ast.stmt, cur: Set[str],
+                            in_loop: bool) -> None:
+            """Calls evaluated directly by `stmt` (nested statements are
+            visited by their own scan)."""
+
+            def rec(node):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.stmt, ast.excepthandler,
+                                          ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    if isinstance(child, ast.Call):
+                        handle_call(child, cur, in_loop)
+                    rec(child)
+
+            rec(stmt)
+
+        def handle_call(call: ast.Call, cur: Set[str],
+                        in_loop: bool) -> None:
+            info.held_at_call[id(call)] = frozenset(cur)
+            if isinstance(call.func, ast.Attribute):
+                attr = call.func.attr
+                recv = call.func.value
+                if attr == "acquire":
+                    token = self._token_of(fn, mod, recv)
+                    if token is not None:
+                        blocking = not _is_try_acquire(call)
+                        info.acqs.append((token, call, blocking,
+                                          frozenset(cur - {token})))
+                        cur.add(token)
+                    return
+                if attr == "release":
+                    token = self._token_of(fn, mod, recv)
+                    if token is not None:
+                        cur.discard(token)
+                    return
+                if attr == "wait":
+                    if self._token_kind(fn, recv) == "condition":
+                        token = self._token_of(fn, mod, recv)
+                        info.waits.append((token, call, in_loop,
+                                           frozenset(cur - {token})))
+                    return
+            reason = classify_blocking(call)
+            if reason is not None:
+                info.blocks.append((call, reason, frozenset(cur)))
+
+        def walk(body: List[ast.stmt], held: Set[str],
+                 in_loop: bool) -> None:
+            cur = set(held)
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                scan_stmt_calls(stmt, cur, in_loop)
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    tokens = set()
+                    for item in stmt.items:
+                        token = self._token_of(fn, mod, item.context_expr)
+                        if token is not None:
+                            info.acqs.append((
+                                token, item.context_expr, True,
+                                frozenset((cur | tokens) - {token})))
+                            tokens.add(token)
+                    walk(stmt.body, cur | tokens, in_loop)
+                    continue
+                inner = in_loop or isinstance(stmt, (ast.While, ast.For))
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        walk(sub, cur,
+                             inner if attr != "orelse"
+                             or isinstance(stmt, (ast.While, ast.For))
+                             else in_loop)
+                for h in getattr(stmt, "handlers", []) or []:
+                    walk(h.body, cur, in_loop)
+
+        walk(fn.node.body, set(), False)
+        return info
+
+    # -- lock-pass call graph ---------------------------------------------- #
+    def _build_callees(self) -> None:
+        self.callees: Dict[int, List[Tuple[ast.Call, FunctionInfo]]] = {}
+        dispatchers = {id(f) for f in getattr(self.p, "section_dispatchers",
+                                              [])}
+        callbacks = list(getattr(self.p, "section_callbacks", []))
+        for fn in self.p.iter_functions():
+            out: List[Tuple[ast.Call, FunctionInfo]] = []
+            for call in self.p._iter_calls(fn):
+                d = dotted_name(call.func)
+                target = self.p._resolve_call_target(fn, d) \
+                    if d is not None else None
+                if target is None and isinstance(call.func, ast.Attribute):
+                    base_t = self.types.infer(fn, call.func.value)
+                    if base_t:
+                        target = self.types.method(base_t, call.func.attr)
+                if target is not None:
+                    out.append((call, target))
+                elif id(fn) in dispatchers and d is None is not call.func \
+                        and isinstance(call.func, ast.Name):
+                    pass
+                elif id(fn) in dispatchers and isinstance(call.func,
+                                                          ast.Name):
+                    for cb in callbacks:
+                        out.append((call, cb))
+            # dispatcher bare-name calls (`for name, fn in _sections: fn()`)
+            if id(fn) in dispatchers:
+                resolved_ids = {id(c) for c, _ in out}
+                for call in self.p._iter_calls(fn):
+                    if id(call) in resolved_ids:
+                        continue
+                    if isinstance(call.func, ast.Name) \
+                            and fn.module.functions.get(call.func.id) is None \
+                            and call.func.id not in fn.module.aliases:
+                        for cb in callbacks:
+                            out.append((call, cb))
+            self.callees[id(fn)] = out
+
+    # -- interprocedural fixpoints ----------------------------------------- #
+    def _compute_entry_held(self) -> None:
+        self.entry_held: Dict[int, frozenset] = {
+            id(fn): frozenset() for fn in self.p.iter_functions()}
+        for _ in range(10):
+            changed = False
+            for fn in self.p.iter_functions():
+                base = self.entry_held[id(fn)]
+                for call, target in self.callees.get(id(fn), []):
+                    held = self.info[id(fn)].held_at_call.get(
+                        id(call), frozenset()) | base
+                    tid = id(target)
+                    if tid in self.entry_held \
+                            and not held <= self.entry_held[tid]:
+                        self.entry_held[tid] = self.entry_held[tid] | held
+                        changed = True
+            if not changed:
+                break
+
+    def _compute_closures(self) -> None:
+        """token -> (path, line, chain) each function may BLOCKINGLY
+        acquire, transitively; plus a may-block reason closure."""
+        self.acq_closure: Dict[int, Dict[str, Tuple[str, int, str]]] = {}
+        self.block_closure: Dict[int, Optional[Tuple[str, str, int]]] = {}
+        for fn in self.p.iter_functions():
+            acc: Dict[str, Tuple[str, int, str]] = {}
+            info = self.info[id(fn)]
+            for token, node, blocking, _held in info.acqs:
+                if blocking and token not in acc:
+                    acc[token] = (fn.module.path, node.lineno, fn.qualname)
+            for token, node, _in_loop, _held in info.waits:
+                if token is not None and token not in acc:
+                    acc[token] = (fn.module.path, node.lineno,
+                                  f"{fn.qualname} (wait re-acquire)")
+            self.acq_closure[id(fn)] = acc
+            blk = None
+            if info.blocks:
+                node, reason, _held = info.blocks[0]
+                blk = (reason, fn.module.path, node.lineno)
+            self.block_closure[id(fn)] = blk
+        for _ in range(20):
+            changed = False
+            for fn in self.p.iter_functions():
+                acc = self.acq_closure[id(fn)]
+                blk = self.block_closure[id(fn)]
+                for _call, target in self.callees.get(id(fn), []):
+                    for token, (path, line, chain) in \
+                            self.acq_closure.get(id(target), {}).items():
+                        if token not in acc:
+                            acc[token] = (path, line,
+                                          f"{fn.qualname} -> {chain}")
+                            changed = True
+                    if blk is None:
+                        tb = self.block_closure.get(id(target))
+                        if tb is not None:
+                            reason, path, line = tb
+                            blk = (f"{reason} via `{target.qualname}`",
+                                   path, line)
+                            self.block_closure[id(fn)] = blk
+                            changed = True
+            if not changed:
+                break
+
+    def _compute_signal_scope(self) -> None:
+        """Functions running in signal-handler context.  Two sets: the
+        full closure (hot-lock contexts) and a module-scoped one
+        (TPU016 flags only the handler's own module — cross-module
+        library locks are the callee's audit, provided they are brief).
+        """
+        handlers = list(getattr(self.p, "signal_handlers", []))
+        callbacks = list(getattr(self.p, "section_callbacks", []))
+        roots = handlers + callbacks
+        self.signal_reachable: Set[int] = set()
+        self.signal_scope: Dict[int, str] = {}      # id -> root qualname
+        for root in roots:
+            work = [root]
+            seen = {id(root)}
+            self.signal_reachable.add(id(root))
+            self.signal_scope.setdefault(id(root), root.qualname)
+            while work:
+                f = work.pop()
+                for _call, target in self.callees.get(id(f), []):
+                    if id(target) in seen:
+                        continue
+                    seen.add(id(target))
+                    self.signal_reachable.add(id(target))
+                    if target.module is root.module:
+                        self.signal_scope.setdefault(id(target),
+                                                     root.qualname)
+                    work.append(target)
+
+    # -- edges + contexts --------------------------------------------------- #
+    def _context_of(self, fn: FunctionInfo) -> Set[str]:
+        ctx = {"thread"} if fn.thread_reachable else {"main"}
+        if id(fn) in self.signal_reachable:
+            ctx.add("signal")
+        return ctx
+
+    def _emit_edges_and_contexts(self) -> None:
+        g = self.graph
+        for fn in self.p.iter_functions():
+            info = self.info[id(fn)]
+            eh = self.entry_held[id(fn)]
+            for token, node, blocking, held in info.acqs:
+                if not blocking:
+                    continue
+                for h in (held | eh) - {token}:
+                    g.add_edge(h, token, {
+                        "path": fn.module.path, "line": node.lineno,
+                        "function": fn.full_name,
+                        "via": f"{fn.qualname} acquires `{token}` while "
+                               f"holding `{h}`"})
+            for token, node, _in_loop, held in info.waits:
+                if token is None:
+                    continue
+                for h in (held | eh) - {token}:
+                    g.add_edge(h, token, {
+                        "path": fn.module.path, "line": node.lineno,
+                        "function": fn.full_name,
+                        "via": f"{fn.qualname} Condition.wait re-acquires "
+                               f"`{token}` while holding `{h}`"})
+            for call, target in self.callees.get(id(fn), []):
+                held = info.held_at_call.get(id(call), frozenset()) | eh
+                if not held:
+                    continue
+                for token, (path, line, chain) in \
+                        self.acq_closure.get(id(target), {}).items():
+                    if token in held:
+                        continue
+                    for h in held:
+                        g.add_edge(h, token, {
+                            "path": fn.module.path, "line": call.lineno,
+                            "function": fn.full_name,
+                            "via": f"{fn.qualname} -> {chain} "
+                                   f"({path}:{line})"})
+        for fn in self.p.iter_functions():
+            ctx = self._context_of(fn)
+            for token in self.acq_closure.get(id(fn), {}):
+                g.contexts.setdefault(token, set()).update(ctx)
+        g.hot = {t for t, ctx in g.contexts.items()
+                 if len(ctx) >= 2 or "signal" in ctx}
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+_PASS_CACHE: Dict[int, _LockPass] = {}
+
+
+def _lock_pass(project: Project) -> _LockPass:
+    lp = _PASS_CACHE.get(id(project))
+    if lp is None:
+        lp = _LockPass(project)
+        _PASS_CACHE.clear()
+        _PASS_CACHE[id(project)] = lp
+    return lp
+
+
+def build_lock_graph(project: Project) -> LockGraph:
+    """The static lock graph (also the witness's cross-check source)."""
+    return _lock_pass(project).graph
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], dict]) -> List[List[str]]:
+    """One representative cycle per strongly connected component with
+    more than one node (self-loops are reentrancy, not inversions)."""
+    adj: Dict[str, List[str]] = {}
+    for s, t in edges:
+        if s != t:
+            adj.setdefault(s, []).append(t)
+            adj.setdefault(t, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(adj.get(v, [])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj.get(w, []))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: List[List[str]] = []
+    for comp in sccs:
+        comp_set = set(comp)
+        start = comp[0]
+        # walk inside the SCC until we revisit a node — that's a cycle
+        path, seen = [start], {start: 0}
+        node = start
+        while True:
+            nxt = next(w for w in adj[node] if w in comp_set)
+            if nxt in seen:
+                cycles.append(path[seen[nxt]:])
+                break
+            seen[nxt] = len(path)
+            path.append(nxt)
+            node = nxt
+    return cycles
+
+
+def check_tpu013(lp: _LockPass) -> List[Finding]:
+    out: List[Finding] = []
+    for cycle in _find_cycles(lp.graph.edges):
+        pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+        samples = [lp.graph.edges[p] for p in pairs if p in lp.graph.edges]
+        if not samples:
+            continue
+        anchor = min(samples, key=lambda s: (s["path"], s["line"]))
+        stacks = "; ".join(
+            f"{s['src' if 'src' in s else 'path']}" if False else
+            f"[{a} -> {b}] {s['via']} at {s['path']}:{s['line']}"
+            for (a, b), s in zip(pairs, samples))
+        out.append(Finding(
+            "TPU013",
+            f"lock-order cycle {' -> '.join(cycle + [cycle[0]])} — two "
+            f"threads can acquire these locks in opposite order and "
+            f"deadlock; acquisition stacks: {stacks}. Impose one global "
+            f"order (or drop to a try-lock on one side)",
+            anchor["path"], anchor["line"], 0, anchor["function"],
+            extra={"cycle": cycle,
+                   "edges": [dict(s, src=a, dst=b)
+                             for (a, b), s in zip(pairs, samples)]}))
+    return out
+
+
+def check_tpu014(lp: _LockPass) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in lp.p.iter_functions():
+        for token, node, in_loop, _held in lp.info[id(fn)].waits:
+            if in_loop:
+                continue
+            out.append(Finding(
+                "TPU014",
+                f"`Condition.wait()` outside a `while` predicate loop — "
+                f"spurious wakeups and multi-waiter notify races deliver "
+                f"the wakeup without the condition holding (lost-wakeup); "
+                f"re-check the predicate in a `while` around the wait",
+                fn.module.path, node.lineno, node.col_offset, fn.full_name))
+    return out
+
+
+def check_tpu015(lp: _LockPass) -> List[Finding]:
+    out: List[Finding] = []
+    hot = lp.graph.hot
+    for fn in lp.p.iter_functions():
+        info = lp.info[id(fn)]
+        eh = lp.entry_held[id(fn)]
+        reported: Set[int] = set()
+        for node, reason, held in info.blocks:
+            hot_held = (held | eh) & hot
+            if not hot_held or id(node) in reported:
+                continue
+            reported.add(id(node))
+            tok = sorted(hot_held)[0]
+            ctx = ",".join(sorted(lp.graph.contexts.get(tok, ())))
+            out.append(Finding(
+                "TPU015",
+                f"blocking call {reason} while holding hot lock `{tok}` "
+                f"(acquired from contexts: {ctx}) — every thread "
+                f"contending for the lock stalls behind it; move the "
+                f"blocking work outside the lock or bound it with a "
+                f"timeout",
+                fn.module.path, node.lineno, node.col_offset, fn.full_name))
+        for call, target in lp.callees.get(id(fn), []):
+            if id(call) in reported:
+                continue
+            hot_held = (info.held_at_call.get(id(call), frozenset()) | eh) \
+                & hot
+            if not hot_held:
+                continue
+            blk = lp.block_closure.get(id(target))
+            if blk is None:
+                continue
+            reason, path, line = blk
+            reported.add(id(call))
+            tok = sorted(hot_held)[0]
+            ctx = ",".join(sorted(lp.graph.contexts.get(tok, ())))
+            out.append(Finding(
+                "TPU015",
+                f"call to `{target.qualname}` may block ({reason}, "
+                f"{path}:{line}) while holding hot lock `{tok}` "
+                f"(contexts: {ctx}) — move the blocking work outside "
+                f"the lock or bound it with a timeout",
+                fn.module.path, call.lineno, call.col_offset, fn.full_name))
+    return out
+
+
+def check_tpu016(lp: _LockPass) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in lp.p.iter_functions():
+        root = lp.signal_scope.get(id(fn))
+        if root is None:
+            continue
+        for token, node, blocking, _held in lp.info[id(fn)].acqs:
+            if not blocking:
+                continue        # try-lock: the sanctioned idiom
+            out.append(Finding(
+                "TPU016",
+                f"blocking acquisition of `{token}` in signal-handler "
+                f"context (reachable from `{root}`) — the interrupted "
+                f"thread may already hold this lock, deadlocking the "
+                f"process inside the handler; use "
+                f"`acquire(timeout=...)` and bail out on failure",
+                fn.module.path, node.lineno, node.col_offset, fn.full_name))
+    return out
+
+
+def check_lock_rules(project: Project,
+                     active: Set[str]) -> List[Finding]:
+    """Project-wide driver for TPU013–TPU016 (one shared pass)."""
+    if not active & set(LOCK_RULES):
+        return []
+    lp = _lock_pass(project)
+    out: List[Finding] = []
+    if "TPU013" in active:
+        out.extend(check_tpu013(lp))
+    if "TPU014" in active:
+        out.extend(check_tpu014(lp))
+    if "TPU015" in active:
+        out.extend(check_tpu015(lp))
+    if "TPU016" in active:
+        out.extend(check_tpu016(lp))
+    return out

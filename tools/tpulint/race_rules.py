@@ -85,6 +85,7 @@ class _SiteCollector:
         self._walk(fn.node.body, frozenset())
 
     def _walk(self, body: List[ast.stmt], held: frozenset):
+        cur = set(held)
         for stmt in body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
@@ -92,16 +93,40 @@ class _SiteCollector:
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 tokens = {t for t in (_lock_token(i.context_expr)
                                       for i in stmt.items) if t}
-                self._exprs(stmt, held)
-                self._walk(stmt.body, held | tokens)
+                self._exprs(stmt, frozenset(cur))
+                self._walk(stmt.body, frozenset(cur | tokens))
                 continue
-            self._exprs(stmt, held)
+            self._exprs(stmt, frozenset(cur))
+            # linear `.acquire()` / `.release()` tracking — the
+            # explicit-region idiom (`if not lock.acquire(timeout=..):
+            # return` ... `try: ... finally: lock.release()`) holds the
+            # lock between the two calls just like a `with` block
+            self._acquires(stmt, cur)
             for attr in ("body", "orelse", "finalbody"):
                 sub = getattr(stmt, attr, None)
                 if sub:
-                    self._walk(sub, held)
+                    self._walk(sub, frozenset(cur))
             for h in getattr(stmt, "handlers", []) or []:
-                self._walk(h.body, held)
+                self._walk(h.body, frozenset(cur))
+
+    def _acquires(self, stmt: ast.stmt, cur: set):
+        def rec(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.stmt, ast.excepthandler,
+                                      ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Call) \
+                        and isinstance(child.func, ast.Attribute):
+                    token = _lock_token(child.func.value)
+                    if token is not None:
+                        if child.func.attr == "acquire":
+                            cur.add(token)
+                        elif child.func.attr == "release":
+                            cur.discard(token)
+                rec(child)
+
+        rec(stmt)
 
     def _exprs(self, stmt: ast.stmt, held: frozenset):
         def rec(node):

@@ -588,12 +588,13 @@ def check_tpu006(project: Project, fn: FunctionInfo) -> List[Finding]:
 # ---------------------------------------------------------------------------
 
 ALL_RULES = ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
-             "TPU007", "TPU008", "TPU009", "TPU010", "TPU011", "TPU012")
+             "TPU007", "TPU008", "TPU009", "TPU010", "TPU011", "TPU012",
+             "TPU013", "TPU014", "TPU015", "TPU016")
 
 
 def run_rules(project: Project, select: Optional[Set[str]] = None) -> List[Finding]:
     # deferred: mesh_rules/race_rules import taint helpers from here
-    from . import cache_rules, mesh_rules, race_rules
+    from . import cache_rules, lock_rules, mesh_rules, race_rules
 
     findings: List[Finding] = []
     active = set(select) if select else set(ALL_RULES)
@@ -629,5 +630,8 @@ def run_rules(project: Project, select: Optional[Set[str]] = None) -> List[Findi
             if "TPU012" in active:
                 findings.extend(
                     race_rules.check_tpu012_class(project, mod, cls))
+    # project-wide concurrency pass (TPU013-TPU016): one shared
+    # lock-graph build, not per-function/per-module dispatch
+    findings.extend(lock_rules.check_lock_rules(project, active))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
