@@ -9,6 +9,7 @@ reports the latency/goodput envelope:
         [--max-batch B] [--max-queue Q] [--prompt-len P] [--new-tokens T]
         [--slow-step-ms MS] [--cancel-frac F] [--kv-dtype model|int8]
         [--sweep-prompt-lens P1,P2,...] [--seed S] [--out FILE]
+        [--profile] [--profile-out TRACE.json]
 
 Open loop: arrival gaps are pre-sampled exponentials and submit() never
 blocks on the engine — requests the bounded queue cannot hold are shed,
@@ -31,6 +32,13 @@ capacity win.  ``--sweep-prompt-lens 24,96,192`` appends compact
 secondary rows under ``detail.prompt_sweep`` — the longer-prompt
 regime where dense-gather attention traffic grows with ``max_seq_len``
 while the paged kernel's page walk stays length-bounded.
+
+``--profile`` (ISSUE 17) enables telemetry for the measured run and
+carries the stall-attribution table + recent hiccup records under
+``detail.profile``, so a BENCH row explains WHERE the step time went
+alongside how much goodput it bought; ``--profile-out FILE`` also
+writes the merged chrome-trace JSON (request/scheduler/program lanes)
+for chrome://tracing / Perfetto.
 """
 import argparse
 import json
@@ -89,7 +97,16 @@ def main():
                          "row under detail.prompt_sweep")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", help="also write the JSON row here")
+    ap.add_argument("--profile", action="store_true",
+                    help="enable telemetry for the measured run and "
+                         "carry the stall-attribution table + recent "
+                         "hiccups under detail.profile")
+    ap.add_argument("--profile-out",
+                    help="with --profile: write the merged chrome-trace "
+                         "JSON (request/scheduler/program lanes) here")
     args = ap.parse_args()
+    if args.profile_out and not args.profile:
+        ap.error("--profile-out requires --profile")
 
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu.models.transformer import TransformerLM
@@ -97,6 +114,13 @@ def main():
 
     sweep_lens = [int(s) for s in args.sweep_prompt_lens.split(",")] \
         if args.sweep_prompt_lens else []
+
+    if args.profile:
+        # the stall ledger runs regardless; telemetry must be ON for
+        # its histograms, trace lanes and program timings to record
+        from incubator_mxnet_tpu import telemetry
+
+        telemetry.enable()
 
     mx.random.seed(args.seed)
     max_prompt = max([args.prompt_len] + sweep_lens)
@@ -118,6 +142,9 @@ def main():
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:
             fh.write(line + "\n")
+    if args.profile_out:
+        with open(args.profile_out, "w", encoding="utf-8") as fh:
+            json.dump(run[3]["trace"], fh)
 
 
 def _run_once(args, net, prompt_len):
@@ -158,6 +185,18 @@ def _run_once(args, net, prompt_len):
     stats = eng.stats()
     info = {"kv_bytes_per_token": eng.kv_bytes_per_token,
             "attn_impl": eng.attn_impl}
+    if args.profile:
+        prof = eng.profiler
+        info["profile"] = {
+            "stall_attribution": eng.stall_table(),
+            "hiccups": prof.recent_stalls(8),
+            "hiccups_total": prof.hiccups_total,
+            "invariant_violations": prof.invariant_violations,
+        }
+        if args.profile_out:
+            # capture BEFORE close(): the engine's scheduler lane
+            # unregisters from the merged timeline at close
+            info["trace"] = eng.capture_profile(0)
     eng.close()
     return reqs, stats, wall, info
 
@@ -237,6 +276,8 @@ def _render_row(args, run):
     for d in (row["detail"]["ttft_ms"], row["detail"]["tpot_ms"]):
         for k, v in d.items():
             d[k] = None if v is None else round(v * 1e3, 2)
+    if "profile" in info:
+        row["detail"]["profile"] = info["profile"]
     return row
 
 

@@ -36,6 +36,16 @@ asserts the overload contract:
    match the float-KV engine >= 95%, zero recompiles after warmup
    under its own budget-0 guard (``serving_step_kv8`` /
    ``serving_prefill_kv8``), and every block returns to the pool.
+9. **Stall attribution explains the slow steps** (ISSUE 17) — the
+   fault hook injects one 10x slow decode step every
+   ``HICCUP_EVERY``; ``/profilez`` and ``/stallz`` are hit DURING the
+   overloaded run (valid chrome-trace JSON with request + scheduler +
+   program lanes under the conformance validator the tests use); after
+   drain, every recent step's cause ledger sums to its wall time
+   within 5% (zero invariant violations), at least one injected step
+   was flagged as a hiccup with ``device_step`` dominating its ledger,
+   the witness gauges appeared in the MID-RUN /metrics scrape, and an
+   enabled-vs-disabled A/B pins the profiler's tpot p50 overhead <3%.
 
 Budget: well under 30 s on the CPU smoke host.
 Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/serving_smoke.py
@@ -84,6 +94,9 @@ TTFT_P50_BUDGET_S = 2.0
 N_REQUESTS = 24
 ARRIVAL_RATE_HZ = 60.0        # >> capacity with the slow step below
 SLOW_STEP_S = 0.02
+HICCUP_EVERY = 25             # every Nth decode step is 10x slower --
+HICCUP_STEP_S = 0.2           # guaranteed hiccups for the stall ledger
+PROFILER_OVERHEAD_FRAC = 0.03  # enabled-vs-disabled tpot p50 gate
 MAX_QUEUE = 3
 SEED = 0
 TERMINAL_EVENTS = ("done", "shed", "evicted", "cancelled", "failed")
@@ -144,8 +157,20 @@ def main() -> int:
     assert eng.drain(timeout=30)
 
     # -- loaded run: Poisson arrivals above capacity, zero-compile ----- #
-    eng.set_fault_hook(lambda ph: time.sleep(SLOW_STEP_S)
-                       if ph == "step" else None)
+    # every decode step sleeps SLOW_STEP_S (caps throughput -> forced
+    # overload); every HICCUP_EVERY-th sleeps 10x that, so the stall
+    # ledger must flag hiccups with device_step dominating (ISSUE 17)
+    n_steps_hooked = {"n": 0}
+
+    def loaded_hook(ph):
+        if ph != "step":
+            return
+        n_steps_hooked["n"] += 1
+        time.sleep(HICCUP_STEP_S
+                   if n_steps_hooked["n"] % HICCUP_EVERY == 0
+                   else SLOW_STEP_S)
+
+    eng.set_fault_hook(loaded_hook)
     rng = np.random.RandomState(SEED)
     gaps = rng.exponential(1.0 / ARRIVAL_RATE_HZ, size=N_REQUESTS)
     prompts = [rng.randint(0, 61, size=rng.choice([3, 5, 9]))
@@ -167,11 +192,52 @@ def main() -> int:
         hcode, _, hbody = _fetch(base, "/healthz")
         assert hcode == 200, (hcode, hbody)   # degraded is still 200
         assert json.loads(hbody)["status"] in ("healthy", "degraded")
+        # profiler plane, also DURING the overload: /stallz parses and
+        # shows this engine; /profilez captures 0.3s of loaded serving
+        # into a merged trace the shared validator accepts
+        scode, _, sbody = _fetch(base, "/stallz")
+        assert scode == 200 and eng._name in json.loads(sbody)["engines"]
+        pcode, pctype, pbody = _fetch(base, "/profilez?seconds=0.3")
+        assert pcode == 200 and pctype.startswith("application/json")
         assert eng.drain(timeout=60), "engine failed to drain under load"
         guard.check()     # zero serving-program compiles after warmup
     assert "serving_slo_fraction" in metrics_body, "SLO gauge not scraped"
     assert "serving_slo_burn_rate" in metrics_body
+    # the witness gauges must be scrapeable MID-RUN (the engine rides a
+    # periodic snapshot every 8 decode steps), not only after the
+    # end-of-run assert_clean below
+    assert "lock_witness_edges_total" in metrics_body, \
+        "lock witness gauges absent from the mid-load scrape"
+    assert "lock_contention_seconds" in metrics_body
     _check_prom_conformance(metrics_body)
+
+    # -- stall attribution contract (ISSUE 17) -------------------------- #
+    from incubator_mxnet_tpu.telemetry.profiler import validate_chrome_trace
+    problems = validate_chrome_trace(pbody)
+    assert problems == [], f"/profilez trace fails conformance: {problems[:5]}"
+    lanes = {e.get("cat") for e in json.loads(pbody)["traceEvents"]
+             if e.get("ph") != "M"}
+    for lane in ("request", "scheduler", "program"):
+        assert lane in lanes, f"/profilez missing {lane} lane: {lanes}"
+    prof = eng.profiler
+    assert prof.invariant_violations == 0, \
+        f"{prof.invariant_violations} step ledger(s) broke sum-to-wall"
+    recent = prof.recent_steps()
+    assert recent, "no step ledgers recorded under load"
+    for rec in recent:
+        total = sum(rec["causes"].values())
+        assert abs(total - rec["wall_s"]) <= 0.05 * rec["wall_s"] + 1e-6, \
+            f"step {rec['step']}: causes sum {total} != wall {rec['wall_s']}"
+    assert n_steps_hooked["n"] >= HICCUP_EVERY, \
+        f"run too short to inject a hiccup: {n_steps_hooked['n']} steps"
+    assert prof.hiccups_total >= 1, \
+        f"no hiccup flagged over {prof.steps} steps ({n_steps_hooked})"
+    hics = prof.recent_stalls()
+    assert any(h["dominant"] == "device_step" for h in hics), \
+        f"injected stalls not attributed to device_step: {hics}"
+    for h in hics:
+        assert abs(sum(h["causes"].values()) - h["wall_s"]) \
+            <= 0.05 * h["wall_s"] + 1e-6, h
 
     # -- overload contract --------------------------------------------- #
     stats = eng.stats()
@@ -196,10 +262,16 @@ def main() -> int:
                          ("serving_batch_occupancy", None),
                          ("serving_kv_blocks_in_use", None),
                          ("serving_ttft_seconds", {"path": "float"}),
-                         ("serving_tpot_seconds", {"path": "float"})):
+                         ("serving_tpot_seconds", {"path": "float"}),
+                         ("serving_step_stall_seconds",
+                          {"cause": "device_step"}),
+                         ("serving_step_stall_seconds",
+                          {"cause": "host_other"})):
         assert reg.get(name, labels) is not None, f"metric missing: {name}"
     assert reg.get("serving_shed_total",
                    {"reason": "queue_full"}).value >= 1
+    assert reg.get("serving_step_hiccups_total",
+                   {"engine": eng._name}).value >= 1
 
     # -- request traces: every terminal request is fully explained ----- #
     for r in reqs:
@@ -220,6 +292,31 @@ def main() -> int:
     # the evicted one was admitted first — its timeline proves it ran
     ev_names = [e["name"] for e in by_status["evicted"][0]["events"]]
     assert "admitted" in ev_names and "prefill" in ev_names, ev_names
+
+    # -- profiler overhead A/B: enabled tpot p50 within 3% of disabled - #
+    # constant (hiccup-free) step cost so the two runs are comparable
+    eng.set_fault_hook(lambda ph: time.sleep(SLOW_STEP_S)
+                       if ph == "step" else None)
+
+    def _tpot_p50() -> float:
+        rs = []
+        for _ in range(6):        # closed loop: never overflows the queue
+            r = eng.submit(np.array((3, 7, 11), np.int32), 8)
+            r.result(timeout=60)
+            rs.append(r)
+        assert eng.drain(timeout=30)
+        tps = sorted(r.tpot for r in rs if r.tpot is not None)
+        assert tps, "A/B run produced no tpot samples"
+        return tps[len(tps) // 2]
+
+    prof.set_enabled(False)
+    off_p50 = _tpot_p50()
+    prof.set_enabled(True)
+    on_p50 = _tpot_p50()
+    # 2 ms absolute slack absorbs shared-CI scheduling jitter on a
+    # ~20 ms step; the relative term is the actual contract
+    assert on_p50 < off_p50 * (1 + PROFILER_OVERHEAD_FRAC) + 2e-3, \
+        f"profiler overhead: tpot p50 {on_p50:.4f}s on vs {off_p50:.4f}s off"
 
     # -- int8-KV engine: greedy parity + same overload contract -------- #
     eng.set_fault_hook(None)
@@ -284,6 +381,9 @@ def main() -> int:
           f"(float {eng.kv_bytes_per_token}), {len(q8_done)}/{len(q8_reqs)} "
           f"served kv8, lock witness {wstats['edges']} edge(s) over "
           f"{wstats['tracked_locks']} locks acyclic+static-covered, "
+          f"{prof.hiccups_total} hiccup(s) attributed "
+          f"(tpot p50 {on_p50 * 1e3:.1f} ms on / {off_p50 * 1e3:.1f} ms "
+          f"off profiler), /profilez+/stallz live, "
           f"{dt:.1f}s total on {jax.devices()[0].platform}")
     return 0
 

@@ -50,6 +50,7 @@ import time
 import traceback
 import _thread
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 Site = Tuple[str, int]
@@ -68,6 +69,9 @@ _edges: Dict[Tuple[Site, Site], dict] = {}
 _held: Dict[int, List["_WitnessLock"]] = {}
 _contention_total = 0.0
 _n_tracked = 0
+# individual contention waits (site, t0, dur — perf_counter seconds)
+# for the merged profiler timeline; bounded, guarded by _meta
+_recent: deque = deque(maxlen=1024)
 
 _STACK_DEPTH = 12
 
@@ -131,6 +135,8 @@ def _record(held, dst: "_WitnessLock", dt: float) -> None:
     global _contention_total
     with _meta:
         _contention_total += dt
+        if dt > 1e-4:       # a real wait, not edge-only bookkeeping
+            _recent.append((dst.site, time.perf_counter() - dt, dt))
         for w in held:
             if w.site == dst.site:
                 continue            # reentrancy, not an ordering edge
@@ -196,6 +202,7 @@ def reset() -> None:
     with _meta:
         _edges.clear()
         _held.clear()
+        _recent.clear()
         global _contention_total
         _contention_total = 0.0
 
@@ -219,6 +226,20 @@ def stats() -> dict:
         return {"edges": len(_edges),
                 "tracked_locks": _n_tracked,
                 "contention_seconds": _contention_total}
+
+
+def recent_contention(since: Optional[float] = None) -> List[dict]:
+    """Recent individual contention waits as
+    ``{"site": "file.py:123", "t0": ..., "dur": ...}`` (perf_counter
+    seconds), oldest first — the merged-timeline profiler's lock lane.
+    ``since`` keeps only waits still in flight at/after that instant."""
+    with _meta:
+        evs = list(_recent)
+    out = [{"site": _fmt_site(site), "t0": t0, "dur": dur}
+           for site, t0, dur in evs]
+    if since is not None:
+        out = [e for e in out if e["t0"] + e["dur"] >= since]
+    return out
 
 
 def snapshot() -> None:

@@ -334,10 +334,11 @@ class ServingEngine:
     slo_windows     burn-rate window lengths in seconds (default
                     (60, 600)); slo_objective the good-fraction target
                     (default 0.99, i.e. a 1% error budget).
-    http_port       serve /metrics /healthz /varz /requestz on this
-                    port (0 = ephemeral; read ``engine.http_port``
-                    back).  Default: ``MXTPU_TELEMETRY_PORT`` if set,
-                    else no server.  close() joins the server.
+    http_port       serve /metrics /healthz /varz /requestz /profilez
+                    /stallz on this port (0 = ephemeral; read
+                    ``engine.http_port`` back).  Default:
+                    ``MXTPU_TELEMETRY_PORT`` if set, else no server.
+                    close() joins the server.
     """
 
     def __init__(self, net, *, max_batch: int = 4, block_size: int = 16,
@@ -490,9 +491,18 @@ class ServingEngine:
         if self._http is not None:
             self._http.register_health(self._name, self.health)
             self._http.register_requestz(self._name, self.requestz)
+            self._http.register_varz(self._name, self.varz_config)
         # SIGTERM/crash bundles carry the in-flight table + trace ring
         telemetry.flight_recorder.register_section(
             self._name, self._flight_section)
+        # per-step stall-attribution ledger (ISSUE 17): always
+        # constructed and fed by the scheduler loop — disabling
+        # (MXTPU_SERVING_PROFILER=0 / set_enabled(False)) leaves one
+        # flag read per note.  Registered process-wide so /profilez and
+        # /stallz see every engine's lane.
+        self._prof = telemetry.profiler.register(
+            telemetry.profiler.EngineProfiler(self._name))
+        telemetry.profiler.install_gc_hooks()
 
         self._thread = threading.Thread(
             target=self._scheduler, daemon=True,
@@ -675,7 +685,67 @@ class ServingEngine:
             self._lock.release()
         return {"engine": self._name, "in_flight": rows, "stats": stats,
                 "slo": self._slo.snapshot(now),
+                "stalls": self._prof.recent_stalls(8),
                 "recent_traces": telemetry.requestlog.recent(32)}
+
+    @property
+    def profiler(self) -> "telemetry.profiler.EngineProfiler":
+        """The engine's per-step stall-attribution ledger."""
+        return self._prof
+
+    def capture_profile(self, seconds: float = 1.0) -> dict:
+        """On-demand merged timeline capture (the `/profilez` payload):
+        let ``seconds`` of serving activity accumulate, then return one
+        chrome-trace dict with request, scheduler, program, GC and
+        lock-contention lanes (0 = everything still buffered)."""
+        return telemetry.profiler.capture(seconds)
+
+    def stall_table(self) -> list:
+        """Aggregate stall attribution rows (cause / total_s / share /
+        per_step_ms), biggest cause first."""
+        return self._prof.stall_table()
+
+    def stallz(self) -> dict:
+        """This engine's `/stallz` payload: cause table + worst recent
+        hiccups with their per-cause ledgers."""
+        return self._prof.stallz()
+
+    def varz_config(self) -> dict:
+        """Build/config section for `/varz` — which engine
+        configuration is actually running (ops triage can't tell from
+        metrics alone).  Values are frozen at construction except the
+        profiler toggle and MXTPU_* env knobs, read live."""
+        ladder, b = [], self._bs
+        while b < self._msl:
+            ladder.append(b)
+            b *= 2
+        ladder.append(self._msl)
+        return {
+            "engine": self._name,
+            "path": self._path,
+            "prog_label": self._label,
+            "kv_dtype": self._kv_dtype or "model",
+            "attn_impl": self._programs.attn_impl,
+            "max_batch": self._B,
+            "block_size": self._bs,
+            "max_seq_len": self._msl,
+            "num_blocks": self._num_blocks,
+            "max_queue": self._max_queue,
+            "bucket_ladder": ladder,
+            "kv_pool_bytes": self._kv_pool_bytes,
+            "eos_id": self._eos,
+            "poll_interval_s": self._poll,
+            "ttft_budget_s": self._ttft_budget,
+            "default_deadline_s": self._default_deadline,
+            "slo": {"ttft_target_s": self._slo.ttft_target,
+                    "tpot_target_s": self._slo.tpot_target,
+                    "objective": self._slo.objective,
+                    "windows_s": list(self._slo.windows)},
+            "profiler": {"enabled": self._prof.enabled,
+                         "hiccup_k": self._prof.hiccup_k},
+            "env": {k: v for k, v in sorted(os.environ.items())
+                    if k.startswith("MXTPU_")},
+        }
 
     def set_fault_hook(self, hook) -> None:
         with self._lock:
@@ -771,6 +841,7 @@ class ServingEngine:
                     RequestCancelled("serving engine closed"))
                 self._work.notify_all()
             telemetry.flight_recorder.unregister_section(self._name)
+            telemetry.profiler.unregister(self._name)
             if self._http is not None:
                 self._http.unregister(self._name)
                 self._http.close(timeout)
@@ -908,8 +979,15 @@ class ServingEngine:
                 self._work.notify_all()
 
     def _loop(self) -> None:
+        # every phase of the iteration feeds the stall ledger: lock
+        # acquisition, reap+admission bookkeeping, idle polls — so the
+        # per-step causes sum to the step's wall time (profiler.py)
+        prof = self._prof
         while True:
+            t_lk = time.perf_counter()
             with self._work:
+                t_bk = time.perf_counter()
+                prof.note("lock_wait", t_bk - t_lk)
                 if self._stop.is_set():
                     return
                 now = time.monotonic()
@@ -920,13 +998,19 @@ class ServingEngine:
                     live = [(i, s.req) for i, s in enumerate(self._slots)
                             if s is not None and self._active[i]]
                     if not live:
+                        prof.note("bookkeeping",
+                                  time.perf_counter() - t_bk)
                         if not self._queue:
+                            t_w = time.perf_counter()
                             self._work.wait(self._poll)
+                            prof.note("wait",
+                                      time.perf_counter() - t_w)
                         continue
                     snap = (self._tables.copy(), self._toks.copy(),
                             self._pos.copy(), self._active.copy(),
                             self._keys.copy())
                     hook = self._fault_hook
+                prof.note("bookkeeping", time.perf_counter() - t_bk)
             if adm is not None:
                 # prefill OUTSIDE the lock — then loop back to admit
                 # the next queued request (or start decoding)
@@ -1024,46 +1108,63 @@ class ServingEngine:
         never stall behind prefill compute (fault-hook injected sleeps
         included).  Re-locks to commit the first token, with a slot
         identity check in case the request was evicted meanwhile."""
+        prof = self._prof
         req = adm.req
-        if adm.hook is not None:
-            adm.hook("prefill")
+        # program-cache lookup + weight gather/requantize, timed apart
+        # from the device call so a cold bucket compile or a requantize
+        # after a weight swap shows up as its own stall cause
+        t_g = time.perf_counter()
         fn = self._programs.prefill(adm.bucket)
+        params = self._live_params()
+        t_h = time.perf_counter()
+        prof.note("gather_params", t_h - t_g)
+        if adm.hook is not None:
+            adm.hook("prefill")             # fault seam: counts as prefill
         t0 = time.perf_counter()
         (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
          first) = G._timed_decode(
             f"serving_prefill_{self._label}", f"serving_{self._label}", 1,
             fn, self._pool_k, self._pool_v, self._scale_k, self._scale_v,
             adm.row[:adm.nbp], adm.padded, np.int32(adm.prompt_len),
-            adm.key, self._live_params())
+            adm.key, params)
         tok = int(np.asarray(first)[0])
         dt = time.perf_counter() - t0
+        prof.note("prefill", time.perf_counter() - t_h)
         now = time.monotonic()
+        t_lk = time.perf_counter()
         with self._work:
-            self._prefill_ewma = dt if self._prefill_ewma is None \
-                else 0.8 * self._prefill_ewma + 0.2 * dt
-            slot = self._slots[adm.lane]
-            if slot is None or slot.req is not req:
-                return                      # evicted while prefilling
-            req.status = "running"
-            req.trace.event("prefill", t=now, dur_s=round(dt, 6),
-                            token=tok)
-            req._deliver(tok, now)
-            self._stats["admitted"] += 1
-            if telemetry.enabled():
-                telemetry.counter("serving_admitted_total").inc()
-                telemetry.histogram(
-                    "serving_ttft_seconds",
-                    labels={"path": self._path}).observe(now - req.t_submit)
-                telemetry.gauge("serving_kv_blocks_in_use") \
-                    .set(self._pool.num_allocated)
-            if tok == self._eos or len(req.tokens) >= req.max_new_tokens:
-                self._retire_locked(adm.lane)
-                return
-            self._tables[adm.lane, :] = adm.row
-            self._toks[adm.lane] = tok
-            self._pos[adm.lane] = adm.prompt_len
-            self._active[adm.lane] = True
-            self._keys[adm.lane, :] = adm.key
+            t_bk = time.perf_counter()
+            prof.note("lock_wait", t_bk - t_lk)
+            try:
+                self._prefill_ewma = dt if self._prefill_ewma is None \
+                    else 0.8 * self._prefill_ewma + 0.2 * dt
+                slot = self._slots[adm.lane]
+                if slot is None or slot.req is not req:
+                    return                  # evicted while prefilling
+                req.status = "running"
+                req.trace.event("prefill", t=now, dur_s=round(dt, 6),
+                                token=tok)
+                req._deliver(tok, now)
+                self._stats["admitted"] += 1
+                if telemetry.enabled():
+                    telemetry.counter("serving_admitted_total").inc()
+                    telemetry.histogram(
+                        "serving_ttft_seconds",
+                        labels={"path": self._path}) \
+                        .observe(now - req.t_submit)
+                    telemetry.gauge("serving_kv_blocks_in_use") \
+                        .set(self._pool.num_allocated)
+                if tok == self._eos \
+                        or len(req.tokens) >= req.max_new_tokens:
+                    self._retire_locked(adm.lane)
+                    return
+                self._tables[adm.lane, :] = adm.row
+                self._toks[adm.lane] = tok
+                self._pos[adm.lane] = adm.prompt_len
+                self._active[adm.lane] = True
+                self._keys[adm.lane, :] = adm.key
+            finally:
+                prof.note("bookkeeping", time.perf_counter() - t_bk)
 
     def _retire_locked(self, lane: int) -> None:
         req = self._slots[lane].req
@@ -1078,8 +1179,13 @@ class ServingEngine:
         """One batched decode step — device call OUTSIDE the lock, so
         submit()/cancel() never block on compute (a fault hook's
         injected sleep included)."""
+        prof = self._prof
+        t_g = time.perf_counter()
+        params = self._live_params()
+        t_h = time.perf_counter()
+        prof.note("gather_params", t_h - t_g)
         if hook is not None:
-            hook("step")
+            hook("step")                    # fault seam: counts as device
         tables, toks, pos, active, keys = snap
         t0 = time.perf_counter()
         (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
@@ -1087,14 +1193,21 @@ class ServingEngine:
             f"serving_step_{self._label}", f"serving_{self._label}",
             len(live), self._programs.step, self._pool_k, self._pool_v,
             self._scale_k, self._scale_v, tables, toks, pos, active, keys,
-            self._live_params())
+            params)
         nxt = np.asarray(nxt)               # sync: tokens are consumed now
         dt = time.perf_counter() - t0
+        # the ledger's device_step cause includes the fault hook (an
+        # injected stall IS device time to the requests waiting on it);
+        # the tpot histogram keeps the pure device call, as before
+        prof.note("device_step", time.perf_counter() - t_h)
         now = time.monotonic()
+        t_lk = time.perf_counter()
         with self._work:
+            t_bk = time.perf_counter()
+            prof.note("lock_wait", t_bk - t_lk)
             self._stats["steps"] += 1
-            mark = _TRACE_EVERY > 0 \
-                and self._stats["steps"] % _TRACE_EVERY == 0
+            step_no = self._stats["steps"]
+            mark = _TRACE_EVERY > 0 and step_no % _TRACE_EVERY == 0
             for lane, req in live:
                 slot = self._slots[lane]
                 if slot is None or slot.req is not req:
@@ -1117,6 +1230,17 @@ class ServingEngine:
                     .observe(dt)
                 telemetry.gauge("serving_batch_occupancy") \
                     .set(len(live))
+            queue_depth = len(self._queue)
+            prof.note("bookkeeping", time.perf_counter() - t_bk)
+        # close the ledger OUTSIDE the engine lock (it takes its own
+        # leaf lock + histogram locks; never nested under self._work)
+        prof.end_step(rids=[req.rid for _, req in live],
+                      occupancy=len(live), queue_depth=queue_depth,
+                      step=step_no)
+        if telemetry.enabled() and step_no % 8 == 0:
+            # keep lock_witness_edges_total / lock_contention_seconds
+            # scrapeable mid-run, not only after an end-of-run snapshot
+            telemetry.profiler.snapshot_lock_witness()
 
 
 def default_engine(net, **kw) -> ServingEngine:

@@ -26,10 +26,15 @@ env:
   flight recorder (telemetry.flight_recorder); bundles land in `path`
 * ``MXTPU_FLIGHT_STEPS=N``       flight-recorder ring size (default 16)
 * ``MXTPU_TELEMETRY_PORT=N``     serve /metrics /healthz /varz /requestz
-  over HTTP (telemetry.http; the serving engine starts/joins it —
-  0 = ephemeral port)
+  /profilez /stallz over HTTP (telemetry.http; the serving engine
+  starts/joins it — 0 = ephemeral port)
 * ``MXTPU_REQUESTLOG_RING=N``    recent-request trace ring size
   (telemetry.requestlog, default 256)
+* ``MXTPU_SERVING_PROFILER=0``   disable the serving stall ledger
+  (telemetry.profiler; on by default — one flag read per phase note)
+* ``MXTPU_PROFILER_HICCUP_K=K``  hiccup threshold multiplier over the
+  rolling step-wall p50 (default 3.0)
+* ``MXTPU_STALLZ_RING=N``        /stallz hiccup ring size (default 64)
 
 The ISSUE 8 performance layer lives in two submodules: ``perf``
 (roofline/MFU program attribution + device-memory watermarks) and
@@ -57,7 +62,7 @@ __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "get_registry", "Counter", "Gauge", "Histogram", "Registry",
            "SpanRecord", "DEFAULT_BUCKETS", "log_buckets", "nbytes_of",
            "record_collective_overlap", "exporters", "tracer", "perf",
-           "flight_recorder", "requestlog", "slo", "http"]
+           "flight_recorder", "requestlog", "slo", "http", "profiler"]
 
 _default_registry = Registry()
 _dump_interval = 0
@@ -71,6 +76,9 @@ from . import flight_recorder, perf  # noqa: E402
 # the live HTTP endpoint (also after the registry, same reasoning —
 # `http` here is the package submodule, not the stdlib package)
 from . import http, requestlog, slo  # noqa: E402
+# the ISSUE 17 timeline profiler + stall-attribution ledger (last: its
+# merged capture reads requestlog/tracer/perf, resolved lazily)
+from . import profiler  # noqa: E402
 
 
 def get_registry() -> Registry:

@@ -1,4 +1,5 @@
-"""Live ops HTTP endpoint: /metrics, /healthz, /varz, /requestz.
+"""Live ops HTTP endpoint: /metrics /healthz /varz /requestz
+/profilez /stallz.
 
 The write-only telemetry gap (ISSUE 13): counters and traces used to
 reach disk only via ``telemetry.dump()`` at exit.  `TelemetryServer`
@@ -14,10 +15,20 @@ the live registry while the process runs:
   on the status code; the degraded state is a body-level warning, not
   an eviction);
 * ``/varz``     — JSON snapshot of every metric (name, labels, value /
-  histogram summary);
+  histogram summary) under ``"metrics"``, plus a ``"config"`` section
+  of registered build/config providers (the serving engine publishes
+  kv_dtype, attn_impl, batch/bucket geometry, SLO targets and the
+  MXTPU_* env knobs — so ops triage can tell WHICH configuration is
+  running, not just how it is doing);
 * ``/requestz`` — recent completed request traces (the
   `telemetry.requestlog` ring) plus each registered provider's
-  in-flight table.
+  in-flight table;
+* ``/profilez`` — on-demand merged chrome-trace capture
+  (``?seconds=N``, default 1, bounded; see `telemetry.profiler`) —
+  request, scheduler, program, GC and lock lanes in one JSON a
+  Perfetto / chrome://tracing load renders directly;
+* ``/stallz``   — per-engine stall attribution: aggregate cause table
+  + the worst recent hiccup records with their per-cause ledgers.
 
 Providers are ``name -> callable`` registries (the serving engine
 registers itself; anything else can too).  Provider callbacks run on
@@ -38,6 +49,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs
 
 from . import exporters, requestlog
 from .registry import Histogram, Registry
@@ -94,7 +106,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         owner: "TelemetryServer" = self.server._owner
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        raw_path, _, query = self.path.partition("?")
+        path = raw_path.rstrip("/") or "/"
         try:
             if path == "/metrics":
                 body = exporters.prometheus_text(owner.registry)
@@ -105,12 +118,31 @@ class _Handler(BaseHTTPRequestHandler):
                 code = 503 if health["status"] == "unhealthy" else 200
                 self._send_json(code, health)
             elif path == "/varz":
-                self._send_json(200, _varz(owner.registry))
+                self._send_json(200, owner.varz())
             elif path == "/requestz":
                 self._send_json(200, owner.requestz())
+            elif path == "/profilez":
+                from . import profiler
+
+                try:
+                    seconds = float(
+                        parse_qs(query).get("seconds", ["1"])[0])
+                except ValueError:
+                    self._send_json(400, {"error": "bad seconds= value"})
+                    return
+                # traces are big — no indent (the capture itself sleeps
+                # on this handler thread; bounded by MAX_CAPTURE_S)
+                body = json.dumps(profiler.capture(seconds),
+                                  default=str).encode("utf-8")
+                self._send(200, body, "application/json")
+            elif path == "/stallz":
+                from . import profiler
+
+                self._send_json(200, profiler.stallz())
             elif path == "/":
                 self._send_json(200, {"endpoints": [
-                    "/metrics", "/healthz", "/varz", "/requestz"]})
+                    "/metrics", "/healthz", "/varz", "/requestz",
+                    "/profilez", "/stallz"]})
             else:
                 self._send_json(404, {"error": f"no endpoint {path!r}"})
         except Exception as e:  # a broken provider must not kill serving
@@ -133,6 +165,7 @@ class TelemetryServer:
         self._providers_lock = threading.Lock()
         self._health_providers: Dict[str, Callable[[], dict]] = {}
         self._requestz_providers: Dict[str, Callable[[], dict]] = {}
+        self._varz_providers: Dict[str, Callable[[], dict]] = {}
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd._owner = self
@@ -156,10 +189,18 @@ class TelemetryServer:
         with self._providers_lock:
             self._requestz_providers[name] = fn
 
+    def register_varz(self, name: str,
+                      fn: Callable[[], dict]) -> None:
+        """``fn() -> {...}`` build/config facts for `/varz`'s
+        ``config`` section (frozen engine geometry, env knobs)."""
+        with self._providers_lock:
+            self._varz_providers[name] = fn
+
     def unregister(self, name: str) -> None:
         with self._providers_lock:
             self._health_providers.pop(name, None)
             self._requestz_providers.pop(name, None)
+            self._varz_providers.pop(name, None)
 
     # -- endpoint payloads (also callable in-process, for tests) ------- #
     def health(self) -> dict:
@@ -175,6 +216,20 @@ class TelemetryServer:
         status = _worst(c.get("status", "unhealthy")
                         for c in checks.values())
         return {"status": status, "checks": checks}
+
+    def varz(self) -> dict:
+        """The `/varz` payload: the metric snapshot under ``metrics``
+        plus each registered provider's build/config facts under
+        ``config`` (a raising provider reports its error string)."""
+        with self._providers_lock:
+            providers = dict(self._varz_providers)
+        config = {}
+        for name, fn in sorted(providers.items()):
+            try:
+                config[name] = fn()
+            except Exception as e:
+                config[name] = {"error": f"{type(e).__name__}: {e}"}
+        return {"metrics": _varz(self.registry), "config": config}
 
     def requestz(self) -> dict:
         with self._providers_lock:

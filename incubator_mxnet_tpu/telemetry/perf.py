@@ -46,12 +46,13 @@ from __future__ import annotations
 import math
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from . import registry as _registry_mod
 
 __all__ = ["ProgramCost", "capture", "capture_compiled", "note_timing",
-           "programs", "roofline_table", "clear",
+           "recent_timings", "programs", "roofline_table", "clear",
            "set_hlo_text_capture", "hlo_text_capture_enabled",
            "program_hlo", "hlo_texts",
            "sample_device_memory", "per_device_bytes", "reset_peaks",
@@ -132,6 +133,9 @@ class ProgramCost:
 _programs: Dict[str, ProgramCost] = {}
 _lock = threading.Lock()
 _peaks_cache: Dict[str, float] = {}
+# per-execution timing events for the merged profiler timeline
+# (lock-free: deque appends are atomic; readers copy)
+_timings: deque = deque(maxlen=4096)
 
 # ---- program text capture (the hlolint contract-gate feed) ----------- #
 # Off by default: program texts run to hundreds of KB and only the
@@ -307,10 +311,16 @@ def note_timing(program: Optional[str], seconds: float) -> None:
       (1.0 = running at the roofline for whichever resource binds).
 
     No-op when disabled, when `program` was never captured, or when the
-    clock reads non-positive.
+    clock reads non-positive (the timing still lands in the bounded
+    `recent_timings` ring for the merged profiler timeline even when
+    the program has no cost capture).
     """
     if not _registry_mod._enabled or program is None:
         return
+    if seconds and seconds > 0:
+        t_end = time.perf_counter()
+        _timings.append({"program": program, "t0": t_end - seconds,
+                         "dur": seconds})
     with _lock:
         pc = _programs.get(program)
     if pc is None or not seconds or seconds <= 0:
@@ -328,6 +338,19 @@ def note_timing(program: Optional[str], seconds: float) -> None:
     _gauge("program_mfu", lab).set(mfu)
     _gauge("program_hbm_gbps", lab).set(gbps)
     _gauge("program_roofline_fraction", lab).set(frac)
+
+
+def recent_timings(since: Optional[float] = None) -> List[dict]:
+    """Recent per-execution program timings
+    (``{"program", "t0", "dur"}``, perf_counter seconds, oldest first)
+    — the merged profiler timeline's program lane.  ``since`` keeps
+    only executions still in flight at/after that instant."""
+    from .profiler import _snap_deque
+
+    out = [dict(e) for e in _snap_deque(_timings)]
+    if since is not None:
+        out = [e for e in out if e["t0"] + e["dur"] >= since]
+    return out
 
 
 def programs() -> Dict[str, ProgramCost]:
@@ -349,6 +372,7 @@ def clear() -> None:
     with _lock:
         _programs.clear()
         _hlo_texts.clear()
+    _timings.clear()
     _peaks_cache.clear()
     with _mem_lock:
         _peak_bytes.clear()

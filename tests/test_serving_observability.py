@@ -226,10 +226,14 @@ def test_http_server_endpoints_and_close(telemetry_on):
         assert ei.value.code == 503
         code, body = _get(base, "/requestz")
         assert code == 200 and "eng" in json.loads(body)["engines"]
+        srv.register_varz("eng", lambda: {"max_batch": 4})
         code, body = _get(base, "/varz")
-        assert json.loads(body)["hits"]["value"] == 3
+        varz = json.loads(body)
+        assert varz["metrics"]["hits"]["value"] == 3
+        assert varz["config"]["eng"]["max_batch"] == 4
         code, body = _get(base, "/")
         assert "/metrics" in json.loads(body)["endpoints"]
+        assert "/stallz" in json.loads(body)["endpoints"]
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(base, "/nope")
         assert ei.value.code == 404
