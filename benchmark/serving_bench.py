@@ -8,6 +8,7 @@ reports the latency/goodput envelope:
     python benchmark/serving_bench.py [--rate HZ] [--requests N]
         [--max-batch B] [--max-queue Q] [--prompt-len P] [--new-tokens T]
         [--slow-step-ms MS] [--cancel-frac F] [--kv-dtype model|int8]
+        [--speculate K] [--draft int8|tiny]
         [--sweep-prompt-lens P1,P2,...] [--seed S] [--out FILE]
         [--profile] [--profile-out TRACE.json]
 
@@ -32,6 +33,17 @@ capacity win.  ``--sweep-prompt-lens 24,96,192`` appends compact
 secondary rows under ``detail.prompt_sweep`` — the longer-prompt
 regime where dense-gather attention traffic grows with ``max_seq_len``
 while the paged kernel's page walk stays length-bounded.
+
+``--speculate K`` (ISSUE 19) turns on draft/verify speculative
+decoding: a cheap draft proposes K tokens per lane per scheduler
+iteration and the target verifies all of them in ONE batched forward —
+one target weight stream amortized over up to K+1 tokens per lane.
+``--draft int8`` (default) self-drafts with the target's own
+int8-quantized twin (high acceptance, no second model);
+``--draft tiny`` uses a fresh small TransformerLM (cheaper draft,
+lower acceptance).  Greedy output is bit-identical to the
+non-speculative engine either way; ``detail.speculate`` reports the
+measured acceptance rate and tokens-per-lane-step.
 
 ``--profile`` (ISSUE 17) enables telemetry for the measured run and
 carries the stall-attribution table + recent hiccup records under
@@ -91,6 +103,14 @@ def main():
                     help="KV pool dtype: 'int8' quantizes pages at "
                          "write time (fp32 per-vector scales ride "
                          "alongside, dequant happens in the attention)")
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="speculative decoding: draft K tokens per lane "
+                         "per step, verify them in one batched target "
+                         "forward (0 = off)")
+    ap.add_argument("--draft", choices=("int8", "tiny"), default="int8",
+                    help="draft model for --speculate: 'int8' "
+                         "self-drafts with the target's quantized twin, "
+                         "'tiny' uses a fresh small TransformerLM")
     ap.add_argument("--sweep-prompt-lens",
                     help="comma-separated extra prompt lengths; each "
                          "runs the same open loop and lands a compact "
@@ -132,6 +152,26 @@ def main():
     net(NDArray(jnp.ones((1, 4), jnp.int32)))
     net.cast("bfloat16")
 
+    args.spec_kw = {}
+    if args.speculate > 0:
+        args.spec_kw["speculate_k"] = args.speculate
+        if args.draft == "int8":
+            # the target's int8 twin IS the draft; the serving target
+            # itself stays bf16 (quantized=False)
+            net.quantize_for_decode(act_quant="none")
+            args.spec_kw["quantized"] = False
+        else:
+            mx.random.seed(args.seed + 1)
+            draft = TransformerLM(vocab=V, units=C // 2,
+                                  hidden_size=DFF // 2, num_layers=1,
+                                  num_heads=H // 2,
+                                  max_len=max_prompt + args.new_tokens + 40,
+                                  dropout=0.0)
+            draft.initialize()
+            draft(NDArray(jnp.ones((1, 4), jnp.int32)))
+            draft.cast("bfloat16")
+            args.spec_kw["draft_net"] = draft
+
     run = _run_once(args, net, args.prompt_len)
     row = _render_row(args, run)
     if sweep_lens:
@@ -156,7 +196,8 @@ def _run_once(args, net, prompt_len):
                         max_seq_len=msl, max_queue=args.max_queue,
                         kv_dtype="int8" if args.kv_dtype == "int8" else None,
                         slo_ttft=args.ttft_slo_ms / 1e3,
-                        slo_tpot=args.tpot_slo_ms / 1e3)
+                        slo_tpot=args.tpot_slo_ms / 1e3,
+                        **getattr(args, "spec_kw", {}))
 
     rng = np.random.RandomState(args.seed)
     prompts = [rng.randint(0, V, size=prompt_len).astype(np.int32)
@@ -185,6 +226,20 @@ def _run_once(args, net, prompt_len):
     stats = eng.stats()
     info = {"kv_bytes_per_token": eng.kv_bytes_per_token,
             "attn_impl": eng.attn_impl}
+    if args.speculate > 0:
+        spec = stats["speculate"]
+        info["speculate"] = {
+            "k": spec["k"],
+            "draft": spec["draft"],
+            "accept_rate": round(spec["accept_rate"], 4),
+            # per lane-iteration: 1 committed token + k*accept_rate
+            # accepted drafts (the amortization factor over one target
+            # weight stream)
+            "tokens_per_lane_step": round(
+                1.0 + spec["k"] * spec["accept_rate"], 2),
+            "proposed": spec["proposed"],
+            "accepted": spec["accepted"],
+        }
     if args.profile:
         prof = eng.profiler
         info["profile"] = {
@@ -276,6 +331,8 @@ def _render_row(args, run):
     for d in (row["detail"]["ttft_ms"], row["detail"]["tpot_ms"]):
         for k, v in d.items():
             d[k] = None if v is None else round(v * 1e3, 2)
+    if "speculate" in info:
+        row["detail"]["speculate"] = info["speculate"]
     if "profile" in info:
         row["detail"]["profile"] = info["profile"]
     return row

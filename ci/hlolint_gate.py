@@ -24,6 +24,12 @@ evaluates the committed `.hlolint_contracts.json`:
   family: the decode step must NOT materialize the fp32
   ``(B, H, max_seq_len)`` attention-probs buffer the dense-gather
   path streams (that buffer is the whole point of the kernel)
+* ``serving_draft_step_float`` / ``serving_spec_verify_float`` /
+  ``serving_draft_prefill_float`` — the speculative-decoding family
+  (``speculate_k > 0``): draft k-token proposer, batched target
+  verifier, and the draft-pool prefill.  Donation must hold on BOTH
+  pool sets and everything stays on-device / collective-free /
+  f64-free — speculation is a throughput lever, not a numerics change
 
 Contract context (``ctx``) carries the run's ground truth: the mesh
 size ``D``, the bucket count ``n_buckets``, the global gradient bytes
@@ -172,6 +178,15 @@ def _serving_programs():
         eng.submit(prompt, N).result(timeout=60)   # serving_*_float_kv8
     with ServingEngine(net, attn_impl="pallas", **kws) as eng:
         eng.submit(prompt, N).result(timeout=60)   # serving_*_float_pallas
+    mx.random.seed(99)
+    draft = TransformerLM(vocab=V, units=8, hidden_size=16, num_layers=1,
+                          num_heads=1, max_len=MAXLEN, dropout=0.0)
+    draft.initialize()
+    draft(NDArray(jnp.ones((1, 4), jnp.int32)))
+    with ServingEngine(net, speculate_k=2, draft_net=draft, **kws) as eng:
+        # serving_draft_prefill_float + serving_draft_step_float
+        # + serving_spec_verify_float
+        eng.submit(prompt, N).result(timeout=60)
     net.quantize_for_decode(act_quant="none")
     with ServingEngine(net, **kws) as eng:
         eng.submit(prompt, N).result(timeout=60)   # serving_*_int8
@@ -179,7 +194,7 @@ def _serving_programs():
 
 
 def collect_facts():
-    """Compile the thirteen programs and return (facts_by_program, ctx)."""
+    """Compile the sixteen programs and return (facts_by_program, ctx)."""
     telemetry.enable()
     telemetry.perf.set_hlo_text_capture(True)
     _, _ = _train_program(zero=False)
@@ -198,6 +213,8 @@ def collect_facts():
             "serving_prefill_float", "serving_step_float",
             "serving_prefill_float_kv8", "serving_step_float_kv8",
             "serving_prefill_float_pallas", "serving_step_float_pallas",
+            "serving_draft_prefill_float", "serving_draft_step_float",
+            "serving_spec_verify_float",
             "serving_prefill_int8", "serving_step_int8")
     missing = [p for p in want if p not in texts]
     assert not missing, \

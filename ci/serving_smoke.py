@@ -47,7 +47,17 @@ asserts the overload contract:
    the witness gauges appeared in the MID-RUN /metrics scrape, and an
    enabled-vs-disabled A/B pins the profiler's tpot p50 overhead <3%.
 
-Budget: well under 30 s on the CPU smoke host.
+10. **Speculative decoding holds the same line** (ISSUE 19) — a third
+    overloaded run against a ``speculate_k=3`` engine self-drafting
+    with the target's int8 twin: greedy tokens BIT-IDENTICAL to the
+    float engine, zero recompiles after warmup under a budget-0 guard
+    spanning the whole speculative family (``serving_draft_step`` /
+    ``serving_spec_verify`` / ``serving_draft_prefill`` plus the base
+    names), acceptance rate > 0, every KV block (target AND draft
+    pools share one allocation) returns on drain, and /requestz +
+    /stallz answer DURING the loaded run.
+
+Budget: well under 45 s on the CPU smoke host.
 Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/serving_smoke.py
 """
 import json
@@ -356,6 +366,49 @@ def main() -> int:
     assert q8_stats["blocks_free"] == q8_stats["blocks_total"], q8_stats
     q8.close()
 
+    # -- speculative engine: amortized weight stream, same line -------- #
+    # the int8 twin from quantize_for_decode IS the draft (draft_net
+    # omitted); the target stays float, so greedy output must be
+    # bit-identical to the float engine — speculation is a throughput
+    # lever, never an output change
+    net.quantize_for_decode(act_quant="none")
+    sp = ServingEngine(net, max_batch=2, block_size=8, max_queue=MAX_QUEUE,
+                       poll_interval=0.001, speculate_k=3, quantized=False,
+                       http_port=0)
+    assert sp.http_port, "speculative engine ops endpoint did not come up"
+    sp_base = f"http://127.0.0.1:{sp.http_port}"
+    # warmup doubles as the parity probe: both prompt buckets compile
+    sp_toks = [sp.submit(p, 8).result(timeout=60) for p in eval_prompts]
+    assert sp.drain(timeout=30)
+    assert sp_toks == ref_toks, \
+        f"speculative greedy not bit-identical:\n{sp_toks}\n{ref_toks}"
+    # slow the VERIFY step only: the one amortized target weight stream
+    sp.set_fault_hook(lambda ph: time.sleep(SLOW_STEP_S)
+                      if ph == "step" else None)
+    sp_reqs = []
+    with RetraceGuard(budget=0,
+                      watch={"serving_step", "serving_prefill",
+                             "serving_draft_step", "serving_draft_prefill",
+                             "serving_spec_verify"}) as sp_guard:
+        for gap, prompt in zip(gaps, prompts):
+            time.sleep(gap)
+            sp_reqs.append(sp.submit(prompt, 6))
+        # ops plane DURING the speculative overload
+        scode, _, sbody = _fetch(sp_base, "/stallz")
+        assert scode == 200 and sp._name in json.loads(sbody)["engines"]
+        rcode, _, _ = _fetch(sp_base, "/requestz")
+        assert rcode == 200
+        assert sp.drain(timeout=60), \
+            "speculative engine failed to drain under load"
+        sp_guard.check()   # zero speculative-family compiles after warmup
+    sp_stats = sp.stats()
+    sp_spec = sp_stats["speculate"]
+    assert sp_spec["accepted"] > 0 and sp_spec["accept_rate"] > 0.0, sp_spec
+    assert sp_stats["blocks_free"] == sp_stats["blocks_total"], sp_stats
+    sp_done = [r for r in sp_reqs if r.status == "done"]
+    assert sp_done, f"speculative run admitted nothing: {sp_stats}"
+    sp.close()
+
     # -- graceful shutdown --------------------------------------------- #
     thread = eng._thread
     http_thread = eng.http._thread
@@ -379,7 +432,9 @@ def main() -> int:
           f"/metrics+/healthz+/requestz scraped live, int8-KV parity "
           f"{par_hit}/{par_tot} at {q8.kv_bytes_per_token} B/token "
           f"(float {eng.kv_bytes_per_token}), {len(q8_done)}/{len(q8_reqs)} "
-          f"served kv8, lock witness {wstats['edges']} edge(s) over "
+          f"served kv8, spec k={sp_spec['k']} accept "
+          f"{sp_spec['accept_rate']:.2f} ({len(sp_done)}/{len(sp_reqs)} "
+          f"served, 0 recompiles), lock witness {wstats['edges']} edge(s) over "
           f"{wstats['tracked_locks']} locks acyclic+static-covered, "
           f"{prof.hiccups_total} hiccup(s) attributed "
           f"(tpot p50 {on_p50 * 1e3:.1f} ms on / {off_p50 * 1e3:.1f} ms "

@@ -74,6 +74,10 @@ PROGRAM_NAMES: Set[str] = {
                                                 # bucket (LRU-capped)
     "serving_step_kv8", "serving_prefill_kv8",  # the int8-KV-pool program
                                                 # family (kv_dtype="int8")
+    "serving_draft_step", "serving_draft_prefill",  # speculative decoding
+    "serving_spec_verify", "serving_spec_verify_kv8",  # (ISSUE 19): draft
+                                                # k-step + batched verify
+                                                # + draft-pool prefill
 }
 
 

@@ -156,6 +156,8 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.ttft: Optional[float] = None   # derived at _finish
         self.tpot: Optional[float] = None   # mean s/token past the first
+        self.spec_proposed = 0              # draft tokens offered for us
+        self.spec_accepted = 0              # ... accepted by the target
         self._cancel = False
         self.trace = telemetry.requestlog.RequestTrace(
             meta={"prompt_len": int(prompt.shape[0]),
@@ -199,6 +201,8 @@ class Request:
             attrs["ttft_s"] = round(self.ttft, 6)
         if self.tpot is not None:
             attrs["tpot_s"] = round(self.tpot, 6)
+        if self.spec_proposed:
+            attrs["spec_accept_rate"] = round(self.spec_accept_rate, 4)
         self.trace.event(status, t=self.t_done, **attrs)
         telemetry.requestlog.push(self.trace)
         self._cond.notify_all()
@@ -207,6 +211,13 @@ class Request:
     @property
     def finished(self) -> bool:
         return self.status in _TERMINAL
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """This request's draft-token acceptance rate (0.0 when it
+        never ran under speculation)."""
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
 
     def cancel(self) -> None:
         """Request cancellation (non-blocking, any thread, idempotent).
@@ -319,6 +330,26 @@ class ServingEngine:
     attn_impl       paged-attention impl: None = auto (Pallas kernel
                     on TPU, PR 12's dense gather on CPU), or force
                     "pallas"/"dense" (tests, hlolint gate).
+    speculate_k     speculative decoding window (ISSUE 19): a draft
+                    model proposes k tokens per lane per scheduler
+                    iteration and the target verifies all lanes'
+                    windows in ONE batched donated forward, emitting
+                    1..k+1 tokens per lane per weight stream.  Exact:
+                    greedy decode stays bit-identical to
+                    ``speculate_k=0``; stochastic sampling keeps the
+                    target's output distribution (rejection
+                    sampling + residual resample).  0 (default) = the
+                    non-speculative scheduler, byte-for-byte the
+                    pre-ISSUE-19 path.
+    draft_net       the draft TransformerLM (same vocab, max_len >=
+                    max_seq_len).  None with ``speculate_k>0``
+                    self-drafts through the int8 weight path —
+                    requires `net.quantize_for_decode` and a float
+                    target.
+    spec_greedy     force argmax prefix-match acceptance even at
+                    temperature>0 (a throughput-over-sampling debug
+                    knob; output becomes greedy).  temperature<=0
+                    implies it.
     poll_interval   scheduler idle/wait tick (default env
                     ``MXTPU_SERVING_POLL`` = 2 ms).
     fault_hook      callable(phase: str) invoked before each
@@ -350,6 +381,8 @@ class ServingEngine:
                  default_deadline: Optional[float] = None,
                  quantized=None, kv_dtype: Optional[str] = None,
                  attn_impl: Optional[str] = None,
+                 speculate_k: int = 0, draft_net=None,
+                 spec_greedy: bool = False,
                  poll_interval: Optional[float] = None,
                  fault_hook=None, slo_ttft: Optional[float] = None,
                  slo_tpot: Optional[float] = None,
@@ -386,11 +419,30 @@ class ServingEngine:
                            else _POLL_S)
         self._fault_hook = fault_hook
 
+        self._spec_k = int(speculate_k)
+        self._spec = self._spec_k > 0
+        if self._spec_k < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0, got {speculate_k}")
+        if self._spec and self._spec_k >= msl:
+            raise ValueError(
+                f"speculate_k {self._spec_k} >= max_seq_len {msl}")
+        if self._spec and draft_net is not None:
+            if draft_net.embed.weight.shape[0] != net.embed.weight.shape[0]:
+                raise ValueError(
+                    "draft_net vocab "
+                    f"{draft_net.embed.weight.shape[0]} != target vocab "
+                    f"{net.embed.weight.shape[0]}")
+            if draft_net._max_len < msl:
+                raise ValueError(
+                    f"draft_net.max_len {draft_net._max_len} < "
+                    f"max_seq_len {msl}")
         self._programs = PagedPrograms(
             net, max_batch=self._B, block_size=self._bs,
             blocks_per_seq=self._nbps, temperature=temperature,
             top_k=top_k, quantized=quantized, kv_dtype=kv_dtype,
-            attn_impl=attn_impl)
+            attn_impl=attn_impl, speculate_k=self._spec_k,
+            draft_net=draft_net, spec_greedy=spec_greedy)
         self._path = self._programs.path          # "float" / "int8"
         self._label = self._programs.prog_label   # + _kv8/_pallas
         self._kv_dtype = self._programs.kv_dtype
@@ -422,13 +474,32 @@ class ServingEngine:
                 for _ in range(L))
         else:
             self._scale_k = self._scale_v = ()
+        # speculative draft KV pool: per-draft-layer arrays in the
+        # draft model's dtype, addressed by the SAME block tables and
+        # the same BlockPool ids as the target pool (kv_pool.py), so
+        # one lane allocation covers both and eviction frees both
+        self._dpool_k = self._dpool_v = ()
+        if self._spec:
+            dnet = self._programs.draft_net
+            dparams = self._programs.draft_params(self._msl)
+            dH = dnet._layers[0].attn._num_heads
+            dD = dnet._units // dH
+            ddt = dparams["embed"].dtype
+            self._dpool_k = tuple(
+                jnp.zeros((self._num_blocks, dH, self._bs, dD), ddt)
+                for _ in range(len(dnet._layers)))
+            self._dpool_v = tuple(
+                jnp.zeros((self._num_blocks, dH, self._bs, dD), ddt)
+                for _ in range(len(dnet._layers)))
         # pool byte footprint is STATIC (donation replaces arrays, never
         # shapes) — freeze it here so ops-side readers never touch the
-        # live pool tuples the scheduler thread is rewriting
+        # live pool tuples the scheduler thread is rewriting.  Draft
+        # pages count: they are resident HBM spent per token position.
         self._kv_pool_bytes = sum(
             int(a.size) * a.dtype.itemsize
             for a in (*self._pool_k, *self._pool_v,
-                      *self._scale_k, *self._scale_v))
+                      *self._scale_k, *self._scale_v,
+                      *self._dpool_k, *self._dpool_v))
         self._pool = BlockPool(self._num_blocks)
         if telemetry.enabled():
             telemetry.gauge("serving_kv_bytes_per_token",
@@ -460,6 +531,10 @@ class ServingEngine:
         self._prefill_ewma: Optional[float] = None
         self._stats = {"admitted": 0, "done": 0, "steps": 0,
                        "shed": OrderedDict(), "evicted": OrderedDict()}
+        if self._spec:
+            self._stats.update(spec_steps=0, spec_proposed=0,
+                               spec_accepted=0, spec_ewma=None,
+                               spec_rollback=OrderedDict())
         self._last_tick = time.monotonic()   # scheduler liveness heartbeat
 
         # SLO burn-rate tracker: always on (host-side booleans; the
@@ -662,6 +737,20 @@ class ServingEngine:
             row["ttft_s"] = round(req.t_first - req.t_submit, 6)
         return row
 
+    def _spec_section(self) -> Optional[dict]:
+        """Speculation config + live acceptance EWMA for `/varz` and
+        the flight recorder — post-mortem bundles must explain a
+        throughput delta without guessing the engine's draft setup.
+        None when speculation is off."""
+        if not self._spec:
+            return None
+        return {"k": self._spec_k,
+                "draft": self._programs.draft_label,
+                "greedy": self._programs.spec_greedy,
+                "accept_rate_ewma":
+                    None if self._stats["spec_ewma"] is None
+                    else round(self._stats["spec_ewma"], 4)}
+
     def _flight_section(self) -> dict:
         """Flight-recorder dump hook.  Runs inside a signal handler on
         whatever thread holds whatever locks — so it TRIES the engine
@@ -684,6 +773,7 @@ class ServingEngine:
         finally:
             self._lock.release()
         return {"engine": self._name, "in_flight": rows, "stats": stats,
+                "speculate": self._spec_section(),
                 "slo": self._slo.snapshot(now),
                 "stalls": self._prof.recent_stalls(8),
                 "recent_traces": telemetry.requestlog.recent(32)}
@@ -720,6 +810,8 @@ class ServingEngine:
             ladder.append(b)
             b *= 2
         ladder.append(self._msl)
+        with self._lock:    # spec_ewma is written under the tick lock
+            spec = self._spec_section()
         return {
             "engine": self._name,
             "path": self._path,
@@ -733,6 +825,7 @@ class ServingEngine:
             "max_queue": self._max_queue,
             "bucket_ladder": ladder,
             "kv_pool_bytes": self._kv_pool_bytes,
+            "speculate": spec,
             "eos_id": self._eos,
             "poll_interval_s": self._poll,
             "ttft_budget_s": self._ttft_budget,
@@ -859,7 +952,7 @@ class ServingEngine:
     def stats(self) -> dict:
         """Snapshot of the engine's counters (host-side, lock-held)."""
         with self._lock:
-            return {
+            out = {
                 "admitted": self._stats["admitted"],
                 "done": self._stats["done"],
                 "steps": self._stats["steps"],
@@ -870,6 +963,20 @@ class ServingEngine:
                 "blocks_free": self._pool.num_free,
                 "blocks_total": self._num_blocks - 1,
             }
+            if self._spec:
+                prop = self._stats["spec_proposed"]
+                out["speculate"] = {
+                    "k": self._spec_k,
+                    "draft": self._programs.draft_label,
+                    "steps": self._stats["spec_steps"],
+                    "proposed": prop,
+                    "accepted": self._stats["spec_accepted"],
+                    "accept_rate": (self._stats["spec_accepted"] / prop
+                                    if prop else None),
+                    "accept_rate_ewma": self._stats["spec_ewma"],
+                    "rollback": dict(self._stats["spec_rollback"]),
+                }
+            return out
 
     # ------------------------------------------------------------------ #
     # internals
@@ -902,7 +1009,16 @@ class ServingEngine:
 
     def _blocks_needed(self, P: int, N: int) -> int:
         nbp_prefill = -(-self._bucket(P) // self._bs)
-        return max(nbp_prefill, -(-(P + N) // self._bs))
+        horizon = P + N
+        if self._spec:
+            # the speculative window writes up to k positions past the
+            # last committed one: the last committed position is at
+            # most P+N-2 (the final token needs no write), so the
+            # worst-case write sits at min(P+N-2+k, msl-1) — reserve
+            # blocks covering it so rejected-position garbage always
+            # lands in the lane's OWN pages, never a neighbour's
+            horizon = min(P + N - 1 + self._spec_k, self._msl)
+        return max(nbp_prefill, -(-horizon // self._bs))
 
     def _bucket(self, P: int) -> int:
         return min(G.bucket_length(P, floor=self._bs), self._msl)
@@ -1016,7 +1132,10 @@ class ServingEngine:
                 # the next queued request (or start decoding)
                 self._prefill_one(adm)
                 continue
-            self._decode_step(snap, live, hook)
+            if self._spec:
+                self._spec_step(snap, live, hook)
+            else:
+                self._decode_step(snap, live, hook)
 
     def _reap_locked(self, now: float) -> None:
         # queued requests: cancellation and deadlines apply while waiting
@@ -1127,6 +1246,17 @@ class ServingEngine:
             fn, self._pool_k, self._pool_v, self._scale_k, self._scale_v,
             adm.row[:adm.nbp], adm.padded, np.int32(adm.prompt_len),
             adm.key, params)
+        if self._spec:
+            # populate the DRAFT pool with the prompt's K/V too — the
+            # draft's first proposal attends to the full prompt.  Same
+            # bucket, same table row; lands under the prefill cause.
+            dfn = self._programs.draft_prefill(adm.bucket)
+            dparams = self._programs.draft_params(self._msl)
+            (self._dpool_k, self._dpool_v) = G._timed_decode(
+                f"serving_draft_prefill_{self._label}",
+                f"serving_{self._label}", 1,
+                dfn, self._dpool_k, self._dpool_v, adm.row[:adm.nbp],
+                adm.padded, np.int32(adm.prompt_len), dparams)
         tok = int(np.asarray(first)[0])
         dt = time.perf_counter() - t0
         prof.note("prefill", time.perf_counter() - t_h)
@@ -1240,6 +1370,139 @@ class ServingEngine:
         if telemetry.enabled() and step_no % 8 == 0:
             # keep lock_witness_edges_total / lock_contention_seconds
             # scrapeable mid-run, not only after an end-of-run snapshot
+            telemetry.profiler.snapshot_lock_witness()
+
+    def _note_rollback_locked(self, reason: str) -> None:
+        self._count(self._stats["spec_rollback"], reason)
+        if telemetry.enabled():
+            telemetry.counter("serving_spec_rollback_total",
+                              labels={"reason": reason}).inc()
+
+    def _spec_step(self, snap, live, hook) -> None:
+        """One speculate-then-verify scheduler iteration — the
+        speculative analogue of `_decode_step`, same
+        snapshot → device-calls-outside-the-lock → re-lock-commit
+        shape.  The draft program proposes k tokens per lane on its
+        own pool; its outputs stay ON DEVICE and feed the verify
+        program (no intermediate host sync); the verifier emits
+        ``out[:, :accept_len+1]`` per lane.  Commit truncates each
+        lane at eviction (slot-identity check), eos, and max_new —
+        rollback is host-side position arithmetic only (see
+        `programs._build_spec_verify` for why the device needs none).
+        """
+        prof = self._prof
+        k = self._spec_k
+        t_g = time.perf_counter()
+        params = self._live_params()
+        dparams = self._programs.draft_params(self._msl)
+        t_h = time.perf_counter()
+        prof.note("gather_params", t_h - t_g)
+        tables, toks, pos, active, keys = snap
+        if hook is not None:
+            hook("draft")                   # fault seam: draft stream
+        (self._dpool_k, self._dpool_v, d_toks, d_probs) = G._timed_decode(
+            f"serving_draft_step_{self._label}", f"serving_{self._label}",
+            len(live) * k, self._programs.draft_step,
+            self._dpool_k, self._dpool_v, tables, toks, pos, active,
+            keys, dparams)
+        t1 = time.perf_counter()
+        prof.note("draft_step", t1 - t_h)
+        if hook is not None:
+            hook("step")                    # fault seam: target stream
+        t0 = time.perf_counter()
+        (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
+         out, alen) = G._timed_decode(
+            f"serving_spec_verify_{self._label}", f"serving_{self._label}",
+            len(live), self._programs.spec_verify,
+            self._pool_k, self._pool_v, self._scale_k, self._scale_v,
+            tables, toks, pos, active, keys, d_toks, d_probs, params)
+        out = np.asarray(out)               # sync: tokens consumed now
+        alen = np.asarray(alen)
+        dt = time.perf_counter() - t0
+        prof.note("verify_step", time.perf_counter() - t1)
+        now = time.monotonic()
+        t_lk = time.perf_counter()
+        with self._work:
+            t_bk = time.perf_counter()
+            prof.note("lock_wait", t_bk - t_lk)
+            self._stats["steps"] += 1
+            self._stats["spec_steps"] += 1
+            step_no = self._stats["steps"]
+            mark = _TRACE_EVERY > 0 and step_no % _TRACE_EVERY == 0
+            proposed = accepted = delivered_total = 0
+            for lane, req in live:
+                slot = self._slots[lane]
+                if slot is None or slot.req is not req:
+                    continue                # evicted while speculating
+                a = int(alen[lane])
+                proposed += k
+                accepted += a
+                req.spec_proposed += k
+                req.spec_accepted += a
+                if a < k:
+                    self._note_rollback_locked("rejected")
+                delivered, stop = 0, None
+                for j in range(a + 1):      # accepted run + correction/bonus
+                    tok = int(out[lane, j])
+                    req._deliver(tok, now)
+                    delivered += 1
+                    if tok == self._eos:
+                        stop = "eos"
+                        break
+                    if len(req.tokens) >= req.max_new_tokens:
+                        stop = "max_tokens"
+                        break
+                if stop is not None and delivered < a + 1:
+                    self._note_rollback_locked(stop)
+                self._pos[lane] += delivered
+                self._toks[lane] = int(out[lane, delivered - 1])
+                delivered_total += delivered
+                if not BlockPool.covers(len(slot.blocks), self._bs,
+                                        int(self._pos[lane]) - 1):
+                    raise RuntimeError(
+                        f"speculative commit outran lane {lane}'s "
+                        f"reservation: pos {int(self._pos[lane])} vs "
+                        f"{len(slot.blocks)} blocks of {self._bs}")
+                if mark:                    # every Nth step: cheap marks
+                    req.trace.event("decode", t=now,
+                                    pos=int(self._pos[lane]),
+                                    tokens=len(req.tokens),
+                                    occupancy=len(live),
+                                    spec_accepted=a)
+                if telemetry.enabled():
+                    telemetry.histogram("serving_spec_tokens_per_step",
+                                        labels={"path": self._path}) \
+                        .observe(delivered)
+                if stop is not None \
+                        or len(req.tokens) >= req.max_new_tokens:
+                    self._retire_locked(lane)
+            if proposed:
+                rate = accepted / proposed
+                self._stats["spec_proposed"] += proposed
+                self._stats["spec_accepted"] += accepted
+                ewma = self._stats["spec_ewma"]
+                self._stats["spec_ewma"] = rate if ewma is None \
+                    else 0.9 * ewma + 0.1 * rate
+                if telemetry.enabled():
+                    telemetry.gauge("serving_spec_accept_rate",
+                                    labels={"engine": self._name}) \
+                        .set(self._stats["spec_ewma"])
+            if telemetry.enabled():
+                # per-token time: the iteration's device time over the
+                # mean tokens a lane actually got out of it
+                per_tok = (dt + (t1 - t_h)) \
+                    / max(1.0, delivered_total / max(1, len(live)))
+                telemetry.histogram("serving_tpot_seconds",
+                                    labels={"path": self._path}) \
+                    .observe(per_tok)
+                telemetry.gauge("serving_batch_occupancy") \
+                    .set(len(live))
+            queue_depth = len(self._queue)
+            prof.note("bookkeeping", time.perf_counter() - t_bk)
+        prof.end_step(rids=[req.rid for _, req in live],
+                      occupancy=len(live), queue_depth=queue_depth,
+                      step=step_no)
+        if telemetry.enabled() and step_no % 8 == 0:
             telemetry.profiler.snapshot_lock_witness()
 
 

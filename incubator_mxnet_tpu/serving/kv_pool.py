@@ -24,6 +24,15 @@ Design rules:
   scratch block, raises — a double-free here would silently corrupt a
   neighbour sequence's cache, the exact class of bug the serving
   robustness envelope exists to exclude.
+* **One allocation, every pool**: speculative decoding (ISSUE 19)
+  gives the engine a second, draft-model KV pool.  Draft pages are
+  NOT separately allocated — the draft pool arrays are addressed by
+  the SAME block tables and the same block ids as the target's, so a
+  lane's single all-or-nothing `alloc` covers both pools and a free
+  returns both at once (there is no draft-page leak path to test
+  because there is no draft-page accounting to get wrong).  The
+  engine's worst-case reservation simply grows by the k in-flight
+  speculative positions; `covers` is its commit-time fail-fast check.
 """
 from __future__ import annotations
 
@@ -52,6 +61,15 @@ class BlockPool:
         self._free: List[int] = list(range(1, self.num_blocks))
         heapq.heapify(self._free)
         self._allocated: set = set()
+
+    @staticmethod
+    def covers(n_blocks: int, block_size: int, position: int) -> bool:
+        """True when ``n_blocks`` table blocks of ``block_size`` cover
+        write ``position`` (0-based) — the speculative commit's
+        fail-fast check that an accepted window never outran the
+        lane's reservation (a violation would mean rejected-position
+        garbage could be admitted by a later mask)."""
+        return 0 <= position < n_blocks * block_size
 
     @property
     def num_free(self) -> int:

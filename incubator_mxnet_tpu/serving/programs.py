@@ -14,6 +14,18 @@ recompiles after warmup (RetraceGuard-pinned in ci/serving_smoke.py):
   with the true length riding in as a traced scalar — one program per
   BUCKET, LRU-capped, reusing r7's program-cache idiom.
 
+Speculative decoding (ISSUE 19) adds three more static-shaped
+families, built only when the engine configures ``speculate_k > 0``:
+
+* ``serving_draft_step`` — k unrolled draft-model steps over the
+  draft's own KV pool (same block tables/ids as the target's),
+  emitting the proposals and their full proposal distributions.
+* ``serving_spec_verify`` (+``_kv8``) — ONE batched (k+1)-token
+  window forward of the TARGET against its paged pool, with on-device
+  exact acceptance/rejection sampling (see `_build_spec_verify`).
+* ``serving_draft_prefill`` — per-bucket prompt prefill into the
+  draft pool.
+
 Both donate the pool arrays and their scale pools
 (``donate_argnums=(0, 1, 2, 3)``): the K/V pool
 is a ring the engine threads through every call, and an un-donated
@@ -63,6 +75,15 @@ __all__ = ["PagedPrograms"]
 # plus one prefill per (config, bucket)
 _PROGRAM_CACHE_CAP = 16
 
+# fold_in salts deriving the speculative acceptance / residual-resample
+# streams from the per-request key: they must be DISTINCT from each
+# other and from the plain position counters the draft/bonus picks use,
+# so every uniform consumed by the rejection sampler is independent of
+# the proposal that it judges (the exactness argument in
+# docs/serving.md leans on this)
+_ACCEPT_SALT = 0x5ACC
+_RESID_SALT = 0x0E51
+
 
 def _net_program_cache(net):
     """Net-level cache of JITTED serving programs keyed by the full
@@ -102,6 +123,82 @@ def _row_pick(temperature, top_k):
     return pick
 
 
+def _top_k_logits(logits, temperature, top_k):
+    """Temperature-scaled, top-k-masked logits — the distribution
+    `_row_pick` samples from, shared with the speculative draft/verify
+    programs so p (target) and q (draft) are BOTH this exact
+    distribution (the acceptance ratio must compare like with like)."""
+    lg = logits / jnp.float32(temperature)
+    if top_k > 0:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, jnp.finfo(jnp.float32).min, lg)
+    return lg
+
+
+def _token_forward(params, acts, H, bs, kv8, attn_impl,
+                   pool_k, pool_v, scale_k, scale_v,
+                   tables, toks, pos, active, guard_msl=None):
+    """One token's forward over the paged pool — the `serving_step`
+    body minus the pick: embed `toks` at `pos`, write each layer's K/V
+    into the lane's current block, attend, and return
+    ``(new_k, new_v, new_sk, new_sv, logits)``.
+
+    ``guard_msl``: the speculative families step positions past the
+    engine-committed ones (``pos .. pos+k``), so a full-length lane's
+    window can run off the end of the sequence — with a guard length
+    those positions clamp their gathers and write to the scratch block
+    instead of wrapping into a neighbour's pages (their logits are
+    never consumed host-side).  The non-speculative step passes None
+    and keeps its original, unguarded ops byte-for-byte.
+    """
+    dt = params["embed"].dtype
+    B = toks.shape[0]
+    C = params["embed"].shape[1]
+    if guard_msl is None:
+        pos_c = pos
+        blk_idx = pos // bs
+        ok = active
+    else:
+        pos_c = jnp.clip(pos, 0, guard_msl - 1)
+        blk_idx = jnp.clip(pos_c // bs, 0, tables.shape[1] - 1)
+        ok = active & (pos < guard_msl)
+    off = pos_c % bs
+    h = (params["embed"][toks].astype(dt) * math.sqrt(C)
+         + params["pe"][pos_c].astype(dt))                  # (B, C)
+    # the block this step writes: the lane's table entry for its
+    # current position — inactive (or guarded-out) lanes are pointed
+    # at scratch
+    wblk = jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0]
+    wblk = jnp.where(ok, wblk, jnp.int32(0))
+    new_k, new_v, new_sk, new_sv = [], [], [], []
+    for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+        x = G._ln(h, *lp["ln1"])
+        q, k, v = G._qkv_heads(G._dense(x, *lp["qkv"]), H)  # (B, H, D)
+        # write-then-read, the _cached_self_attn order: position
+        # `pos` is valid by the time the mask admits it
+        if kv8:
+            k, ks = quantize_kv(k)        # (B, H, D) s8 / (B, H) f32
+            v, vs = quantize_kv(v)
+            sk = scale_k[li].at[wblk, :, off].set(ks)
+            sv = scale_v[li].at[wblk, :, off].set(vs)
+            new_sk.append(sk)
+            new_sv.append(sv)
+        else:
+            sk = sv = None
+        pk = pool_k[li].at[wblk, :, off].set(k)
+        pv = pool_v[li].at[wblk, :, off].set(v)
+        a = paged_attention(q, pk, pv, tables, pos,
+                            scale_k=sk, scale_v=sv,
+                            impl=attn_impl)           # (B, H, D)
+        h = h + G._dense(a.reshape(B, C), *lp["proj"])
+        h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
+        new_k.append(pk)
+        new_v.append(pv)
+    logits = G._logits_of(params, h)                        # (B, V)
+    return (tuple(new_k), tuple(new_v), tuple(new_sk), tuple(new_sv),
+            logits)
+
+
 def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k,
                 kv_dtype, attn_impl, name):
     """The batched one-token decode program over the paged pool.
@@ -129,44 +226,11 @@ def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k,
 
     def serving_step(pool_k, pool_v, scale_k, scale_v, tables, toks, pos,
                      active, keys, params):
-        dt = params["embed"].dtype
-        B = toks.shape[0]
-        C = params["embed"].shape[1]
-        h = (params["embed"][toks].astype(dt) * math.sqrt(C)
-             + params["pe"][pos].astype(dt))                    # (B, C)
-        blk_idx = pos // bs
-        off = pos % bs
-        # the block this step writes: the lane's table entry for its
-        # current position — inactive lanes are pointed at scratch
-        wblk = jnp.take_along_axis(tables, blk_idx[:, None], axis=1)[:, 0]
-        wblk = jnp.where(active, wblk, jnp.int32(0))
-        new_k, new_v, new_sk, new_sv = [], [], [], []
-        for li, (lp, act) in enumerate(zip(params["layers"], acts)):
-            x = G._ln(h, *lp["ln1"])
-            q, k, v = G._qkv_heads(G._dense(x, *lp["qkv"]), H)  # (B, H, D)
-            # write-then-read, the _cached_self_attn order: position
-            # `pos` is valid by the time the mask admits it
-            if kv8:
-                k, ks = quantize_kv(k)        # (B, H, D) s8 / (B, H) f32
-                v, vs = quantize_kv(v)
-                sk = scale_k[li].at[wblk, :, off].set(ks)
-                sv = scale_v[li].at[wblk, :, off].set(vs)
-                new_sk.append(sk)
-                new_sv.append(sv)
-            else:
-                sk = sv = None
-            pk = pool_k[li].at[wblk, :, off].set(k)
-            pv = pool_v[li].at[wblk, :, off].set(v)
-            a = paged_attention(q, pk, pv, tables, pos,
-                                scale_k=sk, scale_v=sv,
-                                impl=attn_impl)           # (B, H, D)
-            h = h + G._dense(a.reshape(B, C), *lp["proj"])
-            h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
-            new_k.append(pk)
-            new_v.append(pv)
-        logits = G._logits_of(params, h)                        # (B, V)
+        new_k, new_v, new_sk, new_sv, logits = _token_forward(
+            params, acts, H, bs, kv8, attn_impl,
+            pool_k, pool_v, scale_k, scale_v, tables, toks, pos, active)
         nxt = jax.vmap(pick)(logits, pos, keys)
-        return tuple(new_k), tuple(new_v), tuple(new_sk), tuple(new_sv), nxt
+        return new_k, new_v, new_sk, new_sv, nxt
 
     serving_step.__name__ = name
     return serving_step
@@ -221,6 +285,212 @@ def _build_prefill(H, acts, block_size, bucket, temperature, top_k,
     return serving_prefill
 
 
+def _build_draft_step(H, acts, block_size, k, temperature, top_k,
+                      greedy, attn_impl, msl, name):
+    """k unrolled single-token draft steps over the DRAFT KV pool.
+
+    The draft pool shares the target's block tables and `BlockPool`
+    ids (one host-side allocation covers both pools), so this is
+    exactly k `serving_step` bodies on the draft weights — same
+    write-then-read page scatter, same paged attention — except the
+    pick at step j both emits the proposal d_j AND records q_j, the
+    full temp-scaled top-k-masked softmax the proposal was drawn from
+    (the verifier's acceptance ratio needs q_j(d_j) and the residual
+    needs the whole row).  Greedy mode (argmax drafts) returns a
+    (B, k, 1) placeholder instead — the verifier never reads it.
+
+    Positions ``pos .. pos+k-1`` can run past a full-length lane's
+    last position; ``msl`` guards those steps into the scratch block.
+    """
+    bs = int(block_size)
+
+    def serving_draft_step(pool_k, pool_v, tables, toks, pos, active,
+                           keys, params):
+        pk, pv = pool_k, pool_v
+        cur = toks
+        d_toks, d_probs = [], []
+        for j in range(k):
+            pk, pv, _, _, logits = _token_forward(
+                params, acts, H, bs, False, attn_impl,
+                pk, pv, (), (), tables, cur, pos + j, active,
+                guard_msl=msl)
+            if greedy:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                d_probs.append(jnp.zeros_like(logits[..., :1]))
+            else:
+                lg = _top_k_logits(logits, temperature, top_k)
+                nxt = jax.vmap(
+                    lambda l, t, key: jax.random.categorical(
+                        jax.random.fold_in(key, t), l, axis=-1)
+                )(lg, pos + j, keys).astype(jnp.int32)
+                d_probs.append(jax.nn.softmax(lg, axis=-1))
+            d_toks.append(nxt)
+            cur = nxt
+        return (pk, pv, jnp.stack(d_toks, axis=1),
+                jnp.stack(d_probs, axis=1))
+
+    serving_draft_step.__name__ = name
+    return serving_draft_step
+
+
+def _build_draft_prefill(H, acts, block_size, bucket, name):
+    """Prompt prefill into the DRAFT pool for one length bucket — the
+    target `serving_prefill` minus the first-token pick (the target
+    already picked it) and minus the int8-KV family (the draft pool
+    always stays in the draft model's dtype: it is small and its
+    quantization error would depress acceptance for nothing)."""
+    bs = int(block_size)
+    Pb = int(bucket)
+    nbp = -(-Pb // bs)
+    pad_to = nbp * bs
+
+    def serving_draft_prefill(pool_k, pool_v, table_row, prompt,
+                              valid_len, params):
+        _, kcs, vcs = G._prefill(params, prompt, acts, H, pad_to,
+                                 valid_len=valid_len)
+        new_k, new_v = [], []
+        for li in range(len(acts)):
+            kc, vc = kcs[li], vcs[li]           # (1, H, pad_to, D)
+            kcp = kc[0].reshape(-1, nbp, bs, kc.shape[-1])
+            vcp = vc[0].reshape(-1, nbp, bs, vc.shape[-1])
+            new_k.append(pool_k[li].at[table_row].set(
+                kcp.transpose(1, 0, 2, 3)))
+            new_v.append(pool_v[li].at[table_row].set(
+                vcp.transpose(1, 0, 2, 3)))
+        return tuple(new_k), tuple(new_v)
+
+    serving_draft_prefill.__name__ = name
+    return serving_draft_prefill
+
+
+def _build_spec_verify(H, acts, block_size, k, temperature, top_k,
+                       greedy, kv_dtype, attn_impl, msl, name):
+    """The speculative verifier: ONE batched forward of every lane's
+    (k+1)-token window against the TARGET paged pool, then exact
+    acceptance/rejection on device.
+
+    The window is ``[toks, d_1 .. d_k]`` at positions
+    ``pos .. pos+k`` — the big matmuls (qkv/proj/ffn/logits) batch
+    over B·(k+1) rows, which is the whole point: one weight stream
+    amortized over up to k+1 emitted tokens.  Per layer the FULL
+    window's K/V scatter into the lane's pages
+    (``pos//bs .. (pos+k)//bs``) first, then attention runs as k+1
+    unrolled `paged_attention` calls at the exact single-query shape
+    and per-position mask of `serving_step` — so window position j's
+    math is byte-identical to the sequential step's (later positions'
+    writes are already in the pool but the ``kpos <= pos+j`` mask
+    contributes exactly 0 for them), which is what makes greedy
+    speculation bit-identical to non-speculative decode.
+
+    Acceptance (stochastic): accept d_j while
+    ``u_j < p_j(d_j) / q_j(d_j)`` with u_j drawn from the
+    `_ACCEPT_SALT`-derived stream at counter pos+j; the first rejected
+    position resamples from ``normalize(max(p - q, 0))``
+    (`_RESID_SALT` stream), and a fully-accepted window earns the
+    bonus token sampled from p_{k+1} with the plain pick recipe.
+    Every consumed draw has a unique (salt, counter) pair across the
+    request's lifetime, and is independent of the proposal stream —
+    the emitted distribution is provably the target's.  Greedy:
+    ``out = argmax(logits)`` and the accept length is the leading run
+    of draft/argmax matches.
+
+    Returns ``(new_k, new_v, new_sk, new_sv, out (B, k+1) int32,
+    accept_len (B,) int32)``; the engine delivers
+    ``out[:, :accept_len+1]``.  No device-side rollback exists or is
+    needed: rejected positions' pages are overwritten before any mask
+    admits them (write-before-read, the same argument as bucket-pad
+    garbage), so rollback is host-side position truncation only.
+    """
+    bs = int(block_size)
+    T = k + 1
+    kv8 = kv_dtype == "int8"
+
+    def serving_spec_verify(pool_k, pool_v, scale_k, scale_v, tables,
+                            toks, pos, active, keys, draft_toks,
+                            draft_probs, params):
+        dt = params["embed"].dtype
+        B = toks.shape[0]
+        C = params["embed"].shape[1]
+        win = jnp.concatenate([toks[:, None], draft_toks], axis=1)
+        posw = (pos[:, None]
+                + jnp.arange(T, dtype=jnp.int32)[None, :])     # (B, T)
+        posc = jnp.clip(posw, 0, msl - 1)
+        h = (params["embed"][win].astype(dt) * math.sqrt(C)
+             + params["pe"][posc].astype(dt))                  # (B, T, C)
+        blk_idx = jnp.clip(posc // bs, 0, tables.shape[1] - 1)
+        off = posc % bs
+        wblk = jnp.take_along_axis(tables, blk_idx, axis=1)    # (B, T)
+        wblk = jnp.where(active[:, None] & (posw < msl), wblk,
+                         jnp.int32(0))
+        new_k, new_v, new_sk, new_sv = [], [], [], []
+        for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+            x = G._ln(h, *lp["ln1"])
+            q, kw, vw = G._qkv_heads(G._dense(x, *lp["qkv"]), H)
+            if kv8:
+                kw, ks = quantize_kv(kw)   # (B,T,H,D) s8 / (B,T,H) f32
+                vw, vs = quantize_kv(vw)
+                sk = scale_k[li].at[wblk, :, off].set(ks)
+                sv = scale_v[li].at[wblk, :, off].set(vs)
+                new_sk.append(sk)
+                new_sv.append(sv)
+            else:
+                sk = sv = None
+            pk = pool_k[li].at[wblk, :, off].set(kw)
+            pv = pool_v[li].at[wblk, :, off].set(vw)
+            att = [paged_attention(q[:, j], pk, pv, tables, pos + j,
+                                   scale_k=sk, scale_v=sv,
+                                   impl=attn_impl)
+                   for j in range(T)]
+            a = jnp.stack(att, axis=1)                         # (B,T,H,D)
+            h = h + G._dense(a.reshape(B, T, C), *lp["proj"])
+            h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
+            new_k.append(pk)
+            new_v.append(pv)
+        logits = G._logits_of(params, h)                       # (B,T,V)
+
+        if greedy:
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = (draft_toks == out[:, :k]).astype(jnp.int32)
+            alen = jnp.cumprod(match, axis=1).sum(axis=1)
+        else:
+            lg = _top_k_logits(logits, temperature, top_k)
+            p = jax.nn.softmax(lg, axis=-1)                    # (B,T,V)
+
+            def lane(lg_l, p_l, q_l, d_l, t0, key):
+                ts = t0 + jnp.arange(k, dtype=jnp.int32)
+                us = jax.vmap(lambda t: jax.random.uniform(
+                    jax.random.fold_in(
+                        jax.random.fold_in(key, _ACCEPT_SALT), t)))(ts)
+                pd = jnp.take_along_axis(p_l[:k], d_l[:, None], 1)[:, 0]
+                qd = jnp.take_along_axis(q_l, d_l[:, None], 1)[:, 0]
+                acc = (us * jnp.maximum(qd, 1e-38) < pd).astype(jnp.int32)
+                alen_l = jnp.cumprod(acc).sum()
+                # first rejected position (clamped when all accepted —
+                # then `last` selects the bonus instead)
+                ri = jnp.minimum(alen_l, k - 1)
+                resid = jnp.maximum(p_l[ri] - q_l[ri], 0.0)
+                corr = jax.random.categorical(
+                    jax.random.fold_in(
+                        jax.random.fold_in(key, _RESID_SALT), t0 + ri),
+                    jnp.log(resid + 1e-38)).astype(jnp.int32)
+                bonus = jax.random.categorical(
+                    jax.random.fold_in(key, t0 + k),
+                    lg_l[k]).astype(jnp.int32)
+                last = jnp.where(alen_l == k, bonus, corr)
+                d_pad = jnp.concatenate(
+                    [d_l, jnp.zeros((1,), jnp.int32)])
+                out_l = jnp.where(jnp.arange(T) < alen_l, d_pad, last)
+                return out_l, alen_l
+
+            out, alen = jax.vmap(lane)(lg, p, draft_probs, draft_toks,
+                                       pos, keys)
+        return (tuple(new_k), tuple(new_v), tuple(new_sk),
+                tuple(new_sv), out, alen.astype(jnp.int32))
+
+    serving_spec_verify.__name__ = name
+    return serving_spec_verify
+
+
 class PagedPrograms:
     """The engine's compiled-program surface: one jitted step program
     plus per-bucket prefill programs, all resolved through a net-level
@@ -230,7 +500,8 @@ class PagedPrograms:
 
     def __init__(self, net, *, max_batch, block_size, blocks_per_seq,
                  temperature, top_k, quantized, kv_dtype=None,
-                 attn_impl=None):
+                 attn_impl=None, speculate_k=0, draft_net=None,
+                 spec_greedy=False):
         if kv_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_dtype must be None (model dtype) or 'int8', "
@@ -274,6 +545,77 @@ class PagedPrograms:
                        "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
                        gauge="serving_program_cache_size")
         self._step = step
+        self._init_speculative(net, speculate_k, draft_net, spec_greedy)
+
+    def _init_speculative(self, net, speculate_k, draft_net, spec_greedy):
+        """Resolve the draft model and build the speculative program
+        pair.  ``draft_net=None`` with ``speculate_k>0`` self-drafts
+        through PR 7's int8 weight path (requires
+        `net.quantize_for_decode` and a float target — an int8 target
+        drafting for itself would verify its own proposals)."""
+        self._spec_k = int(speculate_k)
+        self._spec_greedy = bool(spec_greedy) or self._temperature <= 0.0
+        self._draft_params = None
+        self._draft_params_key = None
+        if self._spec_k == 0:
+            self._draft_net = None
+            return
+        if self._spec_k < 0:
+            raise ValueError(
+                f"speculate_k must be >= 0, got {speculate_k}")
+        if draft_net is None:
+            if self.path != "float":
+                raise ValueError(
+                    "speculate_k with draft_net=None self-drafts via the "
+                    "int8 weight path, but the target is already int8 — "
+                    "pass a distinct draft_net")
+            self._draft_qc = G._quant_config(net, True)
+            self._draft_net = net
+            self._draft_label = "self-int8"
+        else:
+            self._draft_qc = G._quant_config(draft_net, None)
+            self._draft_net = draft_net
+            dL = len(draft_net._layers)
+            self._draft_label = f"net[{dL}x{draft_net._units}]"
+        dnet = self._draft_net
+        self._draft_H = dnet._layers[0].attn._num_heads
+        self._draft_acts = tuple(lyr.ffn._act for lyr in dnet._layers)
+        msl = self._nbps * self._bs
+        k, greedy = self._spec_k, self._spec_greedy
+        sfx = "_kv8" if self._kv_dtype == "int8" else ""
+        self._verify_name = "serving_spec_verify" + sfx
+        dkey = (self._draft_H, self._draft_acts,
+                G._decode_path(self._draft_qc), k, greedy)
+        cache = _net_program_cache(net)
+        draft = G._lru_touch(cache, ("draft_step",) + self._key + dkey)
+        if draft is None:
+            _note_build("draft_step")
+            draft = jax.jit(
+                _build_draft_step(self._draft_H, self._draft_acts,
+                                  self._bs, k, self._temperature,
+                                  self._top_k, greedy, self._impl, msl,
+                                  "serving_draft_step"),
+                donate_argnums=(0, 1))
+            G._lru_put(net, cache, ("draft_step",) + self._key + dkey,
+                       draft, "_serving_program_cache_cap",
+                       _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        self._draft_step = draft
+        verify = G._lru_touch(cache, ("spec_verify",) + self._key
+                              + (k, greedy))
+        if verify is None:
+            _note_build("spec_verify")
+            verify = jax.jit(
+                _build_spec_verify(self._H, self._acts, self._bs, k,
+                                   self._temperature, self._top_k,
+                                   greedy, self._kv_dtype, self._impl,
+                                   msl, self._verify_name),
+                donate_argnums=(0, 1, 2, 3))
+            G._lru_put(net, cache, ("spec_verify",) + self._key
+                       + (k, greedy), verify,
+                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        self._spec_verify = verify
 
     @property
     def path(self) -> str:
@@ -331,6 +673,66 @@ class PagedPrograms:
                                self._temperature, self._top_k,
                                self._kv_dtype, self._prefill_name),
                 donate_argnums=(0, 1, 2, 3))
+            G._lru_put(self._net, cache, key, fn,
+                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        return fn
+
+    # -- speculative decoding (ISSUE 19) ------------------------------- #
+    @property
+    def speculate_k(self) -> int:
+        """Draft window length (0 = speculation off)."""
+        return self._spec_k
+
+    @property
+    def spec_greedy(self) -> bool:
+        """Effective acceptance mode: True = argmax prefix-match
+        (temperature<=0 always implies it)."""
+        return self._spec_greedy
+
+    @property
+    def draft_label(self) -> str:
+        """Draft identity for telemetry/varz ("self-int8" or the
+        draft net's shape)."""
+        return self._draft_label
+
+    @property
+    def draft_net(self):
+        return self._draft_net
+
+    @property
+    def draft_step(self):
+        return self._draft_step
+
+    @property
+    def spec_verify(self):
+        return self._spec_verify
+
+    def draft_params(self, pe_width):
+        """The draft weight pytree, cached on the draft net's
+        weight-buffer fingerprint (same idiom as `gather_params` —
+        the self-draft int8 requantize never runs per-iteration)."""
+        key = (G._params_fingerprint(self._draft_net), int(pe_width))
+        if self._draft_params_key != key:
+            self._draft_params = G._gather_params(
+                self._draft_net, pe_width, self._draft_qc)
+            self._draft_params_key = key
+        return self._draft_params
+
+    def draft_prefill(self, bucket):
+        """The jitted DRAFT prefill program for prompt bucket
+        ``bucket`` (net-level LRU, like `prefill`)."""
+        cache = _net_program_cache(self._net)
+        key = (("draft_prefill", bucket) + self._key
+               + (self._draft_H, self._draft_acts))
+        fn = G._lru_touch(cache, key)
+        if fn is None:
+            _note_build("draft_prefill")
+            fn = jax.jit(
+                _build_draft_prefill(self._draft_H, self._draft_acts,
+                                     self._bs, bucket,
+                                     "serving_draft_prefill"),
+                donate_argnums=(0, 1))
             G._lru_put(self._net, cache, key, fn,
                        "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
                        gauge="serving_program_cache_size")

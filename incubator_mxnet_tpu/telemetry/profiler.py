@@ -84,9 +84,13 @@ __all__ = ["EngineProfiler", "register", "unregister", "profilers",
 DEFAULT_HICCUP_K = float(os.environ.get("MXTPU_PROFILER_HICCUP_K", "3.0")
                          or 3.0)
 DEFAULT_STALL_RING = int(os.environ.get("MXTPU_STALLZ_RING", "64") or 64)
-# ledger causes (the serving_step_stall_seconds{cause=} label set)
-CAUSES = ("device_step", "prefill", "gather_params", "lock_wait",
-          "bookkeeping", "wait", "gc", "host_other")
+# ledger causes (the serving_step_stall_seconds{cause=} label set);
+# draft_step/verify_step are the speculative-decoding iteration's two
+# device phases (ISSUE 19) — a speculative engine notes those instead
+# of device_step
+CAUSES = ("device_step", "draft_step", "verify_step", "prefill",
+          "gather_params", "lock_wait", "bookkeeping", "wait", "gc",
+          "host_other")
 # /profilez sleeps on an HTTP handler thread — bound it
 MAX_CAPTURE_S = 30.0
 # phase events shorter than this don't land in the trace deque (a 2 µs
