@@ -9,6 +9,8 @@ reports the latency/goodput envelope:
         [--max-batch B] [--max-queue Q] [--prompt-len P] [--new-tokens T]
         [--slow-step-ms MS] [--cancel-frac F] [--kv-dtype model|int8]
         [--speculate K] [--draft int8|tiny]
+        [--shared-prefix-frac F] [--prefill-chunk N]
+        [--long-prompt-every K]
         [--sweep-prompt-lens P1,P2,...] [--seed S] [--out FILE]
         [--profile] [--profile-out TRACE.json]
 
@@ -44,6 +46,22 @@ int8-quantized twin (high acceptance, no second model);
 lower acceptance).  Greedy output is bit-identical to the
 non-speculative engine either way; ``detail.speculate`` reports the
 measured acceptance rate and tokens-per-lane-step.
+
+``--shared-prefix-frac F`` (ISSUE 20) makes every short prompt share
+its first ``int(F * prompt_len)`` tokens — the system-prompt traffic
+shape the copy-on-write prefix cache serves without re-prefilling:
+after the first admission registers the shared blocks, later requests
+bind them and chunk-prefill only their private tail.
+``detail.prefix_cache`` carries the engine's hit/miss/cached-token
+counters, and a cold CONTROL pass at the same config (prefix sharing
+off) lands under ``detail.prefix_cache_control`` with the measured
+TTFT p50 reduction.  ``--prefill-chunk N`` sets the engine's fixed chunk width
+(default: the engine's own default).  ``--long-prompt-every K`` runs a
+SECOND measured pass where every K-th request carries a cold 2x-length
+prompt — the head-of-line-blocking regime chunked prefill exists for —
+and reports tpot p99 over the SHORT requests (the victims of a
+monolithic prefill) next to the steady-state p99 under
+``detail.long_prompt_arrival``.
 
 ``--profile`` (ISSUE 17) enables telemetry for the measured run and
 carries the stall-attribution table + recent hiccup records under
@@ -87,8 +105,11 @@ def main():
     ap.add_argument("--prompt-len", type=int, default=24)
     ap.add_argument("--new-tokens", type=int, default=32)
     ap.add_argument("--slow-step-ms", type=float, default=0.0,
-                    help="fault injection: sleep this long in every "
-                         "decode step (models a slow/contended device)")
+                    help="fault injection: model a slow device costing "
+                         "this long per batched decode step, and "
+                         "proportionally per prefill chunk "
+                         "(MS * chunk_width / max_batch — same "
+                         "per-token device cost)")
     ap.add_argument("--cancel-frac", type=float, default=0.0,
                     help="fault injection: cancel this fraction of "
                          "requests ~one step after submission")
@@ -111,6 +132,21 @@ def main():
                     help="draft model for --speculate: 'int8' "
                          "self-drafts with the target's quantized twin, "
                          "'tiny' uses a fresh small TransformerLM")
+    ap.add_argument("--shared-prefix-frac", type=float, default=0.0,
+                    metavar="F",
+                    help="short prompts share their first int(F * "
+                         "prompt_len) tokens; the prefix cache serves "
+                         "the shared blocks after the first admission")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    metavar="N",
+                    help="fixed prefill chunk width (tokens per chunk "
+                         "program call); default: engine default")
+    ap.add_argument("--long-prompt-every", type=int, default=0,
+                    metavar="K",
+                    help="also run a long-prompt-arrival pass: every "
+                         "K-th request carries a cold 2x-length prompt; "
+                         "reports short-request tpot p99 under "
+                         "detail.long_prompt_arrival (0 = off)")
     ap.add_argument("--sweep-prompt-lens",
                     help="comma-separated extra prompt lengths; each "
                          "runs the same open loop and lands a compact "
@@ -143,7 +179,9 @@ def main():
         telemetry.enable()
 
     mx.random.seed(args.seed)
-    max_prompt = max([args.prompt_len] + sweep_lens)
+    max_prompt = max([args.prompt_len] + sweep_lens
+                     + ([2 * args.prompt_len] if args.long_prompt_every
+                        else []))
     net = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
                         num_heads=H,
                         max_len=max_prompt + args.new_tokens + 40,
@@ -177,6 +215,22 @@ def main():
     if sweep_lens:
         row["detail"]["prompt_sweep"] = [
             _sweep_summary(args, net, plen) for plen in sweep_lens]
+    if args.shared_prefix_frac > 0:
+        # cold control at the SAME config: the measured win of serving
+        # the shared prefix from cache instead of re-prefilling it
+        ctrl = argparse.Namespace(**vars(args))
+        ctrl.shared_prefix_frac = 0.0
+        creqs, _, _, _ = _run_once(ctrl, net, args.prompt_len)
+        cold = _ttft_p50_ms(creqs)
+        warm = row["detail"]["ttft_ms"]["p50"]
+        row["detail"]["prefix_cache_control"] = {
+            "ttft_p50_ms_cold": cold,
+            "ttft_p50_ms_shared": warm,
+            "ttft_p50_reduction": (None if not warm or not cold
+                                   else round(cold / warm, 2))}
+    if args.long_prompt_every:
+        row["detail"]["long_prompt_arrival"] = _long_prompt_summary(
+            args, net)
     line = json.dumps(row)
     print(line)
     if args.out:
@@ -187,31 +241,55 @@ def main():
             json.dump(run[3]["trace"], fh)
 
 
-def _run_once(args, net, prompt_len):
+def _run_once(args, net, prompt_len, long_every=0, long_msl=False):
     """One open-loop measured run; returns the raw observations."""
     from incubator_mxnet_tpu.serving import ServingEngine
 
-    msl = prompt_len + args.new_tokens + 8
+    long_len = 2 * prompt_len
+    msl = (long_len if long_every or long_msl else prompt_len) \
+        + args.new_tokens + 8
     eng = ServingEngine(net, max_batch=args.max_batch, block_size=16,
                         max_seq_len=msl, max_queue=args.max_queue,
                         kv_dtype="int8" if args.kv_dtype == "int8" else None,
+                        prefill_chunk=args.prefill_chunk,
                         slo_ttft=args.ttft_slo_ms / 1e3,
                         slo_tpot=args.tpot_slo_ms / 1e3,
                         **getattr(args, "spec_kw", {}))
 
     rng = np.random.RandomState(args.seed)
-    prompts = [rng.randint(0, V, size=prompt_len).astype(np.int32)
-               for _ in range(args.requests)]
+    share = int(round(args.shared_prefix_frac * prompt_len))
+    shared = rng.randint(0, V, size=share).astype(np.int32)
+    # long prompts are COLD (no shared prefix): the head-of-line
+    # stressor is a full-length chunked prefill, not a cache hit
+    long_idx = {i for i in range(args.requests)
+                if long_every and i and i % long_every == 0}
+    prompts = []
+    for i in range(args.requests):
+        if i in long_idx:
+            prompts.append(rng.randint(0, V, size=long_len)
+                           .astype(np.int32))
+        else:
+            tail = rng.randint(0, V, size=prompt_len - share) \
+                      .astype(np.int32)
+            prompts.append(np.concatenate([shared, tail]))
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
     cancel = rng.random_sample(args.requests) < args.cancel_frac
 
-    # warmup: compile prefill bucket + step OUTSIDE the timed run
+    # warmup: compile the chunk + step programs OUTSIDE the timed run
+    # (with a shared prefix this also registers it — the steady state a
+    # prefix-cache deployment actually serves from)
     eng.submit(prompts[0], args.new_tokens).result(timeout=120)
     assert eng.drain(timeout=60)
     if args.slow_step_ms > 0:
+        # consistent synthetic device: a decode step carries up to
+        # max_batch tokens for slow_step_ms, so an N-token prefill
+        # chunk on the same device costs slow_step_ms * N / max_batch
+        step_s = args.slow_step_ms / 1e3
+        chunk_s = step_s * (eng.stats()["prefill_chunk"]["chunk"]
+                            / args.max_batch)
         eng.set_fault_hook(
-            lambda ph: time.sleep(args.slow_step_ms / 1e3)
-            if ph == "step" else None)
+            lambda ph: time.sleep(step_s if ph == "step" else chunk_s)
+            if ph in ("step", "prefill") else None)
 
     reqs = []
     t0 = time.monotonic()
@@ -225,7 +303,10 @@ def _run_once(args, net, prompt_len):
     wall = time.monotonic() - t0
     stats = eng.stats()
     info = {"kv_bytes_per_token": eng.kv_bytes_per_token,
-            "attn_impl": eng.attn_impl}
+            "attn_impl": eng.attn_impl,
+            "prefix_cache": stats["prefix_cache"],
+            "prefill_chunk": stats["prefill_chunk"]["chunk"],
+            "long_idx": long_idx}
     if args.speculate > 0:
         spec = stats["speculate"]
         info["speculate"] = {
@@ -271,6 +352,53 @@ def _sweep_summary(args, net, prompt_len):
                 sum(len(r.tokens) for r in slo_ok) / wall, 1),
             "served_under_slo": len(slo_ok),
             "tpot_p50_ms": None if p50 is None else round(p50 * 1e3, 2),
+            "wall_s": round(wall, 2)}
+
+
+def _ttft_p50_ms(reqs):
+    tt = sorted(r.t_first - r.t_submit for r in reqs
+                if r.status == "done" and r.t_first is not None)
+    p = _pct(tt, 50)
+    return None if p is None else round(p * 1e3, 2)
+
+
+def _short_tpot_p99_ms(reqs, long_idx):
+    """p99 over INDIVIDUAL inter-token gaps of the short requests (one
+    sample per decoded token, not per-request means): a monolithic
+    prefill's stall cannot hide inside a request's average."""
+    gaps = []
+    for i, r in enumerate(reqs):
+        if i in long_idx or r.status != "done":
+            continue
+        gaps.extend(b - a for a, b in zip(r.t_tokens, r.t_tokens[1:]))
+    gaps.sort()
+    p99 = _pct(gaps, 99)
+    return None if p99 is None else round(p99 * 1e3, 2)
+
+
+def _long_prompt_summary(args, net):
+    """Two passes on the IDENTICAL engine config (same max_seq_len, so
+    same pool and program shapes): a steady all-short baseline, then
+    one where a cold 2x-length prompt arrives every K-th request.  tpot
+    p99 is computed over the SHORT requests only — the victims a
+    monolithic prefill would stall for the whole long prompt; with
+    chunked prefill their decode cadence should barely move."""
+    sreqs, _, _, _ = _run_once(args, net, args.prompt_len, long_msl=True)
+    steady = _short_tpot_p99_ms(sreqs, set())
+    reqs, stats, wall, info = _run_once(args, net, args.prompt_len,
+                                        long_every=args.long_prompt_every)
+    longs = info["long_idx"]
+    p99_ms = _short_tpot_p99_ms(reqs, longs)
+    return {"every": args.long_prompt_every,
+            "long_prompt_len": 2 * args.prompt_len,
+            "long_served": sum(1 for i, r in enumerate(reqs)
+                               if i in longs and r.status == "done"),
+            "short_served": sum(1 for i, r in enumerate(reqs)
+                                if i not in longs and r.status == "done"),
+            "short_tpot_p99_ms": p99_ms,
+            "steady_tpot_p99_ms": steady,
+            "ratio_vs_steady": (None if not p99_ms or not steady
+                                else round(p99_ms / steady, 2)),
             "wall_s": round(wall, 2)}
 
 
@@ -321,6 +449,9 @@ def _render_row(args, run):
             "new_tokens": args.new_tokens,
             "slow_step_ms": args.slow_step_ms,
             "cancel_frac": args.cancel_frac,
+            "shared_prefix_frac": args.shared_prefix_frac,
+            "prefill_chunk": info["prefill_chunk"],
+            "prefix_cache": info["prefix_cache"],
             "kv_dtype": args.kv_dtype,
             "attn_impl": info["attn_impl"],
             "kv_bytes_per_token": info["kv_bytes_per_token"],

@@ -14,10 +14,12 @@ evaluates the committed `.hlolint_contracts.json`:
 * ``checkpoint_snapshot``             — the async checkpointer's
   on-device copy (must stay pure per-shard copies: no collectives,
   no host transfers)
-* ``serving_prefill_float`` / ``serving_step_float`` and their
+* ``serving_prefill_chunk_float`` / ``serving_step_float`` and their
   ``_int8`` twins — the continuous-batching engine's paged-KV
   programs (donation must hold so eviction never doubles the pool;
-  the int8 path must not materialize bf16 weight copies)
+  the int8 path must not materialize bf16 weight copies).  Prefill is
+  the ISSUE 20 fixed-width chunk program — ONE per engine, no pow2
+  bucket ladder
 * ``serving_*_float_kv8`` — the int8-KV-pool family (``kv_dtype=
   "int8"``): the pool must actually carry s8 pages and keep donation
 * ``serving_*_float_pallas`` — the forced paged-attention-kernel
@@ -25,9 +27,9 @@ evaluates the committed `.hlolint_contracts.json`:
   ``(B, H, max_seq_len)`` attention-probs buffer the dense-gather
   path streams (that buffer is the whole point of the kernel)
 * ``serving_draft_step_float`` / ``serving_spec_verify_float`` /
-  ``serving_draft_prefill_float`` — the speculative-decoding family
-  (``speculate_k > 0``): draft k-token proposer, batched target
-  verifier, and the draft-pool prefill.  Donation must hold on BOTH
+  ``serving_draft_prefill_chunk_float`` — the speculative-decoding
+  family (``speculate_k > 0``): draft k-token proposer, batched target
+  verifier, and the draft-pool chunk prefill.  Donation must hold on BOTH
   pool sets and everything stays on-device / collective-free /
   f64-free — speculation is a throughput lever, not a numerics change
 
@@ -171,7 +173,12 @@ def _serving_programs():
     net(NDArray(jnp.ones((1, 4), jnp.int32)))
     net.cast("bfloat16")
     prompt = np.zeros((P,), dtype="int32")
-    kws = dict(max_batch=1, block_size=4, poll_interval=0.001)
+    # prefill_chunk=5: the weight census counts f32/bf16 buffers SHAPED
+    # like an s8 weight, and a chunk width of 16/32/48 would make the
+    # chunk program's (chunk, C)-family activations alias the smoke
+    # model's weight shapes — 5 aliases nothing
+    kws = dict(max_batch=1, block_size=4, poll_interval=0.001,
+               prefill_chunk=5)
     with ServingEngine(net, **kws) as eng:
         eng.submit(prompt, N).result(timeout=60)   # serving_*_float
     with ServingEngine(net, kv_dtype="int8", **kws) as eng:
@@ -184,7 +191,7 @@ def _serving_programs():
     draft.initialize()
     draft(NDArray(jnp.ones((1, 4), jnp.int32)))
     with ServingEngine(net, speculate_k=2, draft_net=draft, **kws) as eng:
-        # serving_draft_prefill_float + serving_draft_step_float
+        # serving_draft_prefill_chunk_float + serving_draft_step_float
         # + serving_spec_verify_float
         eng.submit(prompt, N).result(timeout=60)
     net.quantize_for_decode(act_quant="none")
@@ -210,12 +217,14 @@ def collect_facts():
     texts = telemetry.perf.hlo_texts()
     want = ("trainer_full_step", "trainer_full_step_zero_bucketed",
             "decode_float", "decode_int8", "checkpoint_snapshot",
-            "serving_prefill_float", "serving_step_float",
-            "serving_prefill_float_kv8", "serving_step_float_kv8",
-            "serving_prefill_float_pallas", "serving_step_float_pallas",
-            "serving_draft_prefill_float", "serving_draft_step_float",
+            "serving_prefill_chunk_float", "serving_step_float",
+            "serving_prefill_chunk_float_kv8", "serving_step_float_kv8",
+            "serving_prefill_chunk_float_pallas",
+            "serving_step_float_pallas",
+            "serving_draft_prefill_chunk_float",
+            "serving_draft_step_float",
             "serving_spec_verify_float",
-            "serving_prefill_int8", "serving_step_int8")
+            "serving_prefill_chunk_int8", "serving_step_int8")
     missing = [p for p in want if p not in texts]
     assert not missing, \
         f"programs not captured (telemetry text capture broken?): " \

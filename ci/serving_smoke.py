@@ -35,7 +35,7 @@ asserts the overload contract:
    overloaded run against a ``kv_dtype="int8"`` engine: greedy tokens
    match the float-KV engine >= 95%, zero recompiles after warmup
    under its own budget-0 guard (``serving_step_kv8`` /
-   ``serving_prefill_kv8``), and every block returns to the pool.
+   ``serving_prefill_chunk_kv8``), and every block returns to the pool.
 9. **Stall attribution explains the slow steps** (ISSUE 17) — the
    fault hook injects one 10x slow decode step every
    ``HICCUP_EVERY``; ``/profilez`` and ``/stallz`` are hit DURING the
@@ -52,10 +52,19 @@ asserts the overload contract:
     with the target's int8 twin: greedy tokens BIT-IDENTICAL to the
     float engine, zero recompiles after warmup under a budget-0 guard
     spanning the whole speculative family (``serving_draft_step`` /
-    ``serving_spec_verify`` / ``serving_draft_prefill`` plus the base
-    names), acceptance rate > 0, every KV block (target AND draft
+    ``serving_spec_verify`` / ``serving_draft_prefill_chunk`` plus the
+    base names), acceptance rate > 0, every KV block (target AND draft
     pools share one allocation) returns on drain, and /requestz +
     /stallz answer DURING the loaded run.
+
+11. **Prefix cache + chunked prefill hold the line** (ISSUE 20) — a
+    shared-prefix overload run against a ``prefill_chunk=8`` engine
+    with a per-chunk injected sleep: a cache-hit re-arrival's TTFT
+    beats the cold TTFT (only the uncached tail chunks run), its
+    greedy tokens are BIT-IDENTICAL to the cold request's, zero
+    recompiles after warmup (ONE chunk program — no pow2 bucket
+    ladder to compile), and every block AND refcount is drained at
+    close even though shared blocks were bound by multiple requests.
 
 Budget: well under 45 s on the CPU smoke host.
 Run via ci/lint.sh; standalone:  JAX_PLATFORMS=cpu python ci/serving_smoke.py
@@ -161,8 +170,10 @@ def main() -> int:
     assert eng.http_port, "ops endpoint did not come up on port 0"
     base = f"http://127.0.0.1:{eng.http_port}"
 
-    # -- warmup: compile the step program and both prompt buckets ------ #
-    for p in ((3, 7, 11), (2, 9, 4, 1, 5, 8, 6, 3, 2)):   # buckets 8, 16
+    # -- warmup: compile the step + prefill-chunk programs ------------- #
+    # (ONE chunk program serves every prompt length — ISSUE 20; the two
+    # lengths double as a chunk-boundary probe)
+    for p in ((3, 7, 11), (2, 9, 4, 1, 5, 8, 6, 3, 2)):
         eng.submit(np.array(p, np.int32), 4).result(timeout=60)
     assert eng.drain(timeout=30)
 
@@ -187,7 +198,8 @@ def main() -> int:
                .astype(np.int32) for _ in range(N_REQUESTS)]
     reqs = []
     with RetraceGuard(budget=0,
-                      watch={"serving_step", "serving_prefill"}) as guard:
+                      watch={"serving_step",
+                             "serving_prefill_chunk"}) as guard:
         # one request whose deadline expires mid-decode: admitted first
         # (empty queue), then evicted — /requestz must explain it
         doomed = eng.submit(prompts[0], 48, deadline=0.5)
@@ -352,8 +364,9 @@ def main() -> int:
     q8.set_fault_hook(lambda ph: time.sleep(SLOW_STEP_S)
                       if ph == "step" else None)
     q8_reqs = []
-    with RetraceGuard(budget=0, watch={"serving_step_kv8",
-                                       "serving_prefill_kv8"}) as q8_guard:
+    with RetraceGuard(budget=0,
+                      watch={"serving_step_kv8",
+                             "serving_prefill_chunk_kv8"}) as q8_guard:
         for gap, prompt in zip(gaps, prompts):
             time.sleep(gap)
             q8_reqs.append(q8.submit(prompt, 6))
@@ -387,8 +400,9 @@ def main() -> int:
                       if ph == "step" else None)
     sp_reqs = []
     with RetraceGuard(budget=0,
-                      watch={"serving_step", "serving_prefill",
-                             "serving_draft_step", "serving_draft_prefill",
+                      watch={"serving_step", "serving_prefill_chunk",
+                             "serving_draft_step",
+                             "serving_draft_prefill_chunk",
                              "serving_spec_verify"}) as sp_guard:
         for gap, prompt in zip(gaps, prompts):
             time.sleep(gap)
@@ -408,6 +422,54 @@ def main() -> int:
     sp_done = [r for r in sp_reqs if r.status == "done"]
     assert sp_done, f"speculative run admitted nothing: {sp_stats}"
     sp.close()
+
+    # -- prefix cache + chunked prefill (ISSUE 20) --------------------- #
+    # Fresh engine, small chunk, and an injected sleep per prefill
+    # CHUNK — so prefill cost is proportional to the UNCACHED tail and
+    # a cache hit must beat the cold TTFT by construction, not luck.
+    pc = ServingEngine(net, max_batch=2, block_size=8,
+                       max_queue=MAX_QUEUE, quantized=False,
+                       prefill_chunk=8, poll_interval=0.001)
+    rng_pc = np.random.RandomState(7)
+    warm_prompt = rng_pc.randint(0, 61, size=48).astype(np.int32)
+    prefix = rng_pc.randint(0, 61, size=40).astype(np.int32)
+    tails = [rng_pc.randint(0, 61, size=8).astype(np.int32)
+             for _ in range(8)]
+    shared = [np.concatenate([prefix, t]) for t in tails]
+    # warmup compiles the chunk + step programs on an UNRELATED prefix
+    # (it must not pre-populate the cache for the cold measurement)
+    pc.submit(warm_prompt, 4).result(timeout=60)
+    assert pc.drain(timeout=30)
+    pc.set_fault_hook(lambda ph: time.sleep(0.03)
+                      if ph == "prefill" else None)
+    with RetraceGuard(budget=0,
+                      watch={"serving_step",
+                             "serving_prefill_chunk"}) as pc_guard:
+        cold = pc.submit(shared[0], 8)           # 6 chunks, cache miss
+        cold_toks = cold.result(timeout=60)
+        hit = pc.submit(shared[0], 8)            # 40/48 tokens cached
+        hit_toks = hit.result(timeout=60)
+        # overload burst: every arrival shares the now-resident prefix
+        pc_reqs = [pc.submit(p, 6) for p in shared[1:]]
+        assert pc.drain(timeout=60), \
+            "prefix-cache engine failed to drain under load"
+        pc_guard.check()   # zero compiles: one chunk program, no ladder
+    assert hit_toks == cold_toks, \
+        f"cache-hit greedy not bit-identical:\n{hit_toks}\n{cold_toks}"
+    assert hit.ttft < cold.ttft * 0.7, \
+        f"cache hit did not beat cold TTFT: {hit.ttft:.3f}s vs " \
+        f"{cold.ttft:.3f}s"
+    pc_stats = pc.stats()
+    pcache = pc_stats["prefix_cache"]
+    assert pcache["hits"] >= 2 and pcache["cached_tokens"] >= 80, pcache
+    assert pc_stats["blocks_free"] == pc_stats["blocks_total"], pc_stats
+    assert pc._pool.num_allocated == 0, "refcounts not drained"
+    assert reg.get("serving_prefix_cache_hits_total").value >= 2
+    assert reg.get("serving_prefix_cache_misses_total").value >= 1
+    pc_done = [r for r in pc_reqs if r.status == "done"]
+    assert pc_done, f"shared-prefix burst admitted nothing: {pc_stats}"
+    pc.close()
+    assert pc._pool.num_allocated == 0, "refcounts leaked across close"
 
     # -- graceful shutdown --------------------------------------------- #
     thread = eng._thread

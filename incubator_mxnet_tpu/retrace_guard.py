@@ -68,16 +68,17 @@ PROGRAM_NAMES: Set[str] = {
     "_paged_core", "_paged_core_q8",            # paged-attention kernel jits
                                                 # (direct calls outside the
                                                 # step program, e.g. tests)
-    "serving_step", "serving_prefill",          # continuous-batching decode:
-                                                # ONE step program per engine
-                                                # + one prefill per prompt
-                                                # bucket (LRU-capped)
-    "serving_step_kv8", "serving_prefill_kv8",  # the int8-KV-pool program
-                                                # family (kv_dtype="int8")
-    "serving_draft_step", "serving_draft_prefill",  # speculative decoding
-    "serving_spec_verify", "serving_spec_verify_kv8",  # (ISSUE 19): draft
-                                                # k-step + batched verify
-                                                # + draft-pool prefill
+    "serving_step", "serving_prefill_chunk",    # continuous-batching decode:
+                                                # ONE step program + ONE
+                                                # fixed-width prefill-chunk
+                                                # program per engine (no
+                                                # pow2 bucket ladder)
+    "serving_step_kv8",                         # the int8-KV-pool program
+    "serving_prefill_chunk_kv8",                # family (kv_dtype="int8")
+    "serving_draft_step",                       # speculative decoding
+    "serving_draft_prefill_chunk",              # (ISSUE 19): draft k-step
+    "serving_spec_verify", "serving_spec_verify_kv8",  # + batched verify
+                                                # + draft-pool chunk prefill
 }
 
 

@@ -3,14 +3,19 @@
 A production decode engine over `models.generation`'s programs:
 
 * `kv_pool`   — paged KV-cache block accounting (scratch block 0,
-  deterministic lowest-first allocation, double-free guards);
+  deterministic lowest-first allocation, double-free guards) plus the
+  ISSUE 20 copy-on-write prefix cache: full KV blocks content-
+  addressed by prefix-token hash, refcounted frees, LRU eviction of
+  unreferenced cached blocks;
 * `programs`  — the static-shaped compiled programs (one batched
-  decode step per engine + LRU-capped per-bucket prefill), pool
-  arrays donated;
+  decode step + ONE fixed-width prefill-chunk program per engine —
+  no pow2 bucket ladder), pool arrays donated;
 * `engine`    — the iteration-level scheduler: bounded admission
-  queue with backpressure, SLO-aware shedding, per-request deadlines
-  with exact mid-batch eviction, cancellation that releases KV
-  blocks, clean drain()/close().
+  queue with backpressure, prefix-cached admission that prefills only
+  a prompt's uncached tail in chunks interleaved with decode steps,
+  SLO-aware shedding, per-request deadlines with exact mid-batch
+  eviction, cancellation that releases KV blocks, clean
+  drain()/close().
 
 Entry points: ``net.serve()`` / `default_engine(net)` for a shared
 engine, `ServingEngine` for explicit config, and

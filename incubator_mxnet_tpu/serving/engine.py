@@ -2,12 +2,23 @@
 
 `ServingEngine` runs an iteration-level (Orca-style) scheduler on a
 background thread: between decode steps it retires finished sequences,
-evicts timed-out/cancelled ones, admits queued requests (prefill
-interleaved with decode), then executes ONE batched decode step for
-every live lane.  The KV cache is a paged pool (`kv_pool`,
-`programs`): admission and eviction move *block table entries*, never
-array shapes, so after warmup nothing recompiles — ci/serving_smoke.py
-pins this with a zero-budget RetraceGuard.
+evicts timed-out/cancelled ones, admits queued requests, runs ONE
+fixed-width prefill chunk for the oldest admitted-but-unprefilled
+request, then executes ONE batched decode step for every live lane —
+so a long prompt costs each resident sequence at most one chunk of
+extra latency per token, never its whole prefill (ISSUE 20).  The KV
+cache is a paged pool (`kv_pool`, `programs`): admission and eviction
+move *block table entries*, never array shapes, so after warmup
+nothing recompiles — ci/serving_smoke.py pins this with a zero-budget
+RetraceGuard.
+
+Admission is copy-on-write prefix-cached (ISSUE 20): the BlockPool
+content-addresses full KV blocks by prefix-token hash, so a request
+whose prompt shares a block-aligned prefix with earlier traffic binds
+those blocks read-only (refcounted — `free` is a decref) and prefills
+only its uncached tail.  Cache-hit greedy output is bit-identical to
+a cold prefill (docs/serving.md §"Prefix caching"), and the draft
+pool shares the same tables and block ids, so speculation composes.
 
 The robustness envelope (the reason this engine exists — an engine
 that stalls or corrupts neighbours under overload is worse than none):
@@ -52,10 +63,11 @@ condition and every request's condition) guards the queue, slots,
 stats and pool accounting.  The scheduler thread is the only toucher
 of the device-side pool arrays, so device calls run lock-free; only
 bookkeeping holds the lock.  That includes prefill (tpulint TPU015):
-admission is reserve (lane + blocks claimed under the lock) →
-prefill (device call, unlocked) → commit (re-lock, slot-identity
-check, first-token delivery), mirroring `_decode_step`'s
-snapshot/step/commit shape.
+admission claims the lane + blocks under the lock (binding any
+cache-hit prefix blocks), each chunk is stage (under the lock) →
+device call (unlocked) → commit (re-lock, slot-identity check), and
+the final chunk's commit delivers the first token — mirroring
+`_decode_step`'s snapshot/step/commit shape.
 """
 from __future__ import annotations
 
@@ -80,6 +92,11 @@ __all__ = ["ServingError", "RequestShed", "RequestTimedOut",
 
 _POLL_S = float(os.environ.get("MXTPU_SERVING_POLL", "0.002"))
 _MAX_QUEUE = int(os.environ.get("MXTPU_SERVING_QUEUE", "16"))
+# prefill-chunk width in tokens (the scheduler's prefill budget per
+# iteration): one chunk of at most this many prompt positions runs
+# between consecutive decode steps
+_PREFILL_CHUNK = int(os.environ.get("MXTPU_SERVING_PREFILL_CHUNK", "32")
+                     or 32)
 # one trace mark per N decode steps per request (0 disables the marks;
 # admission/terminal events always record)
 _TRACE_EVERY = int(os.environ.get("MXTPU_SERVING_TRACE_EVERY", "8"))
@@ -148,6 +165,7 @@ class Request:
         self.seed = int(seed)
         self.status = "new"
         self.tokens: list = []
+        self.t_tokens: list = []            # monotonic stamp per token
         self.error: Optional[BaseException] = None
         self.block_ids: tuple = ()
         self.t_submit = time.monotonic()
@@ -177,6 +195,7 @@ class Request:
         if self.t_first is None:
             self.t_first = now
         self.tokens.append(tok)
+        self.t_tokens.append(now)
         self._cond.notify_all()
 
     def _finish(self, status: str, error: Optional[BaseException] = None):
@@ -282,25 +301,26 @@ class _Slot:
         self.blocks = blocks
 
 
-class _Admission:
-    """A reserved admission: lane + blocks claimed and host inputs
-    staged under the lock, prefill still to run OUTSIDE it."""
+class _PrefillJob:
+    """An admitted request's remaining prefill work: lane + blocks are
+    already claimed (cache-hit prefix blocks bound read-only), the
+    prompt tail past ``next_pos`` still needs chunking through the
+    device.  The scheduler runs ONE chunk of ONE job per iteration,
+    interleaved with decode steps."""
 
-    __slots__ = ("lane", "req", "blocks", "row", "key", "padded",
-                 "prompt_len", "bucket", "nbp", "hook")
+    __slots__ = ("lane", "req", "row", "key", "prompt", "P",
+                 "cached_len", "next_pos", "t_work")
 
-    def __init__(self, lane, req, blocks, row, key, padded,
-                 prompt_len, bucket, nbp, hook):
+    def __init__(self, lane, req, row, key, prompt, P, cached_len):
         self.lane = lane
         self.req = req
-        self.blocks = blocks
         self.row = row
         self.key = key
-        self.padded = padded
-        self.prompt_len = prompt_len
-        self.bucket = bucket
-        self.nbp = nbp
-        self.hook = hook
+        self.prompt = prompt
+        self.P = P
+        self.cached_len = cached_len
+        self.next_pos = cached_len          # first unprefilled position
+        self.t_work = 0.0                   # device seconds spent so far
 
 
 class ServingEngine:
@@ -330,6 +350,15 @@ class ServingEngine:
     attn_impl       paged-attention impl: None = auto (Pallas kernel
                     on TPU, PR 12's dense gather on CPU), or force
                     "pallas"/"dense" (tests, hlolint gate).
+    prefill_chunk   prefill-chunk width in tokens (ISSUE 20): each
+                    scheduler iteration runs at most ONE chunk of this
+                    many prompt positions before the next decode step,
+                    so a long arrival costs resident sequences one
+                    chunk of latency per token, never a full prefill.
+                    Default env ``MXTPU_SERVING_PREFILL_CHUNK`` = 32,
+                    clamped to ``max_seq_len``.  ONE chunk program per
+                    engine — no pow2 bucket ladder, no recompiles for
+                    unseen prompt lengths.
     speculate_k     speculative decoding window (ISSUE 19): a draft
                     model proposes k tokens per lane per scheduler
                     iteration and the target verifies all lanes'
@@ -381,6 +410,7 @@ class ServingEngine:
                  default_deadline: Optional[float] = None,
                  quantized=None, kv_dtype: Optional[str] = None,
                  attn_impl: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None,
                  speculate_k: int = 0, draft_net=None,
                  spec_greedy: bool = False,
                  poll_interval: Optional[float] = None,
@@ -418,6 +448,12 @@ class ServingEngine:
         self._poll = float(poll_interval if poll_interval is not None
                            else _POLL_S)
         self._fault_hook = fault_hook
+        if prefill_chunk is not None and int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self._chunk = min(int(prefill_chunk if prefill_chunk is not None
+                              else _PREFILL_CHUNK), msl)
+        self._chunk = max(1, self._chunk)
 
         self._spec_k = int(speculate_k)
         self._spec = self._spec_k > 0
@@ -441,7 +477,8 @@ class ServingEngine:
             net, max_batch=self._B, block_size=self._bs,
             blocks_per_seq=self._nbps, temperature=temperature,
             top_k=top_k, quantized=quantized, kv_dtype=kv_dtype,
-            attn_impl=attn_impl, speculate_k=self._spec_k,
+            attn_impl=attn_impl, prefill_chunk=self._chunk,
+            speculate_k=self._spec_k,
             draft_net=draft_net, spec_greedy=spec_greedy)
         self._path = self._programs.path          # "float" / "int8"
         self._label = self._programs.prog_label   # + _kv8/_pallas
@@ -500,7 +537,7 @@ class ServingEngine:
             for a in (*self._pool_k, *self._pool_v,
                       *self._scale_k, *self._scale_v,
                       *self._dpool_k, *self._dpool_v))
-        self._pool = BlockPool(self._num_blocks)
+        self._pool = BlockPool(self._num_blocks, self._bs)
         if telemetry.enabled():
             telemetry.gauge("serving_kv_bytes_per_token",
                             labels={"engine": self._name}) \
@@ -524,12 +561,18 @@ class ServingEngine:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queue: deque = deque()
+        # admitted-but-unprefilled work, oldest first: each entry is a
+        # _PrefillJob whose lane+blocks are already claimed; the
+        # scheduler runs one chunk of the head job per iteration
+        self._prefill_jobs: deque = deque()
         self._stop = threading.Event()
         self._closed = False
         self._err_lock = threading.Lock()
         self._pending_err: Optional[BaseException] = None
         self._prefill_ewma: Optional[float] = None
         self._stats = {"admitted": 0, "done": 0, "steps": 0,
+                       "prefix_hits": 0, "prefix_misses": 0,
+                       "cached_tokens": 0,
                        "shed": OrderedDict(), "evicted": OrderedDict()}
         if self._spec:
             self._stats.update(spec_steps=0, spec_proposed=0,
@@ -716,7 +759,13 @@ class ServingEngine:
                      "done": self._stats["done"],
                      "steps": self._stats["steps"],
                      "queue_depth": len(self._queue),
-                     "blocks_free": self._pool.num_free}
+                     "blocks_free": self._pool.num_free,
+                     "prefill_chunks_pending":
+                         self._pending_chunks_locked(),
+                     "prefix_cache": {
+                         "hits": self._stats["prefix_hits"],
+                         "misses": self._stats["prefix_misses"],
+                         **self._pool.prefix_stats()}}
         return {"engine": self._name, "path": self._path,
                 "in_flight": rows, "stats": stats,
                 "slo": self._slo.snapshot(now)}
@@ -769,7 +818,13 @@ class ServingEngine:
                      "done": self._stats["done"],
                      "steps": self._stats["steps"],
                      "shed": dict(self._stats["shed"]),
-                     "evicted": dict(self._stats["evicted"])}
+                     "evicted": dict(self._stats["evicted"]),
+                     "prefill_chunks_pending":
+                         self._pending_chunks_locked(),
+                     "prefix_cache": {
+                         "hits": self._stats["prefix_hits"],
+                         "misses": self._stats["prefix_misses"],
+                         **self._pool.prefix_stats()}}
         finally:
             self._lock.release()
         return {"engine": self._name, "in_flight": rows, "stats": stats,
@@ -805,11 +860,6 @@ class ServingEngine:
         configuration is actually running (ops triage can't tell from
         metrics alone).  Values are frozen at construction except the
         profiler toggle and MXTPU_* env knobs, read live."""
-        ladder, b = [], self._bs
-        while b < self._msl:
-            ladder.append(b)
-            b *= 2
-        ladder.append(self._msl)
         with self._lock:    # spec_ewma is written under the tick lock
             spec = self._spec_section()
         return {
@@ -823,7 +873,8 @@ class ServingEngine:
             "max_seq_len": self._msl,
             "num_blocks": self._num_blocks,
             "max_queue": self._max_queue,
-            "bucket_ladder": ladder,
+            "prefill_chunk": self._chunk,
+            "prefix_cache": True,
             "kv_pool_bytes": self._kv_pool_bytes,
             "speculate": spec,
             "eos_id": self._eos,
@@ -962,6 +1013,15 @@ class ServingEngine:
                 "active": int(self._active.sum()),
                 "blocks_free": self._pool.num_free,
                 "blocks_total": self._num_blocks - 1,
+                "prefix_cache": {
+                    "hits": self._stats["prefix_hits"],
+                    "misses": self._stats["prefix_misses"],
+                    "cached_tokens": self._stats["cached_tokens"],
+                    **self._pool.prefix_stats()},
+                "prefill_chunk": {
+                    "chunk": self._chunk,
+                    "jobs": len(self._prefill_jobs),
+                    "pending_chunks": self._pending_chunks_locked()},
             }
             if self._spec:
                 prop = self._stats["spec_proposed"]
@@ -1008,7 +1068,6 @@ class ServingEngine:
             raise RuntimeError("serving engine is closed")
 
     def _blocks_needed(self, P: int, N: int) -> int:
-        nbp_prefill = -(-self._bucket(P) // self._bs)
         horizon = P + N
         if self._spec:
             # the speculative window writes up to k positions past the
@@ -1018,10 +1077,7 @@ class ServingEngine:
             # blocks covering it so rejected-position garbage always
             # lands in the lane's OWN pages, never a neighbour's
             horizon = min(P + N - 1 + self._spec_k, self._msl)
-        return max(nbp_prefill, -(-horizon // self._bs))
-
-    def _bucket(self, P: int) -> int:
-        return min(G.bucket_length(P, floor=self._bs), self._msl)
+        return -(-horizon // self._bs)
 
     def _count(self, table: OrderedDict, reason: str) -> None:
         table[reason] = table.get(reason, 0) + 1
@@ -1040,6 +1096,7 @@ class ServingEngine:
                               labels={"reason": reason}).inc()
 
     def _abort_all_locked(self, error: BaseException) -> None:
+        self._prefill_jobs.clear()
         while self._queue:
             self._queue.popleft()._finish("cancelled", error)
         for i, slot in enumerate(self._slots):
@@ -1051,8 +1108,8 @@ class ServingEngine:
 
     def _release_lane_locked(self, i: int) -> None:
         slot = self._slots[i]
-        self._pool.free(slot.blocks)
-        self._slots[i] = None
+        self._pool.free(slot.blocks)        # decref: shared prefix
+        self._slots[i] = None               # blocks survive in-cache
         self._tables[i, :] = SCRATCH_BLOCK
         self._active[i] = False
         self._toks[i] = 0
@@ -1060,6 +1117,8 @@ class ServingEngine:
         if telemetry.enabled():
             telemetry.gauge("serving_kv_blocks_in_use") \
                 .set(self._pool.num_allocated)
+            telemetry.gauge("serving_kv_blocks_shared") \
+                .set(self._pool.num_shared)
 
     def _evict_locked(self, i: int, reason: str,
                       error: BaseException) -> None:
@@ -1085,6 +1144,7 @@ class ServingEngine:
             failure = RequestFailed("serving scheduler failed")
             failure.__cause__ = e
             with self._work:
+                self._prefill_jobs.clear()
                 while self._queue:
                     self._queue.popleft()._finish("failed", failure)
                 for i, slot in enumerate(self._slots):
@@ -1097,7 +1157,12 @@ class ServingEngine:
     def _loop(self) -> None:
         # every phase of the iteration feeds the stall ledger: lock
         # acquisition, reap+admission bookkeeping, idle polls — so the
-        # per-step causes sum to the step's wall time (profiler.py)
+        # per-step causes sum to the step's wall time (profiler.py).
+        # Iteration shape (ISSUE 20): reap → admit everything that fits
+        # (lanes + blocks claimed, prefix blocks bound) → run at most
+        # ONE prefill chunk → run ONE decode step over live lanes.
+        # Interleaving chunk and decode per iteration is what bounds a
+        # resident sequence's tpot spike to one chunk of compute.
         prof = self._prof
         while True:
             t_lk = time.perf_counter()
@@ -1109,33 +1174,29 @@ class ServingEngine:
                 now = time.monotonic()
                 self._last_tick = now       # health(): liveness heartbeat
                 self._reap_locked(now)
-                adm = self._reserve_admission_locked(now)
-                if adm is None:
-                    live = [(i, s.req) for i, s in enumerate(self._slots)
-                            if s is not None and self._active[i]]
-                    if not live:
-                        prof.note("bookkeeping",
-                                  time.perf_counter() - t_bk)
-                        if not self._queue:
-                            t_w = time.perf_counter()
-                            self._work.wait(self._poll)
-                            prof.note("wait",
-                                      time.perf_counter() - t_w)
-                        continue
-                    snap = (self._tables.copy(), self._toks.copy(),
-                            self._pos.copy(), self._active.copy(),
-                            self._keys.copy())
-                    hook = self._fault_hook
+                while self._admit_locked(now):
+                    pass
+                staged = self._stage_chunk_locked()
+                live = [(i, s.req) for i, s in enumerate(self._slots)
+                        if s is not None and self._active[i]]
+                snap = (self._tables.copy(), self._toks.copy(),
+                        self._pos.copy(), self._active.copy(),
+                        self._keys.copy()) if live else None
+                hook = self._fault_hook
                 prof.note("bookkeeping", time.perf_counter() - t_bk)
-            if adm is not None:
-                # prefill OUTSIDE the lock — then loop back to admit
-                # the next queued request (or start decoding)
-                self._prefill_one(adm)
-                continue
-            if self._spec:
-                self._spec_step(snap, live, hook)
-            else:
-                self._decode_step(snap, live, hook)
+                if staged is None and not live:
+                    if not self._queue:
+                        t_w = time.perf_counter()
+                        self._work.wait(self._poll)
+                        prof.note("wait", time.perf_counter() - t_w)
+                    continue
+            if staged is not None:
+                self._run_chunk(staged, hook)
+            if live:
+                if self._spec:
+                    self._spec_step(snap, live, hook)
+                else:
+                    self._decode_step(snap, live, hook)
 
     def _reap_locked(self, now: float) -> None:
         # queued requests: cancellation and deadlines apply while waiting
@@ -1170,11 +1231,14 @@ class ServingEngine:
                     RequestTimedOut(f"deadline exceeded after "
                                     f"{len(slot.req.tokens)} token(s)"))
 
-    def _reserve_admission_locked(self, now: float) -> Optional[_Admission]:
-        """Claim a lane + blocks for the queue head and stage its host
-        inputs, all under the lock; the prefill itself runs OUTSIDE the
-        lock (`_prefill_one`).  Returns None when nothing is admissible
-        (empty queue, batch full, pool full)."""
+    def _admit_locked(self, now: float) -> bool:
+        """Admit the queue head: claim a lane, look the prompt up in
+        the prefix cache, bind the cache-hit blocks copy-on-write, and
+        alloc private blocks for the tail — all under the lock.  The
+        remaining prefill work is queued as a `_PrefillJob` (chunks run
+        OUTSIDE the lock, one per scheduler iteration).  Returns False
+        when nothing is admissible (empty queue, batch full, pool
+        full)."""
         while self._queue:
             req = self._queue[0]
             if self._ttft_budget is not None \
@@ -1189,93 +1253,149 @@ class ServingEngine:
             try:
                 lane = self._slots.index(None)
             except ValueError:
-                return None                 # batch full
-            blocks = self._pool.alloc(
-                self._blocks_needed(req.prompt.shape[0],
-                                    req.max_new_tokens))
-            if blocks is None:
-                return None                 # pool full: FCFS head waits
-            # register the lane BEFORE the (unlocked) prefill runs: if
-            # the prefill or a fault hook raises, the scheduler failure
-            # path finds the request in its slot and finishes it — no
+                return False                # batch full
+            P = req.prompt.shape[0]
+            needed = self._blocks_needed(P, req.max_new_tokens)
+            # prefix-cache lookup + COW bind: bound blocks are never
+            # written by this request (chunks start at cached_len,
+            # decode writes at >= P), so sharing needs no copy
+            hits, cached_len = self._pool.lookup(req.prompt)
+            self._pool.bind(hits)
+            fresh = self._pool.alloc(needed - len(hits))
+            if fresh is None:
+                self._pool.unbind(hits)     # roll back: FCFS head waits
+                return False
+            blocks = list(hits) + fresh
+            # register the lane BEFORE any (unlocked) chunk runs: if a
+            # chunk or a fault hook raises, the scheduler failure path
+            # finds the request in its slot and finishes it — no
             # handle ever hangs
             self._queue.popleft()
             self._slots[lane] = _Slot(req, blocks)
             req.block_ids = tuple(blocks)
-            P = req.prompt.shape[0]
-            Pb = self._bucket(P)
             row = np.full((self._nbps,), SCRATCH_BLOCK, np.int32)
             row[:len(blocks)] = blocks
             key = np.array([(req.seed >> 32) & 0xFFFFFFFF,
                             req.seed & 0xFFFFFFFF], np.uint32)
-            padded = np.zeros((1, Pb), np.int32)
-            padded[0, :P] = req.prompt
-            req.trace.event("admitted", lane=lane, bucket=Pb,
+            n_chunks = -(-(P - cached_len) // self._chunk)
+            self._stats["prefix_hits" if cached_len else
+                        "prefix_misses"] += 1
+            self._stats["cached_tokens"] += cached_len
+            req.trace.event("admitted", lane=lane,
                             blocks=[int(b) for b in blocks],
+                            cached_tokens=cached_len, chunks=n_chunks,
                             queue_wait_s=round(
                                 time.monotonic() - req.t_submit, 6))
+            # req.prompt is already a host np.int32 array (submit()
+            # runs _as_prompt before taking the lock) — no conversion
+            # here, nothing under _lock may dispatch or sync
+            self._prefill_jobs.append(_PrefillJob(
+                lane, req, row, key, req.prompt, P, cached_len))
+            if telemetry.enabled():
+                telemetry.counter(
+                    "serving_prefix_cache_hits_total" if cached_len
+                    else "serving_prefix_cache_misses_total").inc()
+                telemetry.gauge("serving_kv_blocks_shared") \
+                    .set(self._pool.num_shared)
+                self._note_chunk_queue_locked()
             self._note_queue_depth_locked()
             self._work.notify_all()         # queue space freed
-            return _Admission(lane, req, blocks, row, key, padded,
-                              P, Pb, -(-Pb // self._bs),
-                              self._fault_hook)
+            return True
+        return False
+
+    def _stage_chunk_locked(self):
+        """Pick the next prefill chunk to run: the oldest job whose
+        lane still belongs to it (evicted/cancelled jobs are dropped
+        here — their blocks were already freed by `_evict_locked`).
+        Returns ``(job, toks, start, n)`` or None."""
+        while self._prefill_jobs:
+            job = self._prefill_jobs[0]
+            slot = self._slots[job.lane]
+            if slot is None or slot.req is not job.req:
+                self._prefill_jobs.popleft()
+                self._note_chunk_queue_locked()
+                continue
+            start = job.next_pos
+            n = min(self._chunk, job.P - start)
+            toks = np.zeros((self._chunk,), np.int32)
+            toks[:n] = job.prompt[start:start + n]
+            return (job, toks, start, n)
         return None
 
-    def _prefill_one(self, adm: _Admission) -> None:
-        """Prefill for a reserved admission — device call OUTSIDE the
-        lock (mirroring `_decode_step`), so submit()/cancel()/stats()
-        never stall behind prefill compute (fault-hook injected sleeps
-        included).  Re-locks to commit the first token, with a slot
-        identity check in case the request was evicted meanwhile."""
+    def _run_chunk(self, staged, hook) -> None:
+        """Run one staged prefill chunk — device call OUTSIDE the lock
+        (mirroring `_decode_step`), so submit()/cancel()/stats() never
+        stall behind prefill compute (fault-hook injected sleeps
+        included).  Re-locks to commit, with a slot identity check in
+        case the request was evicted meanwhile; the FINAL chunk's
+        commit delivers the first token and activates the lane."""
         prof = self._prof
-        req = adm.req
-        # program-cache lookup + weight gather/requantize, timed apart
-        # from the device call so a cold bucket compile or a requantize
-        # after a weight swap shows up as its own stall cause
+        job, toks, start, n = staged
+        req = job.req
+        # weight gather/requantize, timed apart from the device call so
+        # a requantize after a weight swap shows up as its own cause
         t_g = time.perf_counter()
-        fn = self._programs.prefill(adm.bucket)
         params = self._live_params()
         t_h = time.perf_counter()
         prof.note("gather_params", t_h - t_g)
-        if adm.hook is not None:
-            adm.hook("prefill")             # fault seam: counts as prefill
+        if hook is not None:
+            hook("prefill")                 # fault seam: once per chunk
+        final = start + n >= job.P
         t0 = time.perf_counter()
         (self._pool_k, self._pool_v, self._scale_k, self._scale_v,
          first) = G._timed_decode(
-            f"serving_prefill_{self._label}", f"serving_{self._label}", 1,
-            fn, self._pool_k, self._pool_v, self._scale_k, self._scale_v,
-            adm.row[:adm.nbp], adm.padded, np.int32(adm.prompt_len),
-            adm.key, params)
+            f"serving_prefill_chunk_{self._label}",
+            f"serving_{self._label}", n,
+            self._programs.prefill_chunk, self._pool_k, self._pool_v,
+            self._scale_k, self._scale_v, job.row, toks,
+            np.int32(start), np.int32(job.P), job.key, params)
         if self._spec:
-            # populate the DRAFT pool with the prompt's K/V too — the
+            # populate the DRAFT pool with the same chunk too — the
             # draft's first proposal attends to the full prompt.  Same
-            # bucket, same table row; lands under the prefill cause.
-            dfn = self._programs.draft_prefill(adm.bucket)
+            # table row; lands under the prefill_chunk cause.
             dparams = self._programs.draft_params(self._msl)
             (self._dpool_k, self._dpool_v) = G._timed_decode(
-                f"serving_draft_prefill_{self._label}",
-                f"serving_{self._label}", 1,
-                dfn, self._dpool_k, self._dpool_v, adm.row[:adm.nbp],
-                adm.padded, np.int32(adm.prompt_len), dparams)
-        tok = int(np.asarray(first)[0])
+                f"serving_draft_prefill_chunk_{self._label}",
+                f"serving_{self._label}", n,
+                self._programs.draft_prefill_chunk,
+                self._dpool_k, self._dpool_v, job.row, toks,
+                np.int32(start), np.int32(job.P), dparams)
+        # only the final chunk's first-token pick is consumed — don't
+        # force a host sync per intermediate chunk
+        tok = int(np.asarray(first)) if final else None
         dt = time.perf_counter() - t0
-        prof.note("prefill", time.perf_counter() - t_h)
+        prof.note("prefill_chunk", time.perf_counter() - t_h)
         now = time.monotonic()
         t_lk = time.perf_counter()
         with self._work:
             t_bk = time.perf_counter()
             prof.note("lock_wait", t_bk - t_lk)
             try:
-                self._prefill_ewma = dt if self._prefill_ewma is None \
-                    else 0.8 * self._prefill_ewma + 0.2 * dt
-                slot = self._slots[adm.lane]
+                job.t_work += dt
+                slot = self._slots[job.lane]
                 if slot is None or slot.req is not req:
-                    return                  # evicted while prefilling
+                    self._drop_job_locked(job)
+                    return                  # evicted while chunking
+                job.next_pos = start + n
+                self._note_chunk_queue_locked()
+                if not final:
+                    return
+                self._drop_job_locked(job)
+                # EWMA over the request's WHOLE prefill (all chunks):
+                # the SLO shed estimate stays comparable to r12's
+                self._prefill_ewma = job.t_work \
+                    if self._prefill_ewma is None \
+                    else 0.8 * self._prefill_ewma + 0.2 * job.t_work
                 req.status = "running"
-                req.trace.event("prefill", t=now, dur_s=round(dt, 6),
-                                token=tok)
+                req.trace.event("prefill", t=now,
+                                dur_s=round(job.t_work, 6), token=tok,
+                                cached_tokens=job.cached_len)
                 req._deliver(tok, now)
                 self._stats["admitted"] += 1
+                # publish the prompt's full blocks into the prefix
+                # cache now their content is final (COW: nothing
+                # writes positions < P past this point)
+                self._pool.register(job.prompt, job.row)
                 if telemetry.enabled():
                     telemetry.counter("serving_admitted_total").inc()
                     telemetry.histogram(
@@ -1286,15 +1406,36 @@ class ServingEngine:
                         .set(self._pool.num_allocated)
                 if tok == self._eos \
                         or len(req.tokens) >= req.max_new_tokens:
-                    self._retire_locked(adm.lane)
+                    self._retire_locked(job.lane)
                     return
-                self._tables[adm.lane, :] = adm.row
-                self._toks[adm.lane] = tok
-                self._pos[adm.lane] = adm.prompt_len
-                self._active[adm.lane] = True
-                self._keys[adm.lane, :] = adm.key
+                self._tables[job.lane, :] = job.row
+                self._toks[job.lane] = tok
+                self._pos[job.lane] = job.P
+                self._active[job.lane] = True
+                self._keys[job.lane, :] = job.key
             finally:
                 prof.note("bookkeeping", time.perf_counter() - t_bk)
+
+    def _drop_job_locked(self, job: _PrefillJob) -> None:
+        try:
+            self._prefill_jobs.remove(job)
+        except ValueError:
+            pass
+        self._note_chunk_queue_locked()
+
+    def _pending_chunks_locked(self) -> int:
+        """Chunks still to run across live prefill jobs (stale jobs —
+        lane reassigned/evicted — excluded)."""
+        ch = self._chunk
+        return sum(-(-(j.P - j.next_pos) // ch)
+                   for j in self._prefill_jobs
+                   if (self._slots[j.lane] is not None
+                       and self._slots[j.lane].req is j.req))
+
+    def _note_chunk_queue_locked(self) -> None:
+        if telemetry.enabled():
+            telemetry.gauge("serving_prefill_chunk_queue_depth") \
+                .set(self._pending_chunks_locked())
 
     def _retire_locked(self, lane: int) -> None:
         req = self._slots[lane].req

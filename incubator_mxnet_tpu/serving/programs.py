@@ -9,10 +9,18 @@ recompiles after warmup (RetraceGuard-pinned in ci/serving_smoke.py):
   the scratch block and their outputs are ignored host-side.  Compiled
   exactly once per engine: admission/eviction only change *argument
   values* (tables, masks), never shapes.
-* ``serving_prefill`` — one prompt prefill at batch 1, padded to the
-  prompt's power-of-two length bucket (`generation.bucket_length`)
-  with the true length riding in as a traced scalar — one program per
-  BUCKET, LRU-capped, reusing r7's program-cache idiom.
+* ``serving_prefill_chunk`` — a FIXED-width window of ``chunk`` prompt
+  positions computed against the paged pool (ISSUE 20).  The engine
+  feeds a prompt through as ``ceil(P_tail / chunk)`` calls of this ONE
+  program — start offset, valid length and the token window all ride
+  in as traced values — so there is no per-bucket program ladder and
+  no pow2 recompile for long prompts, and the scheduler can interleave
+  decode steps between chunks (a 32k-token arrival no longer spikes
+  every resident sequence's tpot).  Each chunk scatters its K/V into
+  the sequence's pages and attends with the per-position
+  ``kpos <= pos`` mask, which makes a position's K/V (and the
+  first-token logits) INDEPENDENT of how the prompt was chunked — the
+  prefix-cache bit-exactness argument in docs/serving.md.
 
 Speculative decoding (ISSUE 19) adds three more static-shaped
 families, built only when the engine configures ``speculate_k > 0``:
@@ -23,8 +31,8 @@ families, built only when the engine configures ``speculate_k > 0``:
 * ``serving_spec_verify`` (+``_kv8``) — ONE batched (k+1)-token
   window forward of the TARGET against its paged pool, with on-device
   exact acceptance/rejection sampling (see `_build_spec_verify`).
-* ``serving_draft_prefill`` — per-bucket prompt prefill into the
-  draft pool.
+* ``serving_draft_prefill_chunk`` — the chunk program on the draft
+  weights, filling the draft pool alongside the target's.
 
 Both donate the pool arrays and their scale pools
 (``donate_argnums=(0, 1, 2, 3)``): the K/V pool
@@ -46,7 +54,7 @@ gather, nothing (B, H, max_seq_len)-shaped materialized — and the same
 guarantees hold within the kernel path (deterministic, lane-local).
 
 ``kv_dtype="int8"`` keys a second program family
-(``serving_step_kv8``/``serving_prefill_kv8``): K/V are quantized
+(``serving_step_kv8``/``serving_prefill_chunk_kv8``): K/V are quantized
 per-head at page-write time (`contrib.quantization.quantize_kv`) with
 fp32 scale pools riding alongside, and dequantized inside the
 attention — s8 pages in HBM, CI-pinned via `.hlolint_contracts.json`.
@@ -71,8 +79,8 @@ from ..ops.paged_attention import default_impl, paged_attention
 __all__ = ["PagedPrograms"]
 
 # LRU cap for the net-level serving program cache (override per net via
-# `net._serving_program_cache_cap`): one step program per engine config
-# plus one prefill per (config, bucket)
+# `net._serving_program_cache_cap`): one step + one prefill-chunk
+# program per engine config (plus the speculative pair when enabled)
 _PROGRAM_CACHE_CAP = 16
 
 # fold_in salts deriving the speculative acceptance / residual-resample
@@ -236,53 +244,81 @@ def _build_step(H, acts, block_size, blocks_per_seq, temperature, top_k,
     return serving_step
 
 
-def _build_prefill(H, acts, block_size, bucket, temperature, top_k,
-                   kv_dtype, name):
-    """Prompt prefill for one length bucket: runs the training-numerics
-    prefill (`generation._prefill`, right-padded prompt + traced
-    valid_len), scatters the resulting per-layer caches into the
-    sequence's pool blocks, and picks the FIRST generated token from
-    h_last — so TTFT is one program call after admission.
+def _build_prefill_chunk(H, acts, block_size, blocks_per_seq, chunk,
+                         temperature, top_k, kv_dtype, attn_impl, name):
+    """ONE fixed-width prefill chunk (ISSUE 20): positions
+    ``start .. start+chunk-1`` of a single sequence's prompt, computed
+    against the paged pool.  The engine walks a prompt's uncached tail
+    through repeated calls — admission binds cache-hit prefix blocks
+    read-only and ``start`` begins at the cached length.
 
-    table_row is the (nbp,) int32 ids of the blocks covering the
-    bucket; positions >= valid_len hold pad garbage that decode
-    overwrites before ever attending to it (write-before-read).  With
-    ``kv_dtype="int8"`` the paged caches are quantized per-head before
-    the scatter and their fp32 scales land in the scale pools.
+    The body is the `_build_spec_verify` window recipe at batch 1:
+    embed the window, scatter each layer's K/V into the sequence's
+    pages (positions >= valid_len land in scratch), then ONE batched
+    `paged_attention` whose per-row ``kpos <= pos`` mask gives every
+    window position exactly its causal prefix — including the
+    positions this very chunk just wrote (write-then-read, the
+    `serving_step` order).  Because each row's math is lane-local
+    (batched matmuls never mix rows; masked slots contribute exactly
+    0.0), a position's K/V and logits are byte-identical however the
+    prompt is split into chunks — the fact that makes a prefix-cache
+    hit bit-identical to a cold prefill.
+
+    The first generated token is picked from the ``valid_len-1`` row
+    on every call; the engine consumes it only from the final chunk.
+    With ``kv_dtype="int8"`` K/V quantize per-head before the scatter
+    and fp32 scales land in the scale pools.
     """
     bs = int(block_size)
-    Pb = int(bucket)
-    nbp = -(-Pb // bs)          # blocks covering the bucket
-    pad_to = nbp * bs
+    nbps = int(blocks_per_seq)
+    CH = int(chunk)
+    msl = nbps * bs
     pick = _row_pick(temperature, top_k)
     kv8 = kv_dtype == "int8"
 
-    def serving_prefill(pool_k, pool_v, scale_k, scale_v, table_row,
-                        prompt, valid_len, key, params):
-        h_last, kcs, vcs = G._prefill(params, prompt, acts, H, pad_to,
-                                      valid_len=valid_len)
+    def serving_prefill_chunk(pool_k, pool_v, scale_k, scale_v, table_row,
+                              toks, start, valid_len, key, params):
+        dt = params["embed"].dtype
+        C = params["embed"].shape[1]
+        posw = start + jnp.arange(CH, dtype=jnp.int32)         # (CH,)
+        ok = posw < valid_len
+        posc = jnp.clip(posw, 0, msl - 1)
+        h = (params["embed"][toks].astype(dt) * math.sqrt(C)
+             + params["pe"][posc].astype(dt))                  # (CH, C)
+        blk_idx = jnp.clip(posc // bs, 0, nbps - 1)
+        off = posc % bs
+        wblk = jnp.where(ok, table_row[blk_idx], jnp.int32(0))
+        tables = jnp.broadcast_to(table_row[None, :], (CH, nbps))
         new_k, new_v, new_sk, new_sv = [], [], [], []
-        for li in range(len(acts)):
-            kc, vc = kcs[li], vcs[li]           # (1, H, pad_to, D)
+        for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+            x = G._ln(h, *lp["ln1"])
+            q, kw, vw = G._qkv_heads(G._dense(x, *lp["qkv"]), H)
             if kv8:
-                kc, ksc = quantize_kv(kc)       # scales (1, H, pad_to)
-                vc, vsc = quantize_kv(vc)
-                new_sk.append(scale_k[li].at[table_row].set(
-                    ksc[0].reshape(-1, nbp, bs).transpose(1, 0, 2)))
-                new_sv.append(scale_v[li].at[table_row].set(
-                    vsc[0].reshape(-1, nbp, bs).transpose(1, 0, 2)))
-            # (1, H, pad_to, D) -> (nbp, H, bs, D): page the cache
-            kcp = kc[0].reshape(-1, nbp, bs, kc.shape[-1])
-            vcp = vc[0].reshape(-1, nbp, bs, vc.shape[-1])
-            new_k.append(pool_k[li].at[table_row].set(
-                kcp.transpose(1, 0, 2, 3)))
-            new_v.append(pool_v[li].at[table_row].set(
-                vcp.transpose(1, 0, 2, 3)))
-        first = pick(G._logits_of(params, h_last), valid_len - 1, key)
-        return tuple(new_k), tuple(new_v), tuple(new_sk), tuple(new_sv), first
+                kw, ks = quantize_kv(kw)   # (CH,H,D) s8 / (CH,H) f32
+                vw, vs = quantize_kv(vw)
+                sk = scale_k[li].at[wblk, :, off].set(ks)
+                sv = scale_v[li].at[wblk, :, off].set(vs)
+                new_sk.append(sk)
+                new_sv.append(sv)
+            else:
+                sk = sv = None
+            pk = pool_k[li].at[wblk, :, off].set(kw)
+            pv = pool_v[li].at[wblk, :, off].set(vw)
+            a = paged_attention(q, pk, pv, tables, posc,
+                                scale_k=sk, scale_v=sv,
+                                impl=attn_impl)                # (CH,H,D)
+            h = h + G._dense(a.reshape(CH, C), *lp["proj"])
+            h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
+            new_k.append(pk)
+            new_v.append(pv)
+        logits = G._logits_of(params, h)                       # (CH, V)
+        li_idx = jnp.clip(valid_len - 1 - start, 0, CH - 1)
+        first = pick(logits[li_idx], valid_len - 1, key)
+        return (tuple(new_k), tuple(new_v), tuple(new_sk),
+                tuple(new_sv), first)
 
-    serving_prefill.__name__ = name
-    return serving_prefill
+    serving_prefill_chunk.__name__ = name
+    return serving_prefill_chunk
 
 
 def _build_draft_step(H, acts, block_size, k, temperature, top_k,
@@ -333,34 +369,48 @@ def _build_draft_step(H, acts, block_size, k, temperature, top_k,
     return serving_draft_step
 
 
-def _build_draft_prefill(H, acts, block_size, bucket, name):
-    """Prompt prefill into the DRAFT pool for one length bucket — the
-    target `serving_prefill` minus the first-token pick (the target
-    already picked it) and minus the int8-KV family (the draft pool
-    always stays in the draft model's dtype: it is small and its
-    quantization error would depress acceptance for nothing)."""
+def _build_draft_prefill_chunk(H, acts, block_size, blocks_per_seq,
+                               chunk, attn_impl, name):
+    """The chunk program on the DRAFT weights, filling the draft pool
+    alongside the target's — `_build_prefill_chunk` minus the
+    first-token pick (the target already picks it) and minus the
+    int8-KV family (the draft pool always stays in the draft model's
+    dtype: it is small and its quantization error would depress
+    acceptance for nothing)."""
     bs = int(block_size)
-    Pb = int(bucket)
-    nbp = -(-Pb // bs)
-    pad_to = nbp * bs
+    nbps = int(blocks_per_seq)
+    CH = int(chunk)
+    msl = nbps * bs
 
-    def serving_draft_prefill(pool_k, pool_v, table_row, prompt,
-                              valid_len, params):
-        _, kcs, vcs = G._prefill(params, prompt, acts, H, pad_to,
-                                 valid_len=valid_len)
+    def serving_draft_prefill_chunk(pool_k, pool_v, table_row, toks,
+                                    start, valid_len, params):
+        dt = params["embed"].dtype
+        C = params["embed"].shape[1]
+        posw = start + jnp.arange(CH, dtype=jnp.int32)
+        ok = posw < valid_len
+        posc = jnp.clip(posw, 0, msl - 1)
+        h = (params["embed"][toks].astype(dt) * math.sqrt(C)
+             + params["pe"][posc].astype(dt))                  # (CH, C)
+        blk_idx = jnp.clip(posc // bs, 0, nbps - 1)
+        off = posc % bs
+        wblk = jnp.where(ok, table_row[blk_idx], jnp.int32(0))
+        tables = jnp.broadcast_to(table_row[None, :], (CH, nbps))
         new_k, new_v = [], []
-        for li in range(len(acts)):
-            kc, vc = kcs[li], vcs[li]           # (1, H, pad_to, D)
-            kcp = kc[0].reshape(-1, nbp, bs, kc.shape[-1])
-            vcp = vc[0].reshape(-1, nbp, bs, vc.shape[-1])
-            new_k.append(pool_k[li].at[table_row].set(
-                kcp.transpose(1, 0, 2, 3)))
-            new_v.append(pool_v[li].at[table_row].set(
-                vcp.transpose(1, 0, 2, 3)))
+        for li, (lp, act) in enumerate(zip(params["layers"], acts)):
+            x = G._ln(h, *lp["ln1"])
+            q, kw, vw = G._qkv_heads(G._dense(x, *lp["qkv"]), H)
+            pk = pool_k[li].at[wblk, :, off].set(kw)
+            pv = pool_v[li].at[wblk, :, off].set(vw)
+            a = paged_attention(q, pk, pv, tables, posc,
+                                impl=attn_impl)
+            h = h + G._dense(a.reshape(CH, C), *lp["proj"])
+            h = h + G._ffn_fwd(G._ln(h, *lp["ln2"]), lp, act)
+            new_k.append(pk)
+            new_v.append(pv)
         return tuple(new_k), tuple(new_v)
 
-    serving_draft_prefill.__name__ = name
-    return serving_draft_prefill
+    serving_draft_prefill_chunk.__name__ = name
+    return serving_draft_prefill_chunk
 
 
 def _build_spec_verify(H, acts, block_size, k, temperature, top_k,
@@ -493,15 +543,16 @@ def _build_spec_verify(H, acts, block_size, k, temperature, top_k,
 
 class PagedPrograms:
     """The engine's compiled-program surface: one jitted step program
-    plus per-bucket prefill programs, all resolved through a net-level
-    LRU keyed by the full static config — rebuilding an engine with
-    the same config reuses the compiled programs.  Holds only static
-    config — the engine owns the pool arrays and the weights pytree."""
+    plus ONE fixed-width prefill-chunk program, all resolved through a
+    net-level LRU keyed by the full static config — rebuilding an
+    engine with the same config reuses the compiled programs.  Holds
+    only static config — the engine owns the pool arrays and the
+    weights pytree."""
 
     def __init__(self, net, *, max_batch, block_size, blocks_per_seq,
                  temperature, top_k, quantized, kv_dtype=None,
-                 attn_impl=None, speculate_k=0, draft_net=None,
-                 spec_greedy=False):
+                 attn_impl=None, prefill_chunk=32, speculate_k=0,
+                 draft_net=None, spec_greedy=False):
         if kv_dtype not in (None, "int8"):
             raise ValueError(
                 f"kv_dtype must be None (model dtype) or 'int8', "
@@ -524,9 +575,13 @@ class PagedPrograms:
         # distinct def names per KV family: RetraceGuard budgets
         # compiles BY NAME, so the int8-KV programs must not count
         # against (or hide behind) the float-KV budget
+        if int(prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        self._chunk = int(prefill_chunk)
         sfx = "_kv8" if kv_dtype == "int8" else ""
         self._step_name = "serving_step" + sfx
-        self._prefill_name = "serving_prefill" + sfx
+        self._prefill_name = "serving_prefill_chunk" + sfx
         self._key = (self._H, self._acts, self._bs, self._nbps,
                      self._temperature, self._top_k, self.path,
                      self._kv_dtype, self._impl)
@@ -545,6 +600,21 @@ class PagedPrograms:
                        "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
                        gauge="serving_program_cache_size")
         self._step = step
+        pkey = ("prefill_chunk", self._chunk) + self._key
+        pfc = G._lru_touch(cache, pkey)
+        if pfc is None:
+            _note_build("prefill_chunk")
+            pfc = jax.jit(
+                _build_prefill_chunk(self._H, self._acts, self._bs,
+                                     self._nbps, self._chunk,
+                                     self._temperature, self._top_k,
+                                     self._kv_dtype, self._impl,
+                                     self._prefill_name),
+                donate_argnums=(0, 1, 2, 3))
+            G._lru_put(net, cache, pkey, pfc,
+                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        self._prefill_chunk = pfc
         self._init_speculative(net, speculate_k, draft_net, spec_greedy)
 
     def _init_speculative(self, net, speculate_k, draft_net, spec_greedy):
@@ -616,6 +686,21 @@ class PagedPrograms:
                        "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
                        gauge="serving_program_cache_size")
         self._spec_verify = verify
+        dpkey = (("draft_prefill_chunk", self._chunk) + self._key
+                 + (self._draft_H, self._draft_acts))
+        dpfc = G._lru_touch(cache, dpkey)
+        if dpfc is None:
+            _note_build("draft_prefill_chunk")
+            dpfc = jax.jit(
+                _build_draft_prefill_chunk(
+                    self._draft_H, self._draft_acts, self._bs,
+                    self._nbps, self._chunk, self._impl,
+                    "serving_draft_prefill_chunk"),
+                donate_argnums=(0, 1))
+            G._lru_put(net, cache, dpkey, dpfc,
+                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
+                       gauge="serving_program_cache_size")
+        self._draft_prefill_chunk = dpfc
 
     @property
     def path(self) -> str:
@@ -660,23 +745,16 @@ class PagedPrograms:
     def step(self):
         return self._step
 
-    def prefill(self, bucket):
-        """The jitted prefill program for prompt bucket ``bucket``
-        (net-level LRU; cap via `net._serving_program_cache_cap`)."""
-        cache = _net_program_cache(self._net)
-        key = ("prefill", bucket) + self._key
-        fn = G._lru_touch(cache, key)
-        if fn is None:
-            _note_build("prefill")
-            fn = jax.jit(
-                _build_prefill(self._H, self._acts, self._bs, bucket,
-                               self._temperature, self._top_k,
-                               self._kv_dtype, self._prefill_name),
-                donate_argnums=(0, 1, 2, 3))
-            G._lru_put(self._net, cache, key, fn,
-                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
-                       gauge="serving_program_cache_size")
-        return fn
+    @property
+    def prefill_chunk(self):
+        """The jitted fixed-width prefill-chunk program (ONE per
+        engine config — no bucket ladder)."""
+        return self._prefill_chunk
+
+    @property
+    def prefill_chunk_len(self) -> int:
+        """Static chunk width in tokens."""
+        return self._chunk
 
     # -- speculative decoding (ISSUE 19) ------------------------------- #
     @property
@@ -719,21 +797,7 @@ class PagedPrograms:
             self._draft_params_key = key
         return self._draft_params
 
-    def draft_prefill(self, bucket):
-        """The jitted DRAFT prefill program for prompt bucket
-        ``bucket`` (net-level LRU, like `prefill`)."""
-        cache = _net_program_cache(self._net)
-        key = (("draft_prefill", bucket) + self._key
-               + (self._draft_H, self._draft_acts))
-        fn = G._lru_touch(cache, key)
-        if fn is None:
-            _note_build("draft_prefill")
-            fn = jax.jit(
-                _build_draft_prefill(self._draft_H, self._draft_acts,
-                                     self._bs, bucket,
-                                     "serving_draft_prefill"),
-                donate_argnums=(0, 1))
-            G._lru_put(self._net, cache, key, fn,
-                       "_serving_program_cache_cap", _PROGRAM_CACHE_CAP,
-                       gauge="serving_program_cache_size")
-        return fn
+    @property
+    def draft_prefill_chunk(self):
+        """The jitted DRAFT prefill-chunk program (speculation only)."""
+        return self._draft_prefill_chunk

@@ -11,7 +11,9 @@ commit `end_step()` closes one ledger decomposing the step's wall time
 waits and idle polls between steps are attributed, not lost) into:
 
     device_step     decode device call (fault-hook injection included)
-    prefill         interleaved prefill device calls
+    prefill         interleaved prefill device calls (legacy cause;
+                    chunked prefill notes prefill_chunk)
+    prefill_chunk   interleaved fixed-width prefill-chunk device calls
     gather_params   weight gather / requantize for the program call
     lock_wait       scheduler blocked acquiring the engine lock
     bookkeeping     reap + admission reservation + commit sections
@@ -89,8 +91,8 @@ DEFAULT_STALL_RING = int(os.environ.get("MXTPU_STALLZ_RING", "64") or 64)
 # device phases (ISSUE 19) — a speculative engine notes those instead
 # of device_step
 CAUSES = ("device_step", "draft_step", "verify_step", "prefill",
-          "gather_params", "lock_wait", "bookkeeping", "wait", "gc",
-          "host_other")
+          "prefill_chunk", "gather_params", "lock_wait", "bookkeeping",
+          "wait", "gc", "host_other")
 # /profilez sleeps on an HTTP handler thread — bound it
 MAX_CAPTURE_S = 30.0
 # phase events shorter than this don't land in the trace deque (a 2 µs

@@ -1,0 +1,288 @@
+"""Copy-on-write prefix caching + chunked prefill (ISSUE 20).
+
+The load-bearing contracts:
+
+* **Cache-hit bit-identity** — a request admitted with its prefix
+  blocks already resident produces byte-identical greedy output to a
+  cold admission (and to `lm_generate`): bound blocks are read-only,
+  chunk boundaries don't change per-position K/V or logits.
+* **Refcount exactness** — shared blocks are decref'd, never
+  double-freed: evict-while-shared, cancel-mid-chunked-prefill, and
+  two requests racing to admit the same new prefix all leave the pool
+  fully drained with the cache intact.
+* **Collision safety** — `lookup` verifies token slices, not just the
+  32-bit chain hash, so a forced hash collision is a miss, never a
+  wrong binding.
+
+Tiny nets, small chunks (prefill_chunk=4 exercises many chunk
+boundaries per prompt), shared module-scope engine to bound compiles.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.models.generation import lm_generate
+from incubator_mxnet_tpu.models.transformer import TransformerLM
+from incubator_mxnet_tpu.ndarray.ndarray import NDArray
+from incubator_mxnet_tpu.serving import (BlockPool, RequestCancelled,
+                                         ServingEngine)
+
+V, C, DFF, L, H, MAXLEN = 61, 16, 32, 1, 2, 64
+_POLL = 0.001
+
+_RS = onp.random.RandomState(42)
+PREF = _RS.randint(0, V, size=16).astype(onp.int32)    # 2 full blocks @ 8
+TAIL_A = _RS.randint(0, V, size=5).astype(onp.int32)
+TAIL_B = _RS.randint(0, V, size=5).astype(onp.int32)
+PA = onp.concatenate([PREF, TAIL_A])                   # P=21: 6 chunks @ 4
+PB = onp.concatenate([PREF, TAIL_B])
+PLONG = _RS.randint(0, V, size=33).astype(onp.int32)   # 9 chunks @ 4
+
+
+def _wait(pred, timeout=30.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(0)
+    n = TransformerLM(vocab=V, units=C, hidden_size=DFF, num_layers=L,
+                      num_heads=H, max_len=MAXLEN, dropout=0.0)
+    n.initialize()
+    n(NDArray(jnp.ones((1, 4), jnp.int32)))
+    return n
+
+
+@pytest.fixture(scope="module")
+def engine(net):
+    """Shared chunked engine: ONE prefill-chunk program + one step
+    program for the whole module (prefill_chunk=4 makes every prompt
+    here span several chunk boundaries)."""
+    eng = ServingEngine(net, max_batch=2, block_size=8, prefill_chunk=4,
+                        poll_interval=_POLL)
+    yield eng
+    try:
+        eng.close()
+    except Exception:
+        pass
+
+
+@pytest.fixture
+def clean_engine(engine):
+    engine.set_fault_hook(None)
+    yield engine
+    engine.drain(timeout=30)
+    engine.set_fault_hook(None)
+
+
+# --------------------------------------------------------------------- #
+# pool: content addressing, refcounts, LRU
+# --------------------------------------------------------------------- #
+def test_pool_lookup_register_roundtrip():
+    pool = BlockPool(8, 4)                 # scratch + 7, block_size 4
+    toks = list(range(100, 111))           # P=11: 2 full blocks
+    ids = pool.alloc(3)
+    pool.register(toks, ids)               # publishes ids[0], ids[1]
+    assert pool.lookup(toks) == (ids[:2], 8)
+    # the last prompt position is never served from cache: P=8 walks
+    # (P-1)//bs = 1 block only
+    assert pool.lookup(toks[:8]) == (ids[:1], 4)
+    # divergence after block 0: only the shared block binds
+    assert pool.lookup(toks[:4] + [1, 2, 3, 4, 9]) == (ids[:1], 4)
+    # a never-seen prefix misses entirely
+    assert pool.lookup([9] * 11) == ([], 0)
+
+
+def test_pool_refcounts_shared_free_and_lru_harvest():
+    pool = BlockPool(6, 4)                 # scratch + 5
+    toks = list(range(1, 9))               # 2 full blocks
+    a = pool.alloc(2)
+    pool.register(toks, a)
+    hits, clen = pool.lookup(toks + [7])
+    assert hits == a and clen == 8
+    pool.bind(hits)                        # second owner: refcount 2
+    assert pool.num_shared == 2
+    pool.free(a)                           # decref: still allocated
+    assert pool.num_allocated == 2 and pool.num_shared == 0
+    pool.free(a)                           # last ref: parks evictable
+    assert pool.num_allocated == 0 and pool.num_free == 5
+    with pytest.raises(ValueError):
+        pool.free(a)                       # double free still fails fast
+    # content survives refcount 0: a new request still hits
+    assert pool.lookup(toks + [7]) == (a, 8)
+    # never-cached free blocks are preferred over harvesting the cache
+    assert pool.alloc(3) == [3, 4, 5]
+    assert pool.lookup(toks + [7])[1] == 8
+    # exhaustion harvests cached blocks oldest-first, dropping entries
+    assert set(pool.alloc(2)) == set(a)
+    assert pool.lookup(toks + [7]) == ([], 0)
+    assert pool.num_cached == 0
+
+
+def test_pool_bind_rollback_keeps_cache():
+    pool = BlockPool(6, 4)
+    toks = list(range(10, 18))
+    a = pool.alloc(2)
+    pool.register(toks, a)
+    pool.free(a)                           # evictable, refcount 0
+    hits, _ = pool.lookup(toks + [3])
+    pool.bind(hits)
+    pool.unbind(hits)                      # admission rolled back
+    assert pool.num_allocated == 0
+    assert pool.lookup(toks + [3]) == (a, 8)   # still resident
+
+
+def test_pool_hash_collision_is_a_miss(monkeypatch):
+    pool = BlockPool(8, 4)
+    # force EVERY chain hash to collide: token verification is now the
+    # only thing between a collision and a wrong binding
+    monkeypatch.setattr(BlockPool, "_chain",
+                        staticmethod(lambda h, sl: 1))
+    t1 = [1, 2, 3, 4, 5, 6, 7, 8]
+    a = pool.alloc(2)
+    pool.register(t1, a)
+    t2 = [9, 9, 9, 9, 5, 6, 7, 8]          # same hash, different tokens
+    assert pool.lookup(t2 + [0]) == ([], 0)
+    assert pool.lookup(t1 + [0]) == (a, 8)  # the real prefix still hits
+
+
+def test_pool_register_first_wins():
+    pool = BlockPool(8, 4)
+    toks = list(range(20, 28))
+    a = pool.alloc(2)
+    b = pool.alloc(2)
+    pool.register(toks, a)
+    pool.register(toks, b)                 # racing loser: a no-op
+    assert pool.lookup(toks + [0]) == (a, 8)
+    pool.free(b)                           # loser's blocks were private:
+    assert pool.num_free == 5              # straight back to the heap
+    pool.free(a)
+    assert pool.num_free == 7
+
+
+# --------------------------------------------------------------------- #
+# engine: chunked prefill + cache-hit bit-identity
+# --------------------------------------------------------------------- #
+def test_chunked_prefill_parity_with_lm_generate(net, clean_engine):
+    # 21-token prompt through 6 chunks of 4: per-position K/V and the
+    # first-token logits must be byte-identical to the monolithic path
+    ref = onp.asarray(lm_generate(net, PA[None, :], 8))[0, len(PA):]
+    cold = clean_engine.submit(PA, 8)
+    assert cold.result(timeout=60) == ref.tolist()
+    st = clean_engine.stats()
+    assert st["prefix_cache"]["misses"] >= 1
+
+
+def test_cache_hit_bit_identical_to_cold(net, clean_engine):
+    ref = onp.asarray(lm_generate(net, PA[None, :], 8))[0, len(PA):]
+    hits0 = clean_engine.stats()["prefix_cache"]["hits"]
+    req = clean_engine.submit(PA, 8)       # PREF+TAIL_A registered above
+    assert req.result(timeout=60) == ref.tolist()
+    st = clean_engine.stats()
+    assert st["prefix_cache"]["hits"] == hits0 + 1
+    adm = next(e for e in req.trace.snapshot() if e["name"] == "admitted")
+    assert adm["cached_tokens"] == 16      # 2 of 3 prompt blocks bound
+    assert adm["chunks"] == 2              # only the 5-token tail chunks
+    assert st["blocks_free"] == st["blocks_total"]
+
+
+def test_evict_while_shared_decrefs_exactly(net, clean_engine):
+    eng = clean_engine
+    ref_b = onp.asarray(lm_generate(net, PB[None, :], 10))[0, len(PB):]
+    eng.set_fault_hook(lambda ph: time.sleep(0.02) if ph == "step"
+                       else None)
+    ra = eng.submit(PA, 20)                # both bind PREF's 2 blocks
+    rb = eng.submit(PB, 10)
+    assert _wait(lambda: len(rb.tokens) >= 2)
+    assert eng._pool.num_shared >= 2       # genuinely shared right now
+    ra.cancel()                            # evict one sharer mid-decode
+    with pytest.raises(RequestCancelled):
+        ra.result(timeout=30)
+    assert rb.result(timeout=60) == ref_b.tolist()   # survivor exact
+    eng.set_fault_hook(None)
+    st = eng.stats()
+    assert st["blocks_free"] == st["blocks_total"]
+    assert eng._pool.num_allocated == 0    # every refcount drained
+
+
+def test_cancel_mid_chunked_prefill_releases_only_private(net,
+                                                          clean_engine):
+    eng = clean_engine
+    ref = onp.asarray(lm_generate(net, PLONG[None, :], 6))[0, len(PLONG):]
+    assert eng.submit(PLONG, 6).result(timeout=60) == ref.tolist()
+    cached_before = eng._pool.num_cached   # PLONG registered 4 blocks
+    assert cached_before >= 4
+    # a prompt sharing ONE block with PLONG, then diverging: 25 tokens
+    # of tail, slowed to ~0.05 s per chunk so cancel lands mid-prefill
+    pb = onp.concatenate([PLONG[:8],
+                          _RS.randint(0, V, size=25).astype(onp.int32)])
+    eng.set_fault_hook(lambda ph: time.sleep(0.05) if ph == "prefill"
+                       else None)
+    req = eng.submit(pb, 6)
+    assert _wait(lambda: eng._pool.num_allocated > 0)
+    req.cancel()
+    with pytest.raises(RequestCancelled):
+        req.result(timeout=30)
+    eng.set_fault_hook(None)
+    assert eng.drain(timeout=30)
+    st = eng.stats()
+    assert st["blocks_free"] == st["blocks_total"]
+    assert eng._pool.num_allocated == 0
+    # only the PRIVATE blocks were released to the heap — the shared
+    # registered content survived the cancel and still serves hits
+    assert eng._pool.num_cached == cached_before
+    hits0 = st["prefix_cache"]["hits"]
+    assert eng.submit(PLONG, 6).result(timeout=60) == ref.tolist()
+    assert eng.stats()["prefix_cache"]["hits"] == hits0 + 1
+
+
+def test_race_to_admit_same_new_prefix(net, clean_engine):
+    eng = clean_engine
+    fresh = _RS.randint(0, V, size=21).astype(onp.int32)   # unseen prefix
+    ref = onp.asarray(lm_generate(net, fresh[None, :], 6))[0, len(fresh):]
+    # both lanes admit the same never-cached prefix in the same tick:
+    # whichever finishes first registers; the loser's registration is a
+    # first-wins no-op and its blocks stay private — correct either way
+    r1 = eng.submit(fresh, 6)
+    r2 = eng.submit(fresh, 6)
+    assert r1.result(timeout=60) == ref.tolist()
+    assert r2.result(timeout=60) == ref.tolist()
+    st = eng.stats()
+    assert st["blocks_free"] == st["blocks_total"]
+    assert eng._pool.num_allocated == 0
+    # the winner's registration serves a third arrival from cache
+    hits0 = st["prefix_cache"]["hits"]
+    assert eng.submit(fresh, 6).result(timeout=60) == ref.tolist()
+    assert eng.stats()["prefix_cache"]["hits"] == hits0 + 1
+
+
+def test_speculation_composes_with_prefix_cache(net):
+    # the draft pool shares tables and block ids with the target pool,
+    # so a cache-hit admission binds DRAFT pages too (written by the
+    # registrant's draft chunk prefill over the same block ids)
+    mx.random.seed(3)
+    draft = TransformerLM(vocab=V, units=8, hidden_size=16, num_layers=1,
+                          num_heads=1, max_len=MAXLEN, dropout=0.0)
+    draft.initialize()
+    draft(NDArray(jnp.ones((1, 4), jnp.int32)))
+    ref = onp.asarray(lm_generate(net, PA[None, :], 8))[0, len(PA):]
+    with ServingEngine(net, max_batch=2, block_size=8, prefill_chunk=4,
+                       speculate_k=3, draft_net=draft,
+                       poll_interval=_POLL) as eng:
+        cold = eng.submit(PA, 8).result(timeout=60)
+        assert cold == ref.tolist()        # spec greedy == lm_generate
+        hit = eng.submit(PA, 8).result(timeout=60)
+        assert hit == cold                 # cache hit: bit-identical
+        st = eng.stats()
+        assert st["prefix_cache"]["hits"] >= 1
+        assert st["speculate"]["proposed"] > 0   # spec really ran
+        assert st["blocks_free"] == st["blocks_total"]
+        assert eng._pool.num_allocated == 0

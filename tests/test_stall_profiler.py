@@ -418,7 +418,7 @@ def test_engine_ledger_invariant_holds_live(engine):
         assert total == pytest.approx(rec["wall_s"],
                                       rel=0.05, abs=1e-6)
     rows = {r["cause"] for r in engine.stall_table()}
-    assert "device_step" in rows and "prefill" in rows
+    assert "device_step" in rows and "prefill_chunk" in rows
 
 
 def test_engine_capture_profile_has_lanes(engine):
@@ -445,7 +445,8 @@ def test_engine_http_stallz_profilez_varz(engine):
     assert cfg["max_batch"] == 2 and cfg["block_size"] == 8
     assert cfg["kv_dtype"] == "model"
     assert cfg["attn_impl"] in ("pallas", "dense")
-    assert cfg["bucket_ladder"][0] == 8
+    assert cfg["prefill_chunk"] == engine._chunk
+    assert cfg["prefix_cache"] is True
     assert cfg["slo"]["objective"] == pytest.approx(0.99)
     assert cfg["profiler"]["enabled"] in (True, False)
     with pytest.raises(urllib.error.HTTPError) as ei:
